@@ -1,0 +1,25 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capability
+surface of pre-1.0 Apache MXNet (reference: shujonnaha/incubator-mxnet).
+
+See SURVEY.md at the repo root for the reference structural analysis and
+README.md for the architecture of this re-design:  imperative NDArray ops
+dispatch to cached XLA executables, bound Symbol graphs compile to a single
+XLA computation, distribution is jax.sharding meshes + XLA collectives over
+ICI/DCN, and Gluon-style blocks hybridize into jitted programs.
+"""
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context
+from . import random
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
+
+__version__ = "0.1.0"

@@ -1,0 +1,268 @@
+"""Imperative autograd.
+
+TPU-native replacement for the reference's ``AutogradRuntime`` tape
+(``src/ndarray/autograd.{h,cc}``; Python ``python/mxnet/autograd.py``).
+
+The reference records each imperative op as an nnvm node and, on
+``backward()``, builds a throwaway ``GraphExecutor`` over the recorded
+subgraph (``autograd.cc:229``).  Here the tape records
+``(op, attrs, input buffers, output ids, rng key)`` and ``backward()``
+replays the tape as a **pure function of the marked variables**, then takes
+``jax.vjp`` of that function — gradient construction is delegated to JAX's
+program transform instead of per-op FGradient rewrites.  Because recorded
+buffers are immutable ``jax.Array``s, later in-place rebinding of an
+NDArray cannot corrupt the tape (the reference needs engine version
+tracking for the same guarantee).
+
+API surface matches the reference: ``record()``/``pause()``,
+``train_mode()``/``predict_mode()``, ``mark_variables``, ``backward``,
+``grad``, ``is_recording``/``is_training``.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "mark_variables",
+           "backward", "grad", "is_recording", "is_training", "set_recording",
+           "set_training"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []          # list of _TapeEntry
+        # marked variables, stable across buffer rebinds:
+        _state.marked_vars = []   # list of (NDArray, grad NDArray, req)
+        # id(jax buffer) -> (NDArray, grad, req); REBUILT at each fresh
+        # record() from live buffers — raw ids of freed buffers can be
+        # reused by Python, so a persistent id-keyed map would alias
+        # rebound variables across training steps.
+        _state.marked = {}
+    return _state
+
+
+def _rebuild_marked_map():
+    st = _st()
+    st.marked = {id(var._data): (var, g, req)
+                 for (var, g, req) in st.marked_vars}
+
+
+class _TapeEntry:
+    __slots__ = ("op", "attrs", "in_ids", "in_bufs", "out_ids", "out_bufs",
+                 "rng")
+
+    def __init__(self, op, attrs, in_ids, in_bufs, out_ids, out_bufs, rng):
+        self.op = op
+        self.attrs = attrs
+        self.in_ids = in_ids      # buffer ids at record time
+        self.in_bufs = in_bufs    # the immutable jax arrays themselves
+        self.out_ids = out_ids
+        # output buffers are retained too: ids are raw addresses, so a
+        # freed output could otherwise alias a later unrelated buffer
+        self.out_bufs = out_bufs
+        self.rng = rng
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _st().recording = bool(is_record)
+    return prev
+
+
+def set_training(train):
+    prev = _st().training
+    _st().training = bool(train)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train):
+        self._rec, self._train = is_record, train
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec and not st.recording:
+            # a fresh outermost record() starts a fresh graph; drops any
+            # tape left by a record scope whose backward was never called,
+            # and re-keys the marked-variable map to the live buffers
+            st.tape.clear()
+            _rebuild_marked_map()
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — start the tape (+train mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference ``MXAutogradMarkVariables``)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    st = _st()
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+        var._tape_marked = True
+        st.marked_vars = [e for e in st.marked_vars if e[0] is not var]
+        st.marked_vars.append((var, g, req))
+        st.marked[id(var._data)] = (var, g, req)
+
+
+def _record(op, attrs, in_nds, in_bufs, out_nds, out_bufs, rng_key):
+    """Called by imperative_invoke for every op while recording."""
+    from .ndarray.ndarray import NDArray
+
+    st = _st()
+    # track marked vars through rebinds within this recording: a marked
+    # var whose buffer was rebound since the map was built gets re-keyed
+    # (buffers recorded on the tape stay alive, so no id reuse here)
+    for x in in_nds:
+        if isinstance(x, NDArray) and x._tape_marked:
+            ident = id(x._data)
+            if ident not in st.marked:
+                st.marked[ident] = (x, x._grad, x._grad_req)
+    n_rng = 1 if op.needs_rng else 0
+    st.tape.append(_TapeEntry(
+        op, attrs,
+        [id(b) for b in in_bufs[n_rng:]],
+        list(in_bufs[n_rng:]),
+        [id(b) for b in out_bufs],
+        list(out_bufs),
+        rng_key))
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``heads`` w.r.t. all marked variables
+    (reference ``MXAutogradBackwardEx`` → ``ComputeGradient``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+    from .ops import registry as _reg
+
+    st = _st()
+    if not st.tape:
+        raise MXNetError("autograd.backward called without recorded graph")
+
+    heads = [h for h in heads]
+    head_ids = [id(h._data) for h in heads]
+
+    # leaves = marked variables that actually feed the tape.  A marked
+    # NDArray may have been rebound since marking, so resolve each marked
+    # buffer id against the tape's recorded input buffers; drop ids that
+    # never feed the tape (dedup per variable, keep the live one).
+    tape = list(st.tape)
+    tape_in = {}
+    for entry in tape:
+        for bid, buf in zip(entry.in_ids, entry.in_bufs):
+            tape_in.setdefault(bid, buf)
+    leaf_ids, leaf_entries, leaf_bufs, seen_vars = [], [], [], set()
+    for bid, (var, gbuf, req) in st.marked.items():
+        if bid not in tape_in:
+            continue
+        if id(var) in seen_vars:
+            continue
+        seen_vars.add(id(var))
+        leaf_ids.append(bid)
+        leaf_entries.append((var, gbuf, req))
+        leaf_bufs.append(tape_in[bid])
+
+    def replay(leaf_vals):
+        env = dict(zip(leaf_ids, leaf_vals))
+        for entry in tape:
+            ins = [env.get(bid, buf)
+                   for bid, buf in zip(entry.in_ids, entry.in_bufs)]
+            if entry.op.needs_rng:
+                ins = [entry.rng] + ins
+            outs = entry.op.compute(entry.attrs, *ins)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for oid, o in zip(entry.out_ids, outs):
+                env[oid] = o
+        out_heads = []
+        for hid, h in zip(head_ids, heads):
+            if hid not in env:
+                raise MXNetError("head is not an output of the recorded graph")
+            out_heads.append(env[hid])
+        return tuple(out_heads)
+
+    out_vals, vjp_fn = jax.vjp(replay, tuple(leaf_bufs))
+    if head_grads is None:
+        cts = tuple(jnp.ones_like(o) for o in out_vals)
+    else:
+        cts = tuple(
+            jnp.ones_like(o) if hg is None else
+            (hg._data if isinstance(hg, NDArray) else jnp.asarray(hg))
+            for o, hg in zip(out_vals, head_grads))
+    (leaf_grads,) = vjp_fn(cts)
+
+    for (var, gbuf, req), g in zip(leaf_entries, leaf_grads):
+        if req == "null" or gbuf is None:
+            continue
+        if req == "add":
+            gbuf._set_data(gbuf._data + g)
+        else:
+            gbuf._set_data(g)
+
+    if not retain_graph:
+        st.tape.clear()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads wrt variables without touching ``.grad``
+    (reference ``autograd.grad``)."""
+    from .ndarray.ndarray import zeros, NDArray
+
+    st = _st()
+    saved = [(v._grad, v._grad_req, v._tape_marked) for v in variables]
+    saved_marked_vars = list(st.marked_vars)
+    saved_marked = dict(st.marked)
+    gbufs = [zeros(v.shape, v.context, dtype=v.dtype) for v in variables]
+    mark_variables(variables, gbufs)
+    try:
+        backward(heads, head_grads,
+                 retain_graph=bool(retain_graph or create_graph),
+                 train_mode=train_mode)
+    finally:
+        for v, (g, r, m) in zip(variables, saved):
+            v._grad, v._grad_req, v._tape_marked = g, r, m
+        st.marked_vars = saved_marked_vars
+        st.marked = saved_marked
+    return gbufs

@@ -1,0 +1,75 @@
+"""Base utilities: errors, logging, env config, registries.
+
+TPU-native replacement for the dmlc-core surface the reference uses
+(``dmlc::GetEnv`` config, ``dmlc::logging``, ``dmlc::Registry`` — see
+reference ``include/mxnet/base.h`` and SURVEY.md §2.1).  There is no C ABI
+boundary here: the "registry" that in MXNet lives in C++ and is re-exported
+through ``MXSymbolListAtomicSymbolCreators`` is a Python-level registry whose
+entries carry JAX/XLA compute functions (see ``mxnet_tpu.ops.registry``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["MXNetError", "get_env", "string_types", "numeric_types", "logger"]
+
+logger = logging.getLogger("mxnet_tpu")
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (mirrors ``MXNetError`` raised through the
+    reference's C ABI ``MXGetLastError``, ``python/mxnet/base.py``)."""
+
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+def get_env(name, default, typ=None):
+    """Typed env-var lookup, equivalent of ``dmlc::GetEnv``.
+
+    The reference's runtime-config catalog is in
+    ``docs/how_to/env_var.md`` (SURVEY.md Appendix B); the TPU build keeps
+    the same mechanism with an ``MXTPU_`` prefix while also honoring the
+    original ``MXNET_`` names.
+    """
+    for prefix in ("MXTPU_", "MXNET_", ""):
+        key = name if name.startswith(("MXTPU_", "MXNET_")) else prefix + name
+        if key in os.environ:
+            raw = os.environ[key]
+            t = typ or type(default)
+            if t is bool:
+                return raw not in ("0", "false", "False", "")
+            return t(raw)
+    return default
+
+
+class _Registry:
+    """Generic name → object registry (equivalent of ``dmlc::Registry``)."""
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._entries = {}
+
+    def register(self, name, obj=None):
+        if obj is None:  # decorator form
+            def _reg(o):
+                self._entries[name] = o
+                return o
+            return _reg
+        self._entries[name] = obj
+        return obj
+
+    def get(self, name):
+        if name not in self._entries:
+            raise MXNetError(
+                "%s %r is not registered (known: %s)"
+                % (self._kind, name, sorted(self._entries)))
+        return self._entries[name]
+
+    def __contains__(self, name):
+        return name in self._entries
+
+    def list(self):
+        return sorted(self._entries)

@@ -1,0 +1,118 @@
+"""Device context.
+
+Replaces the reference's ``python/mxnet/context.py`` (``Context``,
+``mx.cpu()``/``mx.gpu()``, thread-local default).  The TPU build adds
+``mx.tpu()`` as the accelerator context — the north-star API from
+BASELINE.json — and maps a context to a concrete ``jax.Device``.
+
+Unlike the reference (where a context selects a CUDA device and a worker
+thread pool, ``src/engine/threaded_engine_perdevice.cc``), here a context
+selects a JAX device for ``jax.device_put`` / compilation targets; XLA owns
+streams and async dispatch.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context"]
+
+
+class Context:
+    """Device context, API-compatible with the reference ``Context``
+    (``python/mxnet/context.py:23``): ``devtype2mask``-style device types,
+    equality, ``with ctx:`` default scoping."""
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 4: "gpu"}
+    devstr2type = {"cpu": 1, "tpu": 2, "cpu_pinned": 3, "gpu": 4}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- JAX mapping ---------------------------------------------------
+    @property
+    def jax_device(self):
+        """The concrete ``jax.Device`` this context denotes."""
+        import jax
+
+        kind = self.device_type
+        if kind in ("cpu", "cpu_pinned"):
+            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+        else:
+            # tpu (and gpu, aliased to the accelerator) → default platform
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+def _has_platform(name):
+    import jax
+
+    try:
+        return bool(jax.devices(name))
+    except RuntimeError:
+        return False
+
+
+def cpu(device_id=0):
+    """A CPU context (reference ``mx.cpu()``)."""
+    return Context("cpu", device_id)
+
+
+def tpu(device_id=0):
+    """A TPU context — the accelerator context of this framework
+    (the ``mx.tpu()`` from the north star in BASELINE.json)."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: reference scripts that say ``mx.gpu(i)`` get the
+    accelerator (TPU) so `--gpus` scripts run unmodified."""
+    return Context("tpu", device_id)
+
+
+def current_context():
+    """The thread-local default context (reference ``current_context()``)."""
+    ctx = getattr(Context._default_ctx, "value", None)
+    if ctx is None:
+        ctx = Context("tpu", 0) if _accelerator_present() else Context("cpu", 0)
+        Context._default_ctx.value = ctx
+    return ctx
+
+
+def _accelerator_present():
+    import jax
+
+    return jax.default_backend() not in ("cpu",)

@@ -1,0 +1,324 @@
+"""Executor — a bound Symbol compiled to XLA.
+
+TPU-native replacement for the reference ``GraphExecutor``
+(``src/executor/graph_executor.cc``, Python ``python/mxnet/executor.py``).
+
+Where the reference runs nnvm passes (Gradient, PlanMemory, inplace
+detection, op-exec attach) and pushes one cached engine op per node
+(``InitCachedOps``, ``graph_executor.cc:1186``), this executor traces the
+whole symbol DAG into **one jitted XLA computation** per (is_train, shapes)
+— forward, and a fused forward+backward built with ``jax.vjp``.  XLA's
+buffer assignment and rematerialization replace PlanMemory and the
+``MXNET_BACKWARD_DO_MIRROR`` mirror pass; bulk-exec segments are moot since
+the whole graph is a single executable (SURVEY.md §7 item 5).
+
+The ``Forward``/``Backward`` split API is preserved: ``forward`` runs the
+forward executable; ``backward`` runs the fused executable seeded with head
+gradients and scatters into the grad arrays honoring ``grad_req``
+(write/add/null — reference ``kWriteTo/kAddTo/kNullOp``).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from .base import MXNetError
+from .ops import registry as _registry
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+def _trace_fn(sym, is_train):
+    """Build the pure function (args, aux, rng) -> (outputs, new_aux)."""
+    import jax
+
+    topo = sym._topo()
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    aux_set = set(aux_names)
+    out_refs = [(id(n), i) for (n, i) in sym._outputs]
+
+    # positions of aux-updating results: node -> list of (input var name)
+    def fn(args, aux, rng):
+        env = {}
+        new_aux = dict(aux)
+        rng_i = 0
+        for node in topo:
+            if node.is_variable:
+                if node.name in aux_set:
+                    env[(id(node), 0)] = aux[node.name]
+                else:
+                    env[(id(node), 0)] = args[node.name]
+                continue
+            ins = [env[(id(src), i)] for (src, i) in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op.uses_train_mode:
+                attrs["__is_train__"] = is_train
+            if node.op.needs_rng:
+                ins = [jax.random.fold_in(rng, rng_i)] + ins
+                rng_i += 1
+            res = node.op.compute(_registry.FrozenAttrs(attrs), *ins)
+            if not isinstance(res, tuple):
+                res = (res,)
+            n_out = node.num_outputs
+            for i in range(n_out):
+                env[(id(node), i)] = res[i]
+            # functional aux-state update (reference FMutateInputs)
+            for mi, upd in zip(node.op.mutable_inputs, res[n_out:]):
+                src, _ = node.inputs[mi]
+                if src.is_variable and src.name in aux_set:
+                    new_aux[src.name] = upd
+        outputs = tuple(env[ref] for ref in out_refs)
+        return outputs, new_aux
+
+    return fn, arg_names, aux_names
+
+
+class Executor:
+    """Executor returned by ``Symbol.bind``/``simple_bind``."""
+
+    def __init__(self, sym, ctx, arg_dict, grad_dict, grad_req, aux_dict):
+        import jax
+
+        self._symbol = sym
+        self._ctx = ctx
+        self.arg_dict = arg_dict          # OrderedDict name -> NDArray
+        self.grad_dict = grad_dict        # name -> NDArray (or None)
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req         # name -> str
+        self.outputs = []
+        self._monitor_callback = None
+
+        self._fwd_eval_fn, self._arg_names, self._aux_names = \
+            _trace_fn(sym, is_train=False)
+        self._fwd_train_fn, _, _ = _trace_fn(sym, is_train=True)
+
+        self._jit_eval = jax.jit(self._fwd_eval_fn)
+        self._jit_train = jax.jit(self._fwd_train_fn)
+
+        grad_args = [n for n in self._arg_names
+                     if grad_req.get(n, "null") != "null"]
+        self._grad_args = grad_args
+
+        def fwd_bwd(args, aux, rng, head_grads):
+            const_args = {n: v for n, v in args.items() if n not in grad_args}
+
+            def loss_fn(garg_vals):
+                full = dict(const_args)
+                full.update(garg_vals)
+                outs, new_aux = self._fwd_train_fn(full, aux, rng)
+                return outs, new_aux
+
+            gvals = {n: args[n] for n in grad_args}
+            (outs, new_aux), vjp = jax.vjp(loss_fn, gvals)
+            grads, = vjp((head_grads, jax.tree.map(
+                lambda x: jax.numpy.zeros_like(x), new_aux)))
+            return outs, new_aux, grads
+
+        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        self._last_run = None  # (args jax dict, aux jax dict, rng)
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return list(self.arg_dict.values())
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return list(self.aux_dict.values())
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        from .ndarray.ndarray import NDArray, array
+
+        import jax
+
+        dev = self._ctx.jax_device
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward input %r" % k)
+            tgt = self.arg_dict[k]
+            buf = v._data if isinstance(v, NDArray) else array(v)._data
+            if buf.device != dev:
+                buf = jax.device_put(buf, dev)
+            tgt._set_data(buf)
+        args = {n: a._data for n, a in self.arg_dict.items()}
+        aux = {n: a._data for n, a in self.aux_dict.items()}
+        rng = _random.next_key()
+        fn = self._jit_train if is_train else self._jit_eval
+        outs, new_aux = fn(args, aux, rng)
+        if is_train:
+            for n, v in new_aux.items():
+                self.aux_dict[n]._set_data(v)
+            self._last_run = (args, aux, rng)
+        from .ndarray.ndarray import NDArray as _ND
+
+        self.outputs = [_ND(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Compute gradients into ``grad_dict`` honoring grad_req.  Runs the
+        fused forward+backward executable (XLA dedups the forward work it
+        can reuse; the extra forward flops are traded for a single fused
+        program — the TPU-idiomatic form of the reference's cached
+        fwd+bwd graph)."""
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import NDArray
+
+        if self._last_run is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        args, aux, rng = self._last_run
+        # head gradients: default ones (loss heads use their own custom vjp)
+        out_shapes = [o._data for o in self.outputs]
+        if out_grads is None:
+            heads = tuple(jnp.ones_like(o) for o in out_shapes)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = tuple(
+                jnp.ones_like(o) if g is None else
+                (g._data if isinstance(g, NDArray) else jnp.asarray(g))
+                for o, g in zip(out_shapes, out_grads))
+        outs, new_aux, grads = self._jit_fwd_bwd(args, aux, rng, heads)
+        for n, g in grads.items():
+            tgt = self.grad_dict.get(n)
+            if tgt is None:
+                continue
+            if self._grad_req.get(n) == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        self.forward(is_train=True, **kwargs)
+        self.backward(out_grads)
+        return self.outputs
+
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %r" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    arr.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes, sharing parameter arrays (reference
+        ``Executor.reshape`` — used by BucketingModule/DataParallel)."""
+        from .ndarray.ndarray import zeros
+
+        new_shapes = {}
+        for n, arr in self.arg_dict.items():
+            new_shapes[n] = kwargs.get(n, arr.shape)
+        ex = Executor._simple_bind(
+            self._symbol, self._ctx,
+            "null" if not self.grad_dict else self._grad_req, new_shapes)
+        for n, arr in self.arg_dict.items():
+            if ex.arg_dict[n].shape == arr.shape:
+                ex.arg_dict[n] = arr
+        for n, arr in self.aux_dict.items():
+            ex.aux_dict[n] = arr
+        return ex
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    @property
+    def output_dict(self):
+        return OrderedDict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # ------------------------------------------------------------------
+    # binding constructors
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _simple_bind(sym, ctx, grad_req, shape_kwargs, shared_exec=None):
+        from .context import current_context
+        from .ndarray.ndarray import zeros
+        from .symbol.symbol import _infer_param_shapes
+
+        ctx = ctx or current_context()
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        shapes = _infer_param_shapes(sym, dict(shape_kwargs))
+        missing = [n for n in arg_names + aux_names if n not in shapes]
+        if missing:
+            raise MXNetError("simple_bind: could not infer shapes for %s"
+                             % missing)
+        if isinstance(grad_req, str):
+            # uniform req applies to parameters; data/label inputs (the
+            # shape kwargs) get no gradient, as in the reference simple_bind
+            grad_req = {n: grad_req for n in arg_names}
+            for n in shape_kwargs:
+                grad_req[n] = "null"
+        elif isinstance(grad_req, list):
+            grad_req = dict(zip(arg_names, grad_req))
+        else:
+            grad_req = dict(grad_req)
+            for n in shape_kwargs:
+                grad_req.setdefault(n, "null")
+
+        arg_dict = OrderedDict()
+        grad_dict = {}
+        for n in arg_names:
+            if shared_exec is not None and n in shared_exec.arg_dict and \
+                    shared_exec.arg_dict[n].shape == tuple(shapes[n]):
+                arg_dict[n] = shared_exec.arg_dict[n]
+                if shared_exec.grad_dict.get(n) is not None:
+                    grad_dict[n] = shared_exec.grad_dict[n]
+            else:
+                arg_dict[n] = zeros(shapes[n], ctx)
+            if grad_req.get(n, "write") != "null" and n not in grad_dict:
+                grad_dict[n] = zeros(shapes[n], ctx)
+        aux_dict = OrderedDict()
+        for n in aux_names:
+            if shared_exec is not None and n in shared_exec.aux_dict:
+                aux_dict[n] = shared_exec.aux_dict[n]
+            else:
+                aux_dict[n] = zeros(shapes[n], ctx)
+        return Executor(sym, ctx, arg_dict, grad_dict, grad_req, aux_dict)
+
+    @staticmethod
+    def _bind(sym, ctx, args, args_grad, grad_req, aux_states,
+              shared_exec=None):
+        from .context import current_context
+
+        ctx = ctx or current_context()
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = OrderedDict(zip(arg_names, args))
+        else:
+            arg_dict = OrderedDict((n, args[n]) for n in arg_names)
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, (list, tuple)):
+            grad_dict = {n: g for n, g in zip(arg_names, args_grad)
+                         if g is not None}
+        else:
+            grad_dict = dict(args_grad)
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, list):
+            grad_req = dict(zip(arg_names, grad_req))
+        if aux_states is None:
+            aux_dict = OrderedDict()
+        elif isinstance(aux_states, (list, tuple)):
+            aux_dict = OrderedDict(zip(aux_names, aux_states))
+        else:
+            aux_dict = OrderedDict((n, aux_states[n]) for n in aux_names)
+        return Executor(sym, ctx, arg_dict, grad_dict, grad_req, aux_dict)

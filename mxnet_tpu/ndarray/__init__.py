@@ -1,0 +1,116 @@
+"""The ``mx.nd`` namespace.
+
+Like the reference, every operator function here is **generated from the
+registry** at import time (reference: ``_init_ndarray_module`` builds one
+Python function per registered op via the C ABI op list,
+``python/mxnet/ndarray/op.py:174-209``).  ``mx.nd.relu``, ``mx.nd.dot``,
+``mx.nd.Convolution`` … all dispatch through
+:func:`mxnet_tpu.ndarray.ndarray.imperative_invoke`.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+import numpy as _np
+
+from ..ops import registry as _registry
+from .ndarray import (NDArray, imperative_invoke, array, empty, zeros, ones,
+                      full, arange, moveaxis, concat, save, load, waitall,
+                      onehot_encode)
+
+_INIT_OPS = {"_zeros", "zeros", "_ones", "ones", "_full", "full", "_arange",
+             "arange", "_eye", "eye"}  # handled by the creation helpers above
+_RESERVED = {"array", "empty", "save", "load", "concat", "moveaxis",
+             "waitall", "onehot_encode",
+             # creation helpers take (shape, ctx, dtype) signatures
+             "zeros", "ones", "full", "arange", "eye"}
+
+
+def _make_op_func(name, op):
+    def op_func(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)  # symbol-compat no-op
+        ctx = kwargs.pop("ctx", None)
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, _np.ndarray):
+                inputs.append(array(a, ctx))
+            elif isinstance(a, (list, tuple)) and not inputs:
+                inputs.append(array(a, ctx))
+            else:
+                raise TypeError(
+                    "%s: positional args must be NDArray, got %r" % (name, a))
+        # NDArray keyword arguments are tensor inputs in the reference call
+        # style (nd.FullyConnected(data=x, weight=w, ...)) — order them by
+        # the op's declared argument names, not into attrs
+        named = {k: v for k, v in kwargs.items()
+                 if isinstance(v, (NDArray, _np.ndarray))}
+        if named:
+            from ..ops.op_names import expected_inputs
+
+            for k in named:
+                kwargs.pop(k)
+            attrs_only = {k: v for k, v in kwargs.items()}
+            arg_names, aux_names = expected_inputs(name, attrs_only)
+            ordered = []
+            for an in list(arg_names) + list(aux_names):
+                if an in named:
+                    v = named.pop(an)
+                    ordered.append(v if isinstance(v, NDArray)
+                                   else array(v, ctx))
+                elif inputs:
+                    ordered.append(inputs.pop(0))
+            if named:
+                raise TypeError("%s: unexpected tensor kwargs %s"
+                                % (name, sorted(named)))
+            inputs = ordered + inputs
+        res = imperative_invoke(name, inputs, kwargs, out=out)
+        if ctx is not None and not inputs:
+            res = [r.as_in_context(ctx) for r in res]
+        return res[0] if len(res) == 1 else res
+
+    op_func.__name__ = name
+    op_func.__qualname__ = name
+    op_func.__doc__ = op.doc
+    return op_func
+
+
+def _init_module():
+    mod = _sys.modules[__name__]
+    for name in _registry.list_ops():
+        if name in _RESERVED:
+            continue
+        func = _make_op_func(name, _registry.get(name))
+        setattr(mod, name, func)
+
+
+_init_module()
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    return imperative_invoke("dot", [lhs, rhs], {
+        "transpose_a": transpose_a, "transpose_b": transpose_b})[0]
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False, **kwargs):
+    return imperative_invoke("SliceChannel", [data], {
+        "num_outputs": num_outputs, "axis": axis,
+        "squeeze_axis": squeeze_axis})
+
+
+def stack(*data, axis=0):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return imperative_invoke("stack", list(data), {"axis": axis})[0]
+
+
+def add_n(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return imperative_invoke("add_n", list(args), {})[0]
+
+
+elemwise_sum = add_n
+ElementWiseSum = add_n

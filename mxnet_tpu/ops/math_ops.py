@@ -1,0 +1,247 @@
+"""Elementwise unary/binary/scalar/broadcast/logic + reduce operators.
+
+Covers the reference's ``src/operator/tensor/elemwise_unary_op.cc``,
+``elemwise_binary_op*.cc``, ``elemwise_binary_scalar_op*.cc``,
+``broadcast_reduce_op*.cc`` and the ~80 ``mshadow_op.h`` scalar functors
+(SURVEY.md Appendix A).  Each op is a one-line pure JAX function: XLA fuses
+chains of these into single kernels, which replaces both mshadow expression
+templates and the reference's hand-registered CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "rint": jnp.rint,
+    "round": jnp.round, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log1p": jnp.log1p,
+    "log2": jnp.log2, "log10": jnp.log10,
+    "sqrt": jnp.sqrt, "cbrt": jnp.cbrt, "square": jnp.square,
+    "negative": jnp.negative, "sign": jnp.sign,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "rsqrt": lax.rsqrt, "rcbrt": lambda x: x ** (-1.0 / 3),
+    "reciprocal": jnp.reciprocal,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name, (lambda fn: lambda attrs, x: fn(x))(_fn))
+
+@register("_copy", aliases=("identity",))
+def _copy(attrs, x):
+    return x
+
+
+@register("BlockGrad", aliases=("stop_gradient", "block_grad"))
+def _block_grad(attrs, x):
+    """Reference ``BlockGrad`` (``src/operator/tensor/elemwise_unary_op.cc``):
+    identity forward, zero gradient — exactly ``lax.stop_gradient``."""
+    return lax.stop_gradient(x)
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def _make_loss(attrs, x):
+    """Reference ``MakeLoss`` (``src/operator/make_loss.cc``): marks a head
+    whose backward seeds grad_scale instead of a head gradient."""
+    scale = float(attrs.get("grad_scale", 1.0))
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    def fwd(v):
+        return v, v.shape
+
+    def bwd(shape, g):
+        return (jnp.full(shape, scale, dtype=g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+@register("Cast", aliases=("cast",))
+def _cast(attrs, x):
+    return x.astype(jnp.dtype(attrs["dtype"]))
+
+
+@register("clip")
+def _clip(attrs, x):
+    return jnp.clip(x, float(attrs["a_min"]), float(attrs["a_max"]))
+
+
+@register("smooth_l1")
+def _smooth_l1(attrs, x):
+    sigma = float(attrs.get("scalar", 1.0))
+    s2 = sigma * sigma
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
+                     jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# binary (elemwise_* and broadcast_* both map to jnp broadcasting ops — a
+# strict superset of the reference's same-shape elemwise requirement)
+# ---------------------------------------------------------------------------
+
+def _logic(fn):
+    return lambda a, b: fn(a, b).astype(jnp.result_type(a, b))
+
+
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum, "hypot": jnp.hypot,
+    "equal": _logic(jnp.equal), "not_equal": _logic(jnp.not_equal),
+    "greater": _logic(jnp.greater), "greater_equal": _logic(jnp.greater_equal),
+    "lesser": _logic(jnp.less), "lesser_equal": _logic(jnp.less_equal),
+    "logical_and": _logic(lambda a, b: (a != 0) & (b != 0)),
+    "logical_or": _logic(lambda a, b: (a != 0) | (b != 0)),
+    "logical_xor": _logic(lambda a, b: (a != 0) ^ (b != 0)),
+    "arctan2": jnp.arctan2,
+}
+
+for _name, _fn in _BINARY.items():
+    _compute = (lambda fn: lambda attrs, a, b: fn(a, b))(_fn)
+    register("elemwise_%s" % _name, _compute,
+             aliases=("_%s" % _name, "broadcast_%s" % _name))
+
+register("_grad_add", lambda attrs, a, b: a + b)
+register("_minus", lambda attrs, a, b: a - b, aliases=("elemwise_minus",))
+register("broadcast_minus", lambda attrs, a, b: a - b)
+register("broadcast_plus", lambda attrs, a, b: a + b)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum", "elemwise_sum"))
+def _add_n(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+# scalar variants (reference elemwise_binary_scalar_op*.cc)
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+
+for _name, _fn in _SCALAR.items():
+    register(_name, (lambda fn: lambda attrs, x: fn(x, float(attrs["scalar"])))(_fn))
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _reduce(fn):
+    def compute(attrs, x):
+        axis = _norm_axis(attrs.get("axis"), x.ndim, attrs.get("exclude", False))
+        return fn(x, axis=axis, keepdims=bool(attrs.get("keepdims", False)))
+    return compute
+
+
+for _name, _fn in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+                   ("nansum", jnp.nansum), ("nanprod", jnp.nanprod),
+                   ("max", jnp.max), ("min", jnp.min)]:
+    register(_name, _reduce(_fn), aliases=("%s_axis" % _name,))
+
+
+@register("norm")
+def _norm(attrs, x):
+    ord_ = int(attrs.get("ord", 2))
+    axis = _norm_axis(attrs.get("axis"), x.ndim)
+    keepdims = bool(attrs.get("keepdims", False))
+    if ord_ == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+def _arg_reduce(fn):
+    def compute(attrs, x):
+        axis = attrs.get("axis")
+        keepdims = bool(attrs.get("keepdims", False))
+        if axis is None:
+            out = fn(x.reshape(-1), axis=0)
+            return out.astype(jnp.float32)
+        out = fn(x, axis=int(axis))
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(jnp.float32)
+    return compute
+
+
+register("argmax", _arg_reduce(jnp.argmax))
+register("argmin", _arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel")
+def _argmax_channel(attrs, x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# broadcast structure ops
+# ---------------------------------------------------------------------------
+
+@register("broadcast_to")
+def _broadcast_to(attrs, x):
+    shape = tuple(int(s) if int(s) != 0 else x.shape[i]
+                  for i, s in enumerate(attrs["shape"]))
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(attrs, x):
+    axes = attrs["axis"]
+    sizes = attrs["size"]
+    if isinstance(axes, int):
+        axes, sizes = (axes,), (sizes,)
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[int(a)] = int(s)
+    return jnp.broadcast_to(x, tuple(shape))

@@ -1,0 +1,432 @@
+"""Tensor structure / indexing / linalg operators.
+
+Covers the reference's ``src/operator/tensor/matrix_op.cc`` (Reshape with
+special codes, transpose, slice family, repeat/tile/reverse/stack, dot,
+batch_dot, take/one_hot/pick), ``indexing_op.cc`` (Embedding, take),
+``ordering_op.cc`` (sort/argsort/topk), ``init_op.cc`` (_zeros/_ones/_arange)
+and ``la_op.cc`` linalg (SURVEY.md Appendix A).
+
+All matmuls go through ``lax.dot_general`` with ``preferred_element_type``
+so the MXU gets large fp32-accumulated contractions even for bf16 inputs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# reshape & friends
+# ---------------------------------------------------------------------------
+
+def _infer_reshape(shape, target):
+    """Implements the reference's Reshape special codes 0, -1, -2, -3, -4
+    (``src/operator/tensor/matrix_op.cc`` Reshape doc)."""
+    out, src_i, i = [], 0, 0
+    target = list(target)
+    while i < len(target):
+        t = int(target[i])
+        if t == 0:
+            out.append(shape[src_i]); src_i += 1
+        elif t == -1:
+            out.append(-1); src_i += 1
+        elif t == -2:
+            out.extend(shape[src_i:]); src_i = len(shape)
+        elif t == -3:
+            out.append(shape[src_i] * shape[src_i + 1]); src_i += 2
+        elif t == -4:
+            a, b = int(target[i + 1]), int(target[i + 2])
+            dim = shape[src_i]
+            if a == -1:
+                a = dim // b
+            if b == -1:
+                b = dim // a
+            out.extend([a, b]); src_i += 1; i += 2
+        else:
+            out.append(t); src_i += 1
+        i += 1
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(attrs, x):
+    shape = attrs["shape"]
+    if attrs.get("reverse", False):
+        rshape = _infer_reshape(x.shape[::-1], list(shape)[::-1])
+        return jnp.reshape(x, rshape[::-1])
+    return jnp.reshape(x, _infer_reshape(x.shape, shape))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(attrs, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(attrs, x):
+    axes = attrs.get("axes") or None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims")
+def _expand_dims(attrs, x):
+    return jnp.expand_dims(x, int(attrs["axis"]))
+
+
+@register("squeeze")
+def _squeeze(attrs, x):
+    axis = attrs.get("axis")
+    return jnp.squeeze(x, axis if axis is None else tuple(
+        a if isinstance(a, int) else int(a)
+        for a in (axis if isinstance(axis, (tuple, list)) else (axis,))))
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def _swapaxes(attrs, x):
+    return jnp.swapaxes(x, int(attrs["dim1"]), int(attrs["dim2"]))
+
+
+@register("slice", aliases=("crop",))
+def _slice(attrs, x):
+    begin, end = attrs["begin"], attrs["end"]
+    step = attrs.get("step") or (None,) * len(begin)
+    idx = tuple(
+        slice(None if b is None else int(b),
+              None if e is None else int(e),
+              None if s in (None, 0) else int(s))
+        for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis")
+def _slice_axis(attrs, x):
+    axis = int(attrs["axis"]) % x.ndim
+    begin = int(attrs["begin"])
+    end = attrs.get("end")
+    end = x.shape[axis] if end is None else int(end)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(attrs, x, like):
+    axes = attrs.get("axes") or tuple(range(like.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[int(a)] = slice(0, like.shape[int(a)])
+    return x[tuple(idx)]
+
+
+@register("repeat")
+def _repeat(attrs, x):
+    return jnp.repeat(x, int(attrs["repeats"]), axis=attrs.get("axis"))
+
+
+@register("tile")
+def _tile(attrs, x):
+    return jnp.tile(x, tuple(int(r) for r in attrs["reps"]))
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(attrs, x):
+    axis = attrs["axis"]
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, tuple(int(a) for a in axis))
+
+
+@register("stack")
+def _stack(attrs, *xs):
+    return jnp.stack(xs, axis=int(attrs.get("axis", 0)))
+
+
+@register("Concat", aliases=("concat", "concatenate"))
+def _concat(attrs, *xs):
+    return jnp.concatenate(xs, axis=int(attrs.get("dim", 1)))
+
+
+def _split_outputs(attrs):
+    return int(attrs["num_outputs"])
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_split_outputs)
+def _split(attrs, x):
+    num = int(attrs["num_outputs"])
+    axis = int(attrs.get("axis", 1))
+    parts = jnp.split(x, num, axis=axis)
+    if attrs.get("squeeze_axis", False):
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("Pad", aliases=("pad",))
+def _pad(attrs, x):
+    pw = attrs["pad_width"]
+    pairs = tuple((int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(len(pw) // 2))
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=float(attrs.get("constant_value", 0)))
+    return jnp.pad(x, pairs, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+@register("take")
+def _take(attrs, a, indices):
+    axis = int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode={"clip": "clip", "wrap": "wrap"}.get(mode, "clip"))
+
+
+@register("batch_take")
+def _batch_take(attrs, a, indices):
+    return a[jnp.arange(a.shape[0]), indices.astype(jnp.int32)]
+
+
+@register("Embedding")
+def _embedding(attrs, data, weight):
+    """Reference ``src/operator/tensor/indexing_op.cc`` Embedding: table
+    lookup.  ``jnp.take`` lowers to an XLA gather; the backward is a scatter
+    that XLA turns into efficient sorted-segment-sum on TPU."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("one_hot")
+def _one_hot(attrs, indices):
+    depth = int(attrs["depth"])
+    on = float(attrs.get("on_value", 1.0))
+    off = float(attrs.get("off_value", 0.0))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    hot = (indices.astype(jnp.int32)[..., None] ==
+           jnp.arange(depth, dtype=jnp.int32))
+    return jnp.where(hot, on, off).astype(dtype)
+
+
+@register("pick", aliases=("choose_element_0index",))
+def _pick(attrs, x, index):
+    axis = attrs.get("axis", 1)
+    axis = x.ndim - 1 if axis is None else int(axis)
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
+    if bool(attrs.get("keepdims", False)):
+        return picked
+    return jnp.squeeze(picked, axis=axis)
+
+
+@register("where")
+def _where(attrs, cond, a, b):
+    if cond.ndim < a.ndim:  # row-wise condition, reference where semantics
+        cond = cond.reshape(cond.shape + (1,) * (a.ndim - cond.ndim))
+    return jnp.where(cond != 0, a, b)
+
+
+@register("ones_like")
+def _ones_like(attrs, x):
+    return jnp.ones_like(x)
+
+
+@register("zeros_like")
+def _zeros_like(attrs, x):
+    return jnp.zeros_like(x)
+
+
+@register("_identity_with_attr_like_rhs")
+def _ident_like(attrs, lhs, rhs):
+    return lhs
+
+
+# ---------------------------------------------------------------------------
+# init ops (no tensor inputs)
+# ---------------------------------------------------------------------------
+
+@register("_zeros", aliases=("zeros",))
+def _zeros(attrs):
+    return jnp.zeros(tuple(attrs["shape"]), jnp.dtype(attrs.get("dtype", "float32")))
+
+
+@register("_ones", aliases=("ones",))
+def _ones(attrs):
+    return jnp.ones(tuple(attrs["shape"]), jnp.dtype(attrs.get("dtype", "float32")))
+
+
+@register("_full", aliases=("full",))
+def _full(attrs):
+    return jnp.full(tuple(attrs["shape"]), float(attrs["value"]),
+                    jnp.dtype(attrs.get("dtype", "float32")))
+
+
+@register("_arange", aliases=("arange",))
+def _arange(attrs):
+    start = float(attrs.get("start", 0))
+    stop = attrs.get("stop")
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    out = np.arange(start, stop if stop is None else float(stop), step)
+    if repeat > 1:
+        out = np.repeat(out, repeat)
+    return jnp.asarray(out, dtype)
+
+
+@register("_eye", aliases=("eye",))
+def _eye(attrs):
+    n = int(attrs["N"])
+    m = int(attrs.get("M", 0)) or n
+    return jnp.eye(n, m, int(attrs.get("k", 0)),
+                   dtype=jnp.dtype(attrs.get("dtype", "float32")))
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference ordering_op.cc) — static shapes keep XLA happy;
+# topk's k is an attr (static), so results are fixed-shape.
+# ---------------------------------------------------------------------------
+
+@register("sort")
+def _sort(attrs, x):
+    axis = attrs.get("axis", -1)
+    axis = x.ndim - 1 if axis is None else int(axis)
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis) if not attrs.get("is_ascend", True) else out
+
+
+@register("argsort")
+def _argsort(attrs, x):
+    axis = attrs.get("axis", -1)
+    axis = x.ndim - 1 if axis is None else int(axis)
+    out = jnp.argsort(x, axis=axis)
+    if not attrs.get("is_ascend", True):
+        out = jnp.flip(out, axis)
+    return out.astype(jnp.dtype(attrs.get("dtype", "float32")))
+
+
+def _topk_outputs(attrs):
+    return 2 if attrs.get("ret_typ", "indices") == "both" else 1
+
+
+@register("topk", num_outputs=_topk_outputs)
+def _topk(attrs, x):
+    axis = attrs.get("axis", -1)
+    axis = x.ndim - 1 if axis is None else int(axis)
+    k = int(attrs.get("k", 1))
+    ret = attrs.get("ret_typ", "indices")
+    largest = bool(attrs.get("is_ascend", False)) is False
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.float32)
+    if ret == "value":
+        return vals
+    if ret == "both":
+        return vals, idx
+    if ret == "mask":
+        raise MXNetError("topk ret_typ='mask' not supported yet")
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# linalg / dot — the MXU path
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def _dot(attrs, a, b):
+    ta, tb = bool(attrs.get("transpose_a", False)), bool(attrs.get("transpose_b", False))
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    am = a.T if ta else a
+    bm = b.T if tb else b
+    # collapse leading dims (reference dot treats >2D as matrix over
+    # flattened leading/trailing dims)
+    if am.ndim > 2:
+        am = am.reshape(-1, am.shape[-1])
+    if bm.ndim > 2:
+        bm = bm.reshape(bm.shape[0], -1)
+    return lax.dot_general(
+        am, bm, (((am.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.promote_types(a.dtype, jnp.float32)
+        if a.dtype == jnp.bfloat16 else None,
+    ).astype(a.dtype)
+
+
+@register("batch_dot")
+def _batch_dot(attrs, a, b):
+    ta, tb = bool(attrs.get("transpose_a", False)), bool(attrs.get("transpose_b", False))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm")
+def _linalg_gemm(attrs, a, b, c):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    ta, tb = bool(attrs.get("transpose_a", False)), bool(attrs.get("transpose_b", False))
+    am = jnp.swapaxes(a, -1, -2) if ta else a
+    bm = jnp.swapaxes(b, -1, -2) if tb else b
+    return alpha * jnp.matmul(am, bm) + beta * c
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    ta, tb = bool(attrs.get("transpose_a", False)), bool(attrs.get("transpose_b", False))
+    am = jnp.swapaxes(a, -1, -2) if ta else a
+    bm = jnp.swapaxes(b, -1, -2) if tb else b
+    return alpha * jnp.matmul(am, bm)
+
+
+@register("linalg_potrf")
+def _potrf(attrs, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri")
+def _potri(attrs, a):
+    # inverse from cholesky factor: (A A^T)^-1 given L
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = lax.linalg.triangular_solve(a, eye, lower=True, left_side=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_trmm")
+def _trmm(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    am = jnp.swapaxes(a, -1, -2) if transpose else a
+    return alpha * (jnp.matmul(b, am) if rightside else jnp.matmul(am, b))
+
+
+@register("linalg_trsm")
+def _trsm(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    return alpha * lax.linalg.triangular_solve(
+        a, b, left_side=not rightside, lower=True,
+        transpose_a=transpose)
+
+
+@register("linalg_sumlogdiag")
+def _sumlogdiag(attrs, a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("linalg_syrk")
+def _syrk(attrs, a):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))

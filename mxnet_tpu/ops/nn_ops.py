@@ -1,0 +1,641 @@
+"""Neural-network layer operators.
+
+Covers the reference's legacy layer ops (``MXNET_REGISTER_OP_PROPERTY`` —
+Convolution, FullyConnected, Pooling, BatchNorm, Activation, Dropout,
+SoftmaxOutput, LRN, LeakyReLU, UpSampling, InstanceNorm, L2Normalization,
+SequenceMask/Last/Reverse, … — SURVEY.md §2.1 "Operators — neural net").
+
+TPU-first notes:
+* Convolutions use ``lax.conv_general_dilated``; data stays in the MXNet
+  NCHW calling convention and XLA's TPU layout assignment picks the
+  physical layout — no hand transposes.
+* Losses with fused backwards in the reference (SoftmaxOutput, the
+  regression outputs) keep their exact gradient contract via
+  ``jax.custom_vjp``: backward emits ``(p - label) * grad_scale`` ignoring
+  head gradients, matching ``src/operator/softmax_output-inl.h`` /
+  ``regression_output-inl.h``.
+* Stateful normalization (BatchNorm moving stats) threads state functionally:
+  the op returns updated stats and the invoke layer rebinds the aux
+  NDArrays — replacing the reference's in-place aux mutation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _pair(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t if t else (1,) * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected — a plain MXU matmul
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", aliases=("fully_connected",))
+def _fully_connected(attrs, data, weight, *bias):
+    """Reference ``src/operator/fully_connected.cc``: Y = X W^T + b."""
+    if bool(attrs.get("flatten", True)) and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(
+        data, weight, (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    ).astype(data.dtype)
+    if bias:
+        out = out + bias[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution / Pooling
+# ---------------------------------------------------------------------------
+
+def _conv_dims(kernel_ndim):
+    # NCHW-family dimension numbers for 1/2/3 spatial dims
+    spec = {1: ("NCH", "OIH", "NCH"),
+            2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[kernel_ndim]
+    return lax.conv_dimension_numbers((0,) * (kernel_ndim + 2),
+                                      (0,) * (kernel_ndim + 2), spec)
+
+
+@register("Convolution", aliases=("conv", "Convolution_v1"))
+def _convolution(attrs, data, weight, *bias):
+    """Reference ``src/operator/convolution-inl.h``: grouped ND convolution,
+    NC+spatial layout, weight (O, I/g, *kernel)."""
+    kernel = _pair(attrs["kernel"], len(attrs["kernel"]))
+    nd = len(kernel)
+    stride = _pair(attrs.get("stride"), nd)
+    pad = _pair(attrs.get("pad", (0,) * nd), nd)
+    dilate = _pair(attrs.get("dilate"), nd)
+    groups = int(attrs.get("num_group", 1))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=tuple((p, p) for p in pad),
+        rhs_dilation=dilate,
+        dimension_numbers=_conv_dims(nd),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    ).astype(data.dtype)
+    if bias:
+        b = bias[0].reshape((1, -1) + (1,) * nd)
+        out = out + b
+    return out
+
+
+@register("Deconvolution")
+def _deconvolution(attrs, data, weight, *bias):
+    """Reference ``src/operator/deconvolution-inl.h``: transposed conv.
+    Implemented as the gradient-of-conv form via lhs dilation."""
+    kernel = _pair(attrs["kernel"], len(attrs["kernel"]))
+    nd = len(kernel)
+    stride = _pair(attrs.get("stride"), nd)
+    pad = _pair(attrs.get("pad", (0,) * nd), nd)
+    adj = _pair(attrs.get("adj", (0,) * nd), nd)
+    groups = int(attrs.get("num_group", 1))
+    # transposed conv = conv with lhs_dilation=stride, flipped spatial kernel,
+    # swapped I/O on the weight, padding k-1-p
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        o, i = weight.shape[0], weight.shape[1]
+        w = w.reshape((groups, o // groups, i) + w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape((groups * i, o // groups) + w.shape[3:])
+    padding = tuple((kernel[d] - 1 - pad[d], kernel[d] - 1 - pad[d] + adj[d])
+                    for d in range(nd))
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=stride,
+        dimension_numbers=_conv_dims(nd),
+        feature_group_count=groups,
+    ).astype(data.dtype)
+    if bias:
+        out = out + bias[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Pooling", aliases=("Pooling_v1",))
+def _pooling(attrs, data):
+    """Reference ``src/operator/pooling-inl.h``: max/avg/sum pooling with
+    global_pool and 'valid'/'full' conventions."""
+    pool_type = attrs.get("pool_type", "max")
+    nd = data.ndim - 2
+    if bool(attrs.get("global_pool", False)):
+        axes = tuple(range(2, 2 + nd))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = _pair(attrs["kernel"], len(attrs["kernel"]))
+    nd = len(kernel)
+    stride = _pair(attrs.get("stride"), nd)
+    pad = _pair(attrs.get("pad", (0,) * nd), nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    # 'full' (ceil) convention pads the high edge so partial windows count
+    # (reference pooling-inl.h pooling_convention)
+    extra = [0] * nd
+    if attrs.get("pooling_convention", "valid") == "full":
+        for d in range(nd):
+            size = data.shape[2 + d] + 2 * pad[d] - kernel[d]
+            rem = size % stride[d]
+            if rem:
+                extra[d] = stride[d] - rem
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, padding)
+    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                               window, strides, padding)
+    if pool_type == "sum":
+        return summed
+    if pool_type == "avg":
+        # count_include_pad=True matches the reference default
+        denom = 1
+        for k in kernel:
+            denom *= k
+        return summed / jnp.asarray(denom, data.dtype)
+    raise MXNetError("unknown pool_type %r" % pool_type)
+
+
+@register("UpSampling")
+def _upsampling(attrs, *inputs):
+    """Reference ``src/operator/upsampling.cc``: nearest / bilinear scale-up."""
+    scale = int(attrs["scale"])
+    sample_type = attrs.get("sample_type", "nearest")
+    data = inputs[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        return out
+    return jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+@register("Activation")
+def _activation(attrs, x):
+    act = attrs.get("act_type", "relu")
+    if act == "relu":
+        return jnp.maximum(x, 0)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act == "tanh":
+        return jnp.tanh(x)
+    if act == "softrelu":
+        return jax.nn.softplus(x)
+    if act == "softsign":
+        return jax.nn.soft_sign(x)
+    raise MXNetError("unknown act_type %r" % act)
+
+
+@register("LeakyReLU", needs_rng=True, uses_train_mode=True)
+def _leaky_relu(attrs, rng, x, *gamma):
+    act = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act == "elu":
+        return jnp.where(x > 0, x, slope * jnp.expm1(x))
+    if act == "prelu":
+        g = gamma[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, g * x)
+    if act == "rrelu":
+        lo, hi = float(attrs.get("lower_bound", 0.125)), float(attrs.get("upper_bound", 0.334))
+        if attrs.get("__is_train__", False):
+            s = jax.random.uniform(rng, x.shape, x.dtype, lo, hi)
+            return jnp.where(x > 0, x, s * x)
+        return jnp.where(x > 0, x, ((lo + hi) / 2) * x)
+    raise MXNetError("unknown LeakyReLU act_type %r" % act)
+
+
+@register("softmax")
+def _softmax(attrs, x):
+    t = float(attrs.get("temperature") or 1.0)
+    return jax.nn.softmax(x / t, axis=int(attrs.get("axis", -1)))
+
+
+@register("log_softmax")
+def _log_softmax(attrs, x):
+    t = float(attrs.get("temperature") or 1.0)
+    return jax.nn.log_softmax(x / t, axis=int(attrs.get("axis", -1)))
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(attrs, x):
+    if attrs.get("mode", "instance") == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# fused losses (custom gradient contract, like the reference)
+# ---------------------------------------------------------------------------
+
+def _fused_loss(forward_out, grad_fn):
+    """Build output whose vjp wrt inputs is grad_fn(...), ignoring head grads
+    — the reference's loss-layer contract (grad seeded by the op itself)."""
+    return forward_out, grad_fn
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def _softmax_output(attrs, data, label):
+    """Reference ``src/operator/softmax_output-inl.h``.  Forward = softmax;
+    backward(data) = (softmax - onehot(label)) * grad_scale, with
+    use_ignore/ignore_label and multi_output support; head grad ignored."""
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    ignore_label = float(attrs.get("ignore_label", -1))
+    use_ignore = bool(attrs.get("use_ignore", False))
+    multi_output = bool(attrs.get("multi_output", False))
+    normalization = attrs.get("normalization", "null")
+
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.softmax(d, axis=axis)
+
+    def fwd(d, l):
+        p = jax.nn.softmax(d, axis=axis)
+        return p, (p, l)
+
+    def bwd(res, g):
+        p, l = res
+        li = l.astype(jnp.int32)
+        if multi_output:
+            onehot = jax.nn.one_hot(li, p.shape[1], axis=1, dtype=p.dtype)
+        else:
+            onehot = jax.nn.one_hot(li, p.shape[-1], dtype=p.dtype)
+        grad = p - onehot
+        if use_ignore:
+            mask = (l != ignore_label).astype(p.dtype)
+            mask = jnp.expand_dims(mask, axis=1 if multi_output else -1)
+            grad = grad * mask
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum((l != ignore_label)), 1)
+            grad = grad / valid.astype(p.dtype)
+        grad = grad * scale
+        return grad, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+def _regression_output(transform, grad):
+    def compute(attrs, data, label):
+        grad_scale = float(attrs.get("grad_scale", 1.0))
+
+        @jax.custom_vjp
+        def f(d, l):
+            return transform(d)
+
+        def fwd(d, l):
+            return transform(d), (d, l)
+
+        def bwd(res, g):
+            d, l = res
+            num = 1
+            for s in d.shape[1:]:
+                num *= s
+            gd = grad(transform(d), l.reshape(d.shape)) * (grad_scale / num)
+            return gd, jnp.zeros_like(l)
+
+        f.defvjp(fwd, bwd)
+        return f(data, label)
+    return compute
+
+
+register("LinearRegressionOutput",
+         _regression_output(lambda d: d, lambda p, l: p - l))
+register("MAERegressionOutput",
+         _regression_output(lambda d: d, lambda p, l: jnp.sign(p - l)))
+register("LogisticRegressionOutput",
+         _regression_output(jax.nn.sigmoid, lambda p, l: p - l))
+
+
+@register("SVMOutput")
+def _svm_output(attrs, data, label):
+    margin = float(attrs.get("margin", 1.0))
+    reg = float(attrs.get("regularization_coefficient", 1.0))
+    use_linear = bool(attrs.get("use_linear", False))
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        li = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, d.shape[-1], dtype=d.dtype)
+        # hinge: for wrong classes, +1 if margin violated; correct class -1
+        score_correct = jnp.sum(d * onehot, axis=-1, keepdims=True)
+        if use_linear:
+            viol = ((d - score_correct + margin) > 0).astype(d.dtype) * (1 - onehot)
+            gd = reg * (viol - onehot * jnp.sum(viol, axis=-1, keepdims=True))
+        else:
+            m = jnp.maximum(0., d - score_correct + margin) * (1 - onehot)
+            gd = reg * 2 * (m - onehot * jnp.sum(m, axis=-1, keepdims=True))
+        return gd, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("softmax_cross_entropy")
+def _softmax_xent(attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    li = label.astype(jnp.int32)
+    return -jnp.sum(jnp.take_along_axis(logp, li[:, None], axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", aliases=("BatchNorm_v1",), uses_train_mode=True,
+          mutable_inputs=(3, 4))
+def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    """Reference ``src/operator/batch_norm-inl.h``.  Inputs: data, gamma,
+    beta, aux moving_mean, moving_var; returns (out, new_mean, new_var).
+    ``fix_gamma`` pins gamma to 1 (reference default!), axis=1 (channel)."""
+    eps = float(attrs.get("eps", 1e-3))
+    momentum = float(attrs.get("momentum", 0.9))
+    fix_gamma = bool(attrs.get("fix_gamma", True))
+    use_global = bool(attrs.get("use_global_stats", False))
+    axis = int(attrs.get("axis", 1))
+    is_train = bool(attrs.get("__is_train__", False)) and not use_global
+
+    reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+
+    if is_train:
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * lax.stop_gradient(mean)
+        new_var = momentum * moving_var + (1 - momentum) * lax.stop_gradient(var)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+
+    inv = lax.rsqrt(var + eps).reshape(bshape)
+    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
+    return out, new_mean, new_var
+
+
+@register("InstanceNorm")
+def _instance_norm(attrs, data, gamma, beta):
+    eps = float(attrs.get("eps", 1e-3))
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return ((data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape)
+            + beta.reshape(bshape))
+
+
+@register("LayerNorm")
+def _layer_norm(attrs, data, gamma, beta):
+    """Not in the 0.11 reference but required by the transformer model
+    family this framework adds; axis=-1."""
+    eps = float(attrs.get("eps", 1e-5))
+    axis = int(attrs.get("axis", -1))
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def _l2_normalization(attrs, data):
+    eps = float(attrs.get("eps", 1e-10))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN")
+def _lrn(attrs, data):
+    """Reference ``src/operator/lrn.cc`` cross-channel local response norm."""
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    knorm = float(attrs.get("knorm", 2.0))
+    nsize = int(attrs["nsize"])
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + alpha * windows / nsize, beta)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+@register("Dropout", needs_rng=True, uses_train_mode=True)
+def _dropout(attrs, rng, x):
+    """Reference ``src/operator/dropout-inl.h``: inverted dropout, scaled at
+    train time, identity at inference."""
+    p = float(attrs.get("p", 0.5))
+    mode = attrs.get("mode", "training")
+    is_train = bool(attrs.get("__is_train__", False))
+    if p <= 0 or (not is_train and mode != "always"):
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops
+# ---------------------------------------------------------------------------
+
+def _seq_len_mask(seq_len, maxlen, dtype):
+    return (jnp.arange(maxlen)[:, None] <
+            seq_len.astype(jnp.int32)[None, :]).astype(dtype)
+
+
+@register("SequenceMask")
+def _sequence_mask(attrs, data, *seq_len):
+    """Reference ``src/operator/sequence_mask.cc``: (T, B, ...) time-major."""
+    if not bool(attrs.get("use_sequence_length", False)) or not seq_len:
+        return data
+    value = float(attrs.get("value", 0.0))
+    mask = _seq_len_mask(seq_len[0], data.shape[0], data.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return data * mask + value * (1 - mask)
+
+
+@register("SequenceLast")
+def _sequence_last(attrs, data, *seq_len):
+    if bool(attrs.get("use_sequence_length", False)) and seq_len:
+        idx = seq_len[0].astype(jnp.int32) - 1
+        return data[idx, jnp.arange(data.shape[1])]
+    return data[-1]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(attrs, data, *seq_len):
+    if bool(attrs.get("use_sequence_length", False)) and seq_len:
+        T = data.shape[0]
+        sl = seq_len[0].astype(jnp.int32)
+        t = jnp.arange(T)[:, None]
+        idx = jnp.where(t < sl[None, :], sl[None, :] - 1 - t, t)
+        return jnp.take_along_axis(
+            data, idx.reshape(idx.shape + (1,) * (data.ndim - 2)), axis=0)
+    return jnp.flip(data, 0)
+
+
+# ---------------------------------------------------------------------------
+# spatial ops
+# ---------------------------------------------------------------------------
+
+@register("Crop")
+def _crop(attrs, *inputs):
+    data = inputs[0]
+    if len(inputs) == 2:
+        h, w = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        h, w = (int(v) for v in attrs["h_w"])
+    if bool(attrs.get("center_crop", False)):
+        y0 = (data.shape[2] - h) // 2
+        x0 = (data.shape[3] - w) // 2
+    else:
+        offset = attrs.get("offset", (0, 0))
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + h, x0:x0 + w]
+
+
+@register("GridGenerator")
+def _grid_generator(attrs, data):
+    """Reference ``src/operator/grid_generator.cc``: affine → sampling grid."""
+    h, w = (int(v) for v in attrs["target_shape"])
+    if attrs.get("transform_type", "affine") == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        grid = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+        out = jnp.einsum("nij,jk->nik", theta, grid)
+        return out.reshape(n, 2, h, w)
+    return data  # warp type passes flow through
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(attrs, data, grid):
+    """Reference ``src/operator/bilinear_sampler.cc``: sample data at grid
+    coords in [-1, 1] (x, y channels)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0; wx0 = 1 - wx1
+    wy1 = gy - y0; wy0 = 1 - wy1
+
+    def gather(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+        vals = data[jnp.arange(n)[:, None, None], :, yc, xc]  # (n,oh,ow,c)
+        return jnp.where(valid[..., None], vals, 0)
+
+    out = (gather(y0, x0) * (wy0 * wx0)[..., None]
+           + gather(y0, x1) * (wy0 * wx1)[..., None]
+           + gather(y1, x0) * (wy1 * wx0)[..., None]
+           + gather(y1, x1) * (wy1 * wx1)[..., None])
+    return jnp.moveaxis(out, -1, 1)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(attrs, data, loc):
+    h, w = (int(v) for v in attrs["target_shape"])
+    grid = _grid_generator(
+        {"target_shape": (h, w), "transform_type": "affine"}, loc)
+    return _bilinear_sampler({}, data, grid)
+
+
+@register("ROIPooling")
+def _roi_pooling(attrs, data, rois):
+    """Reference ``src/operator/roi_pooling.cc``: max-pool each ROI to a
+    fixed grid.  rois: (R, 5) = [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = (int(v) for v in attrs["pooled_size"])
+    scale = float(attrs["spatial_scale"])
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[b]  # (c, h, w)
+        ys = jnp.arange(h); xs = jnp.arange(w)
+
+        def cell(iy, ix):
+            cy0 = y1 + (iy * rh) // ph
+            cy1 = y1 + ((iy + 1) * rh + ph - 1) // ph
+            cx0 = x1 + (ix * rw) // pw
+            cx1 = x1 + ((ix + 1) * rw + pw - 1) // pw
+            m = ((ys[:, None] >= cy0) & (ys[:, None] < jnp.maximum(cy1, cy0 + 1)) &
+                 (xs[None, :] >= cx0) & (xs[None, :] < jnp.maximum(cx1, cx0 + 1)))
+            masked = jnp.where(m[None], img, -jnp.inf)
+            return jnp.max(masked, axis=(1, 2))
+
+        iy, ix = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(iy, ix)  # (ph, pw, c)
+        return jnp.moveaxis(cells, -1, 0)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+@register("IdentityAttachKLSparseReg")
+def _identity_kl(attrs, x):
+    return x
+
+
+@register("Custom")
+def _custom(attrs, *xs):
+    raise MXNetError(
+        "Custom ops execute via mxnet_tpu.operator.CustomOp (host callback), "
+        "not through the registry compute path")

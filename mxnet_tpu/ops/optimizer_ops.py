@@ -1,0 +1,124 @@
+"""Fused optimizer-update operators.
+
+Reference: ``src/operator/optimizer_op.cc`` (sgd_update, sgd_mom_update,
+mp_sgd_*, adam_update, rmsprop_update, rmspropalex_update, ftrl_update).
+In the reference these run through the engine like any op; here they are
+pure functions the compiled train step folds into one XLA program (the
+reference's aspiration — "on TPU these fold into the compiled train step",
+SURVEY.md Appendix A).
+
+Each returns the updated weight (and updated state tensors) — the invoke
+layer rebinds the NDArrays, and ``Optimizer.update`` / the fused Module
+train step call these directly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, attrs):
+    g = grad * float(attrs.get("rescale_grad", 1.0))
+    clip = float(attrs.get("clip_gradient", -1.0))
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+@register("sgd_update")
+def _sgd_update(attrs, weight, grad):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    g = _prep_grad(grad, attrs)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", mutable_inputs=(2,))
+def _sgd_mom_update(attrs, weight, grad, mom):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    momentum = float(attrs.get("momentum", 0.0))
+    g = _prep_grad(grad, attrs)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", mutable_inputs=(2,))
+def _mp_sgd_update(attrs, weight, grad, weight32):
+    """fp16 weights with fp32 master copy (reference mp_sgd_update)."""
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    g = _prep_grad(grad.astype(jnp.float32), attrs)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", mutable_inputs=(2, 3))
+def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    momentum = float(attrs.get("momentum", 0.0))
+    g = _prep_grad(grad.astype(jnp.float32), attrs)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", mutable_inputs=(2, 3))
+def _adam_update(attrs, weight, grad, mean, var):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    beta1 = float(attrs.get("beta1", 0.9))
+    beta2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, attrs) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", mutable_inputs=(2,))
+def _rmsprop_update(attrs, weight, grad, n):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    gamma1 = float(attrs.get("gamma1", 0.95))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, attrs) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + eps)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", mutable_inputs=(2, 3, 4))
+def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    gamma1 = float(attrs.get("gamma1", 0.95))
+    gamma2 = float(attrs.get("gamma2", 0.9))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = _prep_grad(grad, attrs) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_state
+    new_delta = (gamma2 * delta -
+                 lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps))
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+@register("ftrl_update", mutable_inputs=(2, 3))
+def _ftrl_update(attrs, weight, grad, z, n):
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    lamda1 = float(attrs.get("lamda1", 0.01))
+    beta = float(attrs.get("beta", 1.0))
+    g = _prep_grad(grad, attrs)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n

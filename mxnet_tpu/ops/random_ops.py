@@ -1,0 +1,137 @@
+"""Random samplers.
+
+Covers the reference's ``src/operator/random/sample_op.cc`` (global-param
+samplers), ``multisample_op.cc`` (per-row distribution params) and
+``sample_multinomial_op.cc`` (SURVEY.md Appendix A).
+
+Instead of the per-device PRNG resource (``ResourceRequest::kRandom``,
+``src/resource.cc``), every sampler is a pure function of an explicit
+``jax.random`` key supplied by the invoke layer from the global seed state in
+``mxnet_tpu.random`` — deterministic, replayable, and trace-safe under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape_dtype(attrs, default_dtype="float32"):
+    shape = tuple(attrs.get("shape", ()))
+    dtype = jnp.dtype(attrs.get("dtype") or default_dtype)
+    return shape, dtype
+
+
+@register("random_uniform", aliases=("_sample_uniform", "uniform"), needs_rng=True)
+def _uniform(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.uniform(rng, shape, dtype,
+                              float(attrs.get("low", 0.0)),
+                              float(attrs.get("high", 1.0)))
+
+
+@register("random_normal", aliases=("_sample_normal", "normal"), needs_rng=True)
+def _normal(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    return (float(attrs.get("loc", 0.0)) +
+            float(attrs.get("scale", 1.0)) * jax.random.normal(rng, shape, dtype))
+
+
+@register("random_gamma", aliases=("_sample_gamma",), needs_rng=True)
+def _gamma(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    return (jax.random.gamma(rng, float(attrs.get("alpha", 1.0)), shape, dtype)
+            * float(attrs.get("beta", 1.0)))
+
+
+@register("random_exponential", aliases=("_sample_exponential",), needs_rng=True)
+def _exponential(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.exponential(rng, shape, dtype) / float(attrs.get("lam", 1.0))
+
+
+@register("random_poisson", aliases=("_sample_poisson",), needs_rng=True)
+def _poisson(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.poisson(rng, float(attrs.get("lam", 1.0)), shape).astype(dtype)
+
+
+@register("random_negative_binomial", aliases=("_sample_negbinomial",), needs_rng=True)
+def _neg_binomial(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    k = float(attrs.get("k", 1))
+    p = float(attrs.get("p", 1.0))
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+
+@register("random_generalized_negative_binomial",
+          aliases=("_sample_gennegbinomial",), needs_rng=True)
+def _gen_neg_binomial(attrs, rng):
+    shape, dtype = _shape_dtype(attrs)
+    mu = float(attrs.get("mu", 1.0))
+    alpha = float(attrs.get("alpha", 1.0))
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, shape) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+
+# --- per-row-parameter samplers (reference multisample_op.cc) --------------
+
+def _msample(fn):
+    def compute(attrs, rng, *params):
+        shape = tuple(attrs.get("shape", ()))
+        out_shape = params[0].shape + shape
+        return fn(rng, out_shape, *params)
+    return compute
+
+
+def _bcast(p, out_shape):
+    return p.reshape(p.shape + (1,) * (len(out_shape) - p.ndim))
+
+
+register("sample_uniform", _msample(
+    lambda rng, s, low, high: jax.random.uniform(rng, s) *
+    (_bcast(high, s) - _bcast(low, s)) + _bcast(low, s)), needs_rng=True)
+register("sample_normal", _msample(
+    lambda rng, s, mu, sigma: _bcast(mu, s) +
+    _bcast(sigma, s) * jax.random.normal(rng, s)), needs_rng=True)
+register("sample_gamma", _msample(
+    lambda rng, s, alpha, beta: jax.random.gamma(rng, _bcast(alpha, s), s) *
+    _bcast(beta, s)), needs_rng=True)
+register("sample_exponential", _msample(
+    lambda rng, s, lam: jax.random.exponential(rng, s) / _bcast(lam, s)),
+    needs_rng=True)
+register("sample_poisson", _msample(
+    lambda rng, s, lam: jax.random.poisson(rng, _bcast(lam, s), s).astype(jnp.float32)),
+    needs_rng=True)
+
+
+@register("sample_multinomial", aliases=("_sample_multinomial",), needs_rng=True)
+def _multinomial(attrs, rng, data):
+    shape = attrs.get("shape", ())
+    n = 1
+    for s in (shape if isinstance(shape, (tuple, list)) else (shape,)):
+        n *= int(s) if s else 1
+    get_prob = bool(attrs.get("get_prob", False))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    idx = jax.random.categorical(rng, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    idx = jnp.moveaxis(idx, 0, -1)
+    if n == 1:
+        idx = idx[..., 0]
+    out = idx.astype(jnp.dtype(attrs.get("dtype", "int32")))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits),
+            idx[..., None] if n == 1 else idx, axis=-1)
+        return out, logp.reshape(out.shape)
+    return out
+
+
+@register("shuffle", aliases=("_shuffle",), needs_rng=True)
+def _shuffle(attrs, rng, data):
+    return jax.random.permutation(rng, data, axis=0)

@@ -1,0 +1,145 @@
+"""The operator registry — single source of truth for all ops.
+
+This is the TPU-native replacement for the reference's operator registries
+(legacy ``MXNET_REGISTER_OP_PROPERTY`` in ``include/mxnet/operator.h:126`` and
+nnvm ``NNVM_REGISTER_OP`` + ``FCompute`` in ``include/mxnet/op_attr_types.h:223``;
+see SURVEY.md §2.1).  As in the reference, every frontend surface is *generated*
+from this registry: ``mx.nd.<op>`` (imperative), ``mx.sym.<op>`` (symbolic),
+and Gluon layers call through the same entries.
+
+Design (TPU-first, not a port):
+
+* an op's ``compute`` is a **pure JAX function** ``compute(attrs, *inputs)``
+  returning a tuple of ``jax.Array``s.  There is no per-op CUDA kernel, no
+  mshadow expression template, and no shape-inference function to write:
+  XLA compiles the function per (shapes, dtypes, static attrs) and
+  ``jax.eval_shape`` provides shape/dtype inference for the Symbol frontend.
+* imperative invoke jit-compiles ``compute`` with ``attrs`` frozen as static
+  arguments and caches the executable — this is the analogue of the
+  per-op executable cache in the reference's ``MXImperativeInvoke`` path
+  (``src/c_api/c_api_ndarray.cc:548``), except the cache is XLA's.
+* gradients come from ``jax.vjp`` over the composed program rather than
+  per-op ``FGradient`` node rewrites.  Ops with special gradient semantics
+  (e.g. ``SoftmaxOutput``, whose backward is ``softmax - label`` regardless of
+  head gradients — reference ``src/operator/softmax_output-inl.h``) use
+  ``jax.custom_vjp`` inside their ``compute``.
+* ops that mutate state (BatchNorm moving stats; reference ``FMutateInputs``)
+  declare ``mutable_inputs``; their compute returns the updated values as
+  extra outputs and the invoke layer writes them back — functional state
+  threading instead of in-place mutation, which is what XLA wants.
+* ops that consume randomness declare ``needs_rng``; the invoke layer passes
+  a fresh ``jax.random`` key as the first input (replacing the reference's
+  per-device PRNG resource, ``ResourceRequest::kRandom``, ``src/resource.cc``).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get", "list_ops", "invoke", "FrozenAttrs"]
+
+_OP_REGISTRY = {}
+
+
+def _freeze(value):
+    """Make an attr value hashable for the jit cache key."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    return value
+
+
+class FrozenAttrs(dict):
+    """Hashable attr dict passed as a jit static argument."""
+
+    def __hash__(self):
+        return hash(_freeze(self))
+
+    def __setitem__(self, key, value):  # pragma: no cover - guard
+        raise TypeError("FrozenAttrs is immutable")
+
+
+class OpDef:
+    """One registered operator."""
+
+    def __init__(self, name, compute, num_outputs=1, needs_rng=False,
+                 mutable_inputs=(), uses_train_mode=False, aliases=(),
+                 doc=None):
+        self.name = name
+        self.compute = compute
+        # int, or callable(attrs)->int for attr-dependent output counts
+        # (e.g. SliceChannel / split, reference src/operator/slice_channel.cc)
+        self.num_outputs = num_outputs
+        self.needs_rng = needs_rng
+        self.mutable_inputs = tuple(mutable_inputs)
+        self.uses_train_mode = uses_train_mode
+        self.aliases = tuple(aliases)
+        self.doc = doc or (compute.__doc__ or "")
+
+    def count_outputs(self, attrs):
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    # -- executable cache --------------------------------------------------
+    @functools.lru_cache(maxsize=None)
+    def _jitted(self, frozen_attrs):
+        import jax
+
+        def fn(*inputs):
+            out = self.compute(frozen_attrs, *inputs)
+            return out if isinstance(out, tuple) else (out,)
+
+        return jax.jit(fn)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name, compute=None, **kwargs):
+    """Register an op.  Usable as a decorator::
+
+        @register("relu")
+        def _(attrs, x):
+            return jnp.maximum(x, 0)
+    """
+    def _do(fn):
+        op = OpDef(name, fn, **kwargs)
+        _OP_REGISTRY[name] = op
+        for alias in op.aliases:
+            _OP_REGISTRY[alias] = op
+        return fn
+
+    if compute is not None:
+        return _do(compute)
+    return _do
+
+
+def get(name):
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator %r is not registered" % (name,)) from None
+
+
+def exists(name):
+    return name in _OP_REGISTRY
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def invoke(op, inputs, attrs):
+    """Run an op's jitted compute on raw jax arrays.
+
+    ``inputs`` are ``jax.Array``s (rng key already prepended when the op
+    declares ``needs_rng``).  Returns a tuple of arrays:
+    ``(*outputs, *updated_mutable_values)``.
+    """
+    if not isinstance(op, OpDef):
+        op = get(op)
+    frozen = attrs if isinstance(attrs, FrozenAttrs) else FrozenAttrs(attrs)
+    return op._jitted(frozen)(*inputs)

@@ -1,0 +1,48 @@
+"""Global random state.
+
+Replaces the reference's per-device PRNG resource
+(``ResourceManagerImpl``/``ResourceRequest::kRandom``, ``src/resource.cc``;
+Python ``mxnet/random.py`` ``mx.random.seed``).  A single counter-split
+``jax.random`` key chain provides deterministic, replayable streams: every
+consumer takes a fresh key via :func:`next_key`, and the autograd tape
+records the key it used so backward replay is bit-identical.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.seed_value = _DEFAULT_SEED
+    return _state
+
+
+def seed(seed_state):
+    """Seed all random generators (reference ``mx.random.seed``)."""
+    import jax
+
+    st = _get()
+    st.key = jax.random.PRNGKey(int(seed_state))
+    st.seed_value = int(seed_state)
+
+
+def current_seed():
+    return _get().seed_value
+
+
+def next_key():
+    """Split one fresh PRNG key off the global chain."""
+    import jax
+
+    st = _get()
+    st.key, sub = jax.random.split(st.key)
+    return sub

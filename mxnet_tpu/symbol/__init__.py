@@ -1,0 +1,66 @@
+"""The ``mx.sym`` namespace — generated from the op registry, like the
+reference's ``_init_symbol_module`` (``python/mxnet/symbol/op.py``)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _registry
+from .symbol import (Symbol, Variable, var, Group, load, load_json, _apply)
+
+_RESERVED = {"var", "load"}
+
+
+def _make_sym_func(name):
+    def sym_func(*args, **kwargs):
+        node_name = kwargs.pop("name", None)
+        attrs = {}
+        sym_inputs = list(args)
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                # named symbol inputs (data=..., weight=...) — order by the
+                # op's declared argument names
+                attrs.setdefault("__named__", {})[k] = v
+            else:
+                attrs[k] = v
+        named = attrs.pop("__named__", {})
+        if named:
+            from ..ops.op_names import expected_inputs
+
+            arg_names, aux_names = expected_inputs(name, attrs)
+            ordered = []
+            for an in list(arg_names) + list(aux_names):
+                if an in named:
+                    ordered.append(named.pop(an))
+                elif sym_inputs:
+                    ordered.append(sym_inputs.pop(0))
+                else:
+                    break
+            sym_inputs = ordered + sym_inputs + list(named.values())
+        return _apply(name, sym_inputs, attrs, name=node_name)
+
+    sym_func.__name__ = name
+    return sym_func
+
+
+def _init_module():
+    mod = _sys.modules[__name__]
+    for name in _registry.list_ops():
+        if name in _RESERVED:
+            continue
+        setattr(mod, name, _make_sym_func(name))
+
+
+_init_module()
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _apply("_zeros", [], {"shape": tuple(shape), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _apply("_ones", [], {"shape": tuple(shape), "dtype": dtype})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
+    return _apply("_arange", [], {"start": start, "stop": stop, "step": step,
+                                  "repeat": repeat, "dtype": dtype})

@@ -1,0 +1,500 @@
+"""Symbol — the declarative graph frontend.
+
+TPU-native replacement for the reference's nnvm ``Symbol``
+(``python/mxnet/symbol/symbol.py`` over ``nnvm::Symbol`` composition,
+SURVEY.md §2.1 "nnvm").  A Symbol is a lightweight DAG of op applications
+over named variables; ``bind``/``simple_bind`` lower the whole graph —
+forward *and* backward — into a single jitted XLA computation
+(:mod:`mxnet_tpu.executor`), which is the design stance of SURVEY.md §7
+item 5: nnvm passes (PlanMemory, inplace, DetectInplaceAddTo) are replaced
+by XLA's buffer assignment and fusion; the Gradient pass is replaced by
+``jax.vjp`` over the traced program.
+
+JSON save/load keeps the reference's checkpoint graph format
+(``nodes``/``arg_nodes``/``heads`` — ``nnvm::pass::SaveJSON``) so
+``prefix-symbol.json`` files round-trip.
+"""
+from __future__ import annotations
+
+import json
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from ..ops.op_names import expected_inputs
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_name_counter = {}
+
+
+def _auto_name(prefix):
+    idx = _name_counter.get(prefix, 0)
+    _name_counter[prefix] = idx + 1
+    return "%s%d" % (prefix, idx)
+
+
+class _Node:
+    """One graph node: an op application, or a variable (op is None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "aux_slots")
+
+    def __init__(self, op, name, attrs, inputs, aux_slots=()):
+        self.op = op                      # OpDef or None for variables
+        self.name = name
+        self.attrs = dict(attrs)
+        self.inputs = inputs              # list[(Node, out_idx)]
+        self.aux_slots = tuple(aux_slots)  # input positions that are aux vars
+        if op is None:
+            self.num_outputs = 1
+        else:
+            self.num_outputs = op.count_outputs(_registry.FrozenAttrs(self.attrs))
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+
+class Symbol:
+    """A (multi-)output slice of a graph. Composable like the reference."""
+
+    def __init__(self, outputs):
+        # outputs: list[(Node, out_idx)]
+        self._outputs = list(outputs)
+
+    # -- composition --------------------------------------------------------
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.num_outputs == 1:
+                out.append(node.name + "_output" if not node.is_variable
+                           else node.name)
+            else:
+                out.append("%s_output%d" % (node.name, idx))
+        return out
+
+    def _topo(self):
+        """Topological order of all nodes reachable from the outputs."""
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (inp, _) in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _aux_node_ids(self):
+        """ids of variable nodes consumed through an aux slot (one pass)."""
+        aux = set()
+        for n in self._topo():
+            for pos, (src, _) in enumerate(n.inputs):
+                if pos in n.aux_slots and src.is_variable:
+                    aux.add(id(src))
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_node_ids()
+        return [n.name for n in self._topo()
+                if n.is_variable and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_node_ids()
+        return [n.name for n in self._topo()
+                if n.is_variable and id(n) in aux]
+
+    def get_internals(self):
+        """All node outputs as one group (reference
+        ``Symbol.get_internals``)."""
+        outs = []
+        for node in self._topo():
+            for i in range(node.num_outputs):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def attr_dict(self):
+        return {n.name: {k: str(v) for k, v in n.attrs.items()}
+                for n in self._topo() if n.attrs}
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, _, aux_shapes = self._infer(kwargs, key="shape")
+        out_shapes = self._infer_outputs(kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **kwargs):
+        # dtype inference: trace with given dtypes (default float32)
+        arg_names = self.list_arguments()
+        return ([kwargs.get(n, "float32") for n in arg_names],
+                ["float32"] * len(self._outputs),
+                ["float32"] * len(self.list_auxiliary_states()))
+
+    def _infer(self, shape_kwargs, key="shape"):
+        """Infer every argument/aux shape from the given input shapes by
+        abstract evaluation (jax.eval_shape replaces the reference's
+        InferShape pass, graph_executor.cc:565)."""
+        import numpy as np
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = dict(shape_kwargs)
+        # variables whose shape must be derived: walk graph, evaluating ops
+        # abstractly where all input shapes known; parameter shapes come
+        # from op-specific inference below.
+        shapes = _infer_param_shapes(self, known)
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        return arg_shapes, None, aux_shapes
+
+    def _infer_outputs(self, shape_kwargs):
+        import jax
+        import numpy as np
+
+        shapes = _infer_param_shapes(self, dict(shape_kwargs))
+
+        class _Spec:
+            def __init__(self, shape):
+                self.shape = tuple(shape)
+                self.dtype = np.float32
+
+        def trace():
+            env = {}
+            out = []
+            for node in self._topo():
+                if node.is_variable:
+                    env[(id(node), 0)] = jax.numpy.zeros(
+                        shapes[node.name], "float32")
+                else:
+                    ins = [env[(id(n), i)] for (n, i) in node.inputs]
+                    attrs = dict(node.attrs)
+                    if node.op.uses_train_mode:
+                        attrs["__is_train__"] = False
+                    if node.op.needs_rng:
+                        ins = [jax.random.PRNGKey(0)] + ins
+                    res = node.op.compute(_registry.FrozenAttrs(attrs), *ins)
+                    if not isinstance(res, tuple):
+                        res = (res,)
+                    for i, r in enumerate(res):
+                        env[(id(node), i)] = r
+            return tuple(env[(id(n), i)] for (n, i) in self._outputs)
+
+        out_spec = jax.eval_shape(trace)
+        return [tuple(int(d) for d in s.shape) for s in out_spec]
+
+    # -- binding ------------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor._simple_bind(self, ctx, grad_req, kwargs,
+                                     shared_exec=shared_exec)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+
+        return Executor._bind(self, ctx, args, args_grad, grad_req,
+                              aux_states, shared_exec=shared_exec)
+
+    # -- evaluation convenience --------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        shapes = {k: v.shape for k, v in kwargs.items()}
+        ex = self.simple_bind(ctx, grad_req="null", **shapes)
+        return ex.forward(is_train=False, **kwargs)
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        """Reference-compatible graph JSON (nodes/arg_nodes/heads)."""
+        nodes_list = self._topo()
+        node_idx = {id(n): i for i, n in enumerate(nodes_list)}
+        nodes_json = []
+        for n in nodes_list:
+            nodes_json.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in n.attrs.items()},
+                "inputs": [[node_idx[id(src)], i, 0] for (src, i) in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(nodes_list) if n.is_variable]
+        heads = [[node_idx[id(n)], i, 0] for (n, i) in self._outputs]
+        return json.dumps({"nodes": nodes_json, "arg_nodes": arg_nodes,
+                           "heads": heads,
+                           "attrs": {"mxnet_tpu_version": "0.1.0"}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operators ----------------------------------------------------------
+    def _binary(self, other, op, scalar_op, rop=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if rop else (self, other)
+            return _apply(op, [a, b], {})
+        return _apply(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, o): return self._binary(o, "elemwise_add", "_plus_scalar")
+    def __radd__(self, o): return self._binary(o, "elemwise_add", "_plus_scalar")
+    def __sub__(self, o): return self._binary(o, "elemwise_sub", "_minus_scalar")
+    def __rsub__(self, o): return self._binary(o, "elemwise_sub", "_rminus_scalar", rop=True)
+    def __mul__(self, o): return self._binary(o, "elemwise_mul", "_mul_scalar")
+    def __rmul__(self, o): return self._binary(o, "elemwise_mul", "_mul_scalar")
+    def __truediv__(self, o): return self._binary(o, "elemwise_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binary(o, "elemwise_div", "_rdiv_scalar", rop=True)
+    def __pow__(self, o): return self._binary(o, "elemwise_power", "_power_scalar")
+    def __neg__(self): return _apply("negative", [self], {})
+
+    def __getattr__(self, name):
+        if name.startswith("_") or not _registry.exists(name):
+            raise AttributeError(name)
+
+        def method(*args, **kw):
+            return _apply(name, [self] + [a for a in args
+                                          if isinstance(a, Symbol)], kw)
+        return method
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or self.list_outputs())
+
+
+def _infer_param_shapes(sym, known):
+    """Forward shape propagation with op-specific parameter inference —
+    the equivalent of the reference's InferShape attr pass: given data
+    shapes, derive weight/bias/aux shapes for layer ops."""
+    env = {}     # (node id, out idx) -> shape
+    shapes = {}  # var name -> shape
+
+    for node in sym._topo():
+        if node.is_variable:
+            if node.name in known and known[node.name] is not None:
+                shapes[node.name] = tuple(known[node.name])
+            continue
+        # try to fill parameter-variable input shapes from op semantics
+        _fill_param_shapes(node, env, shapes)
+        in_shapes = []
+        ok = True
+        for (src, i) in node.inputs:
+            if src.is_variable:
+                s = shapes.get(src.name)
+            else:
+                s = env.get((id(src), i))
+            if s is None:
+                ok = False
+                break
+            in_shapes.append(s)
+        if not ok:
+            raise MXNetError(
+                "infer_shape: cannot infer inputs of node %s" % node.name)
+        out_shapes = _abstract_eval(node, in_shapes)
+        for i, s in enumerate(out_shapes):
+            env[(id(node), i)] = s
+    return shapes
+
+
+def _abstract_eval(node, in_shapes):
+    import jax
+    import numpy as np
+
+    attrs = dict(node.attrs)
+    if node.op.uses_train_mode:
+        attrs["__is_train__"] = False
+
+    def fn(*xs):
+        ins = list(xs)
+        if node.op.needs_rng:
+            ins = [jax.random.PRNGKey(0)] + ins
+        res = node.op.compute(_registry.FrozenAttrs(attrs), *ins)
+        return res if isinstance(res, tuple) else (res,)
+
+    specs = [jax.ShapeDtypeStruct(tuple(s), np.float32) for s in in_shapes]
+    out = jax.eval_shape(fn, *specs)
+    return [tuple(int(d) for d in o.shape) for o in out]
+
+
+def _fill_param_shapes(node, env, shapes):
+    """Derive weight/bias/gamma/... shapes from the data shape for the
+    common layer ops (the part of reference per-op InferShape that runs
+    'backward' from data to params)."""
+    def in_shape(pos):
+        src, i = node.inputs[pos]
+        if src.is_variable:
+            return shapes.get(src.name)
+        return env.get((id(src), i))
+
+    def set_var(pos, shape):
+        src, _ = node.inputs[pos]
+        if src.is_variable and src.name not in shapes:
+            shapes[src.name] = tuple(int(d) for d in shape)
+
+    op = node.op.name
+    a = node.attrs
+    data = in_shape(0)
+    if data is None:
+        return
+    if op == "FullyConnected":
+        nh = int(a["num_hidden"])
+        flat = 1
+        for d in (data[1:] if a.get("flatten", True) else data[-1:]):
+            flat *= d
+        set_var(1, (nh, flat))
+        if len(node.inputs) > 2:
+            set_var(2, (nh,))
+    elif op in ("Convolution", "Convolution_v1"):
+        nf = int(a["num_filter"])
+        ng = int(a.get("num_group", 1))
+        kernel = tuple(int(k) for k in a["kernel"])
+        set_var(1, (nf, data[1] // ng) + kernel)
+        if len(node.inputs) > 2:
+            set_var(2, (nf,))
+    elif op == "Deconvolution":
+        nf = int(a["num_filter"])
+        ng = int(a.get("num_group", 1))
+        kernel = tuple(int(k) for k in a["kernel"])
+        set_var(1, (data[1], nf // ng) + kernel)
+        if len(node.inputs) > 2:
+            set_var(2, (nf,))
+    elif op in ("BatchNorm", "BatchNorm_v1"):
+        c = data[int(a.get("axis", 1))]
+        for pos in (1, 2, 3, 4):
+            if pos < len(node.inputs):
+                set_var(pos, (c,))
+    elif op in ("InstanceNorm",):
+        c = data[1]
+        set_var(1, (c,)); set_var(2, (c,))
+    elif op == "LayerNorm":
+        c = data[int(a.get("axis", -1))]
+        set_var(1, (c,)); set_var(2, (c,))
+    elif op == "Embedding":
+        set_var(1, (int(a["input_dim"]), int(a["output_dim"])))
+    elif op == "LeakyReLU" and a.get("act_type") == "prelu":
+        set_var(1, (data[1],))
+    elif op in ("SoftmaxOutput", "Softmax", "SVMOutput"):
+        set_var(1, data[:-1] if not a.get("multi_output") else
+                (data[0],) + tuple(data[2:]))
+    elif op in ("LinearRegressionOutput", "MAERegressionOutput",
+                "LogisticRegressionOutput"):
+        set_var(1, data)
+    elif op == "softmax_cross_entropy":
+        set_var(1, (data[0],))
+
+
+def _apply(op_name, input_syms, attrs, name=None):
+    """Compose an op over symbols (the reference's atomic-symbol
+    CreateAtomicSymbol + Compose C API path)."""
+    op = _registry.get(op_name)
+    attrs = dict(attrs)
+    name = name or attrs.pop("name", None) or \
+        _auto_name(op_name.lower().lstrip("_"))
+    attrs.pop("name", None)
+
+    arg_names, aux_names = expected_inputs(op_name, attrs)
+    inputs = []
+    for s in input_syms:
+        if len(s._outputs) != 1:
+            raise MXNetError("cannot compose multi-output symbol directly")
+        inputs.append(s._outputs[0])
+    # auto-create missing parameter/aux variables (reference behavior:
+    # conv = sym.Convolution(data) creates convolution0_weight, ...)
+    total_wanted = len(arg_names) + len(aux_names)
+    if len(inputs) < total_wanted and op_name in _PARAMETRIC_OPS:
+        for extra in list(arg_names)[len(inputs):] + list(aux_names):
+            vnode = _Node(None, "%s_%s" % (name, extra), {}, [])
+            inputs.append((vnode, 0))
+    aux_slots = tuple(range(len(arg_names),
+                            len(arg_names) + len(aux_names)))
+    node = _Node(op, name, attrs, inputs, aux_slots)
+    return Symbol([(node, i) for i in range(node.num_outputs)])
+
+
+_PARAMETRIC_OPS = {
+    "FullyConnected", "Convolution", "Convolution_v1", "Deconvolution",
+    "BatchNorm", "BatchNorm_v1", "Embedding", "InstanceNorm", "LayerNorm",
+    "SoftmaxOutput", "Softmax", "SVMOutput", "LinearRegressionOutput",
+    "MAERegressionOutput", "LogisticRegressionOutput",
+    "softmax_cross_entropy", "LeakyReLU",
+}
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference ``mx.sym.Variable``)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else \
+            init.__class__.__name__
+    node = _Node(None, name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (reference
+    ``mx.sym.Group``)."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for nj in data["nodes"]:
+        attrs = {}
+        for k, v in nj.get("attrs", {}).items():
+            try:
+                attrs[k] = json.loads(v)
+            except (ValueError, TypeError):
+                attrs[k] = v
+        if nj["op"] == "null":
+            node = _Node(None, nj["name"], attrs, [])
+        else:
+            op = _registry.get(nj["op"])
+            inputs = [(nodes[i], oi) for (i, oi, _) in nj["inputs"]]
+            arg_names, aux_names = expected_inputs(nj["op"], attrs)
+            aux_slots = tuple(range(len(arg_names),
+                                    len(arg_names) + len(aux_names))) \
+                if aux_names else ()
+            node = _Node(op, nj["name"], attrs, inputs, aux_slots)
+        nodes.append(node)
+    heads = [(nodes[i], oi) for (i, oi, _) in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

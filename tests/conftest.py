@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Mirrors the reference's test determinism fixture
+(``tests/python/unittest/common.py`` seeds numpy+mx) and runs the suite on
+a virtual 8-device CPU mesh so multi-chip sharding paths are exercised
+without TPU hardware (the driver's dryrun_multichip contract).
+"""
+import os
+
+# Force the CPU platform with 8 virtual devices (the launch env pins
+# JAX_PLATFORMS=axon for the TPU tunnel, so override — not setdefault).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+# tests compare against numpy float32 references, so use full-precision dots
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def seed_rngs():
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,136 @@
+"""NDArray basics — mirrors reference tests/python/unittest/test_ndarray.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.size == 4
+    np.testing.assert_allclose(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_helpers():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(nd.full((2,), 7).asnumpy(), [7, 7])
+    np.testing.assert_allclose(nd.arange(3).asnumpy(), [0, 1, 2])
+
+
+def test_elementwise_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 - a).asnumpy(), [1, 0, -1])
+    np.testing.assert_allclose((6 / a).asnumpy(), [6, 3, 2], rtol=1e-6)
+
+
+def test_inplace_ops_rebind():
+    a = nd.ones((3,))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), [2, 2, 2])
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_indexing_and_setitem():
+    a = nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3].asnumpy()[0], [4, 5, 6, 7])
+    a[1] = 0.0
+    assert a.asnumpy()[1].sum() == 0
+    a[:] = 5.0
+    assert (a.asnumpy() == 5).all()
+
+
+def test_dot():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(), np.dot(a.asnumpy(), b.asnumpy()))
+    np.testing.assert_allclose(
+        nd.dot(a, b, transpose_b=True).asnumpy(),
+        np.dot(a.asnumpy(), b.asnumpy().T))
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, 0, 2, 2)).shape == (2, 3, 2, 2)
+    assert nd.Reshape(a, shape=(-3, 0)).shape == (6, 4)
+
+
+def test_astype_and_dtype():
+    a = nd.array([1.5, 2.5])
+    assert a.dtype == np.float32
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+
+
+def test_copy_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert a.asnumpy().sum() == 4
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type in ("cpu",)
+
+
+def test_registry_method_dispatch():
+    a = nd.array([[1.0, -2.0], [3.0, -4.0]])
+    np.testing.assert_allclose(a.relu().asnumpy(), [[1, 0], [3, 0]])
+    np.testing.assert_allclose(a.sum(axis=1).asnumpy(), [-1, -1])
+    assert a.transpose().shape == (2, 2)
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.npz")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), 1)
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    bt = nd.broadcast_to(nd.ones((1, 3)), shape=(4, 3))
+    assert bt.shape == (4, 3)
+
+
+def test_take_onehot_pick():
+    w = nd.array(np.arange(12).reshape(4, 3).astype("float32"))
+    idx = nd.array([0, 2])
+    np.testing.assert_allclose(nd.take(w, idx).asnumpy(),
+                               [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(idx, depth=4)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(nd.pick(x, nd.array([1, 0])).asnumpy(), [2, 3])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    v = nd.topk(x, k=2, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2]])
+    s = nd.sort(x)
+    np.testing.assert_allclose(s.asnumpy(), [[1, 2, 3]])

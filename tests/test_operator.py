@@ -1,0 +1,323 @@
+"""Operator numeric tests vs numpy references.
+
+Mirrors the reference's tests/python/unittest/test_operator.py pattern:
+every op family checked against a numpy golden implementation, gradients
+checked against finite differences or closed forms (the reference uses
+check_numeric_gradient / check_symbolic_forward, test_utils.py:620,744).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype("float32")
+
+
+@pytest.mark.parametrize("name,npfn", [
+    ("exp", np.exp), ("log", np.log),
+    ("sqrt", np.sqrt), ("square", np.square),
+    ("abs", np.abs), ("sign", np.sign), ("floor", np.floor),
+    ("ceil", np.ceil), ("sin", np.sin), ("cos", np.cos),
+    ("tanh", np.tanh), ("arctan", np.arctan),
+])
+def test_unary_vs_numpy(name, npfn):
+    x = _rand(3, 4)
+    if name == "log":
+        x = np.abs(x) + 1.1
+    elif name == "sqrt":
+        x = np.abs(x)
+    out = getattr(nd, name)(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, npfn(x), rtol=3e-4, atol=1e-5)
+
+
+def test_activation_types():
+    x = _rand(2, 5)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.Activation(a, act_type="relu").asnumpy(),
+                               np.maximum(x, 0))
+    np.testing.assert_allclose(nd.Activation(a, act_type="sigmoid").asnumpy(),
+                               1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(nd.Activation(a, act_type="tanh").asnumpy(),
+                               np.tanh(x), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(nd.Activation(a, act_type="softrelu").asnumpy(),
+                               np.log1p(np.exp(x)), rtol=1e-4, atol=1e-6)
+
+
+def test_fully_connected():
+    x, w, b = _rand(4, 10), _rand(3, 10), _rand(3)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=3)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5)
+    # no_bias + flatten of 4D input
+    x4 = _rand(4, 2, 3, 5)
+    w2 = _rand(7, 30)
+    out2 = nd.FullyConnected(nd.array(x4), nd.array(w2), num_hidden=7,
+                             no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy(),
+                               x4.reshape(4, -1) @ w2.T, rtol=1e-4)
+
+
+def test_convolution_identity_kernel():
+    # 1x1 identity kernel leaves input unchanged
+    x = _rand(2, 3, 5, 5)
+    w = np.zeros((3, 3, 1, 1), "float32")
+    for i in range(3):
+        w[i, i, 0, 0] = 1.0
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.zeros((3,)),
+                         kernel=(1, 1), num_filter=3)
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-5)
+
+
+def test_convolution_vs_manual():
+    x = _rand(1, 1, 4, 4)
+    w = _rand(1, 1, 3, 3)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.zeros((1,)),
+                         kernel=(3, 3), num_filter=1).asnumpy()
+    ref = np.zeros((1, 1, 2, 2), "float32")
+    for i in range(2):
+        for j in range(2):
+            ref[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_convolution():
+    x = _rand(2, 4, 6, 6)
+    w = _rand(8, 2, 3, 3)  # num_group=2: each group sees 2 in-channels
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.zeros((8,)),
+                         kernel=(3, 3), num_filter=8, num_group=2)
+    assert out.shape == (2, 8, 4, 4)
+
+
+def test_pooling():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max").asnumpy()
+    np.testing.assert_allclose(mp[0, 0], [[5, 7], [13, 15]])
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="avg").asnumpy()
+    np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    gp = nd.Pooling(nd.array(x), global_pool=True, kernel=(1, 1),
+                    pool_type="max").asnumpy()
+    assert gp.reshape(()) == 15
+
+
+def test_batchnorm_train_vs_eval():
+    x = _rand(8, 4, 3, 3) * 5 + 2
+    gamma, beta = nd.ones((4,)), nd.zeros((4,))
+    mmean, mvar = nd.zeros((4,)), nd.ones((4,))
+    with mx.autograd.record():
+        out = nd.BatchNorm(nd.array(x), gamma, beta, mmean, mvar,
+                           fix_gamma=False, momentum=0.9)
+    o = out.asnumpy()
+    # per-channel normalized output has ~0 mean, ~1 std
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # moving stats updated toward batch stats
+    assert abs(mmean.asnumpy()).sum() > 0
+
+
+def test_dropout_modes():
+    x = nd.ones((50, 50))
+    assert (nd.Dropout(x, p=0.5).asnumpy() == 1).all()  # predict: identity
+    with mx.autograd.record():
+        y = nd.Dropout(x, p=0.5).asnumpy()
+    assert 0.3 < (y == 0).mean() < 0.7
+    kept = y[y != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-6)  # inverted scaling
+
+
+def test_softmax_and_losses():
+    x = _rand(4, 10)
+    sm = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lsm = nd.log_softmax(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(lsm, np.log(sm + 1e-12), rtol=1e-4, atol=1e-5)
+
+
+def test_linear_regression_output_grad():
+    data = nd.array(_rand(4, 3))
+    label = nd.array(_rand(4, 3))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = nd.LinearRegressionOutput(data, label)
+    out.backward()
+    np.testing.assert_allclose(
+        data.grad.asnumpy(),
+        (data.asnumpy() - label.asnumpy()) / 3, rtol=1e-5)
+
+
+def test_reductions():
+    x = _rand(3, 4, 5)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), x.sum(), rtol=1e-4)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-4)
+    np.testing.assert_allclose(nd.mean(a, axis=(0, 2)).asnumpy(),
+                               x.mean((0, 2)), rtol=1e-4)
+    np.testing.assert_allclose(nd.max(a, axis=2, keepdims=True).asnumpy(),
+                               x.max(2, keepdims=True))
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1, exclude=True).asnumpy(), x.sum((0, 2)), rtol=1e-4)
+    np.testing.assert_allclose(nd.norm(a).asnumpy(),
+                               np.sqrt((x ** 2).sum()), rtol=1e-4)
+
+
+def test_argmax_argmin():
+    x = _rand(3, 7)
+    np.testing.assert_allclose(nd.argmax(nd.array(x), axis=1).asnumpy(),
+                               x.argmax(1))
+    np.testing.assert_allclose(nd.argmin(nd.array(x), axis=0).asnumpy(),
+                               x.argmin(0))
+
+
+def test_embedding():
+    w = _rand(10, 4)
+    idx = np.array([1, 5, 9], "float32")
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 5, 9]])
+
+
+def test_embedding_grad_scatters():
+    w = nd.array(_rand(10, 4))
+    w.attach_grad()
+    idx = nd.array([1, 1, 3], dtype="int32")
+    with mx.autograd.record():
+        out = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+        loss = nd.sum(out)
+    loss.backward()
+    g = w.grad.asnumpy()
+    np.testing.assert_allclose(g[1], 2.0)  # row 1 hit twice
+    np.testing.assert_allclose(g[3], 1.0)
+    np.testing.assert_allclose(g[0], 0.0)
+
+
+def test_transpose_swapaxis_slice():
+    x = _rand(2, 3, 4)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.transpose(a, axes=(2, 0, 1)).asnumpy(),
+                               x.transpose(2, 0, 1))
+    np.testing.assert_allclose(nd.SwapAxis(a, dim1=0, dim2=2).asnumpy(),
+                               x.swapaxes(0, 2))
+    np.testing.assert_allclose(
+        nd.slice(a, begin=(0, 1, None), end=(None, 3, None)).asnumpy(),
+        x[:, 1:3, :])
+    np.testing.assert_allclose(
+        nd.slice_axis(a, axis=2, begin=1, end=3).asnumpy(), x[:, :, 1:3])
+
+
+def test_where_clip():
+    cond = nd.array([1.0, 0.0, 1.0])
+    a, b = nd.array([1.0, 2.0, 3.0]), nd.array([-1.0, -2.0, -3.0])
+    np.testing.assert_allclose(nd.where(cond, a, b).asnumpy(), [1, -2, 3])
+    np.testing.assert_allclose(
+        nd.clip(nd.array([-2.0, 0.5, 9.0]), a_min=0, a_max=1).asnumpy(),
+        [0, 0.5, 1])
+
+
+def test_batch_dot():
+    a, b = _rand(4, 2, 3), _rand(4, 3, 5)
+    out = nd.batch_dot(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, np.matmul(a, b), rtol=1e-5)
+
+
+def test_random_ops_statistics():
+    u = nd.random_uniform(low=2, high=4, shape=(10000,)).asnumpy()
+    assert 2.9 < u.mean() < 3.1 and u.min() >= 2 and u.max() <= 4
+    n = nd.random_normal(loc=1, scale=2, shape=(10000,)).asnumpy()
+    assert 0.9 < n.mean() < 1.1 and 1.9 < n.std() < 2.1
+
+
+def test_random_seed_reproducible():
+    mx.random.seed(42)
+    a = nd.random_uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random_uniform(shape=(5,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+
+
+def test_sequence_ops():
+    # (T=3, B=2)
+    x = np.arange(6, dtype="float32").reshape(3, 2)
+    sl = nd.array([2.0, 3.0])
+    m = nd.SequenceMask(nd.array(x), sl, use_sequence_length=True,
+                        value=-1.0).asnumpy()
+    assert m[2, 0] == -1 and m[2, 1] == 5
+    last = nd.SequenceLast(nd.array(x), sl, use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last, [x[1, 0], x[2, 1]])
+    rev = nd.SequenceReverse(nd.array(x), sl, use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(rev[:, 1], x[::-1, 1])
+    np.testing.assert_allclose(rev[:2, 0], x[:2, 0][::-1])
+
+
+def test_optimizer_ops():
+    # reference calling convention: updated weight written via out=weight
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    nd.sgd_update(w, g, lr=0.5, wd=0.0, out=w)
+    np.testing.assert_allclose(w.asnumpy(), [0.95, 1.95], rtol=1e-6)
+    # adam one step: weight moves, state tensors update in place
+    w2 = nd.array([1.0]); m = nd.zeros((1,)); v = nd.zeros((1,))
+    nd.adam_update(w2, nd.array([1.0]), m, v, lr=0.1, out=w2)
+    assert w2.asnumpy()[0] < 1.0
+    assert m.asnumpy()[0] != 0.0 and v.asnumpy()[0] != 0.0
+    # sgd with momentum accumulates in mom buffer
+    w3 = nd.array([1.0]); mom = nd.zeros((1,))
+    nd.sgd_mom_update(w3, nd.array([1.0]), mom, lr=0.1, momentum=0.9, out=w3)
+    np.testing.assert_allclose(mom.asnumpy(), [-0.1], rtol=1e-6)
+    np.testing.assert_allclose(w3.asnumpy(), [0.9], rtol=1e-6)
+
+
+def test_leakyrelu_variants():
+    x = nd.array([-1.0, 1.0])
+    np.testing.assert_allclose(
+        nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(), [-0.1, 1])
+    np.testing.assert_allclose(
+        nd.LeakyReLU(x, act_type="elu", slope=1.0).asnumpy(),
+        [np.expm1(-1), 1], rtol=1e-5)
+
+
+def test_lrn_shape():
+    x = nd.array(_rand(2, 8, 4, 4))
+    out = nd.LRN(x, nsize=5)
+    assert out.shape == (2, 8, 4, 4)
+
+
+def test_upsampling():
+    x = nd.array(_rand(1, 2, 3, 3))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 2, 6, 6)
+    np.testing.assert_allclose(out.asnumpy()[0, 0, 0, 0],
+                               x.asnumpy()[0, 0, 0, 0])
+
+
+def test_l2_normalization():
+    x = _rand(3, 5)
+    out = nd.L2Normalization(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(np.sqrt((out ** 2).sum(1)), 1, rtol=1e-5)
+
+
+def test_named_tensor_kwargs():
+    # review finding: reference call style nd.Op(data=..., weight=...)
+    x, w, b = _rand(4, 10), _rand(3, 10), _rand(3)
+    out = nd.FullyConnected(data=nd.array(x), weight=nd.array(w),
+                            bias=nd.array(b), num_hidden=3)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-4)
+
+
+def test_method_rejects_positional_scalars():
+    with pytest.raises(TypeError):
+        nd.ones((3,)).relu(0.5)
+    np.testing.assert_allclose(nd.ones((3,)).clip(0.0, 0.5).asnumpy(), 0.5)
+
+
+def test_pooling_full_convention():
+    # 6x6 input, k=3, s=2: valid (floor) -> 2, full (ceil) -> 3
+    x = nd.array(np.random.randn(1, 1, 6, 6).astype("float32"))
+    v = nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    f = nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                   pooling_convention="full")
+    assert v.shape == (1, 1, 2, 2)
+    assert f.shape == (1, 1, 3, 3)

@@ -1,0 +1,126 @@
+"""Symbol tests — mirrors reference tests/python/unittest/test_symbol.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_name_attr_via_kwargs():
+    # review finding: name= must be honored in attrs path too
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fcX")
+    assert "fcX_weight" in fc.list_arguments()
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(4, 8))
+    assert arg_shapes == [(4, 8), (16, 8), (16,), (3, 16), (3,), (4,)]
+    assert out_shapes == [(4, 3)]
+    assert aux_shapes == []
+
+
+def test_batchnorm_aux():
+    bn = mx.sym.BatchNorm(mx.sym.Variable("d"), name="bn0")
+    assert bn.list_arguments() == ["d", "bn0_gamma", "bn0_beta"]
+    assert bn.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+
+
+def test_compose_named_inputs():
+    d = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    fc = mx.sym.FullyConnected(data=d, weight=w, num_hidden=4, no_bias=True,
+                               name="fc")
+    assert fc.list_arguments() == ["x", "w"]
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    back = mx.sym.load_json(js)
+    assert back.list_arguments() == out.list_arguments()
+    assert back.list_outputs() == out.list_outputs()
+    # and it still binds/runs
+    ex = back.simple_bind(mx.cpu(), data=(2, 8))
+    ex.forward(is_train=False, data=np.zeros((2, 8), "float32"),
+               softmax_label=np.zeros(2, "float32"))
+    assert ex.outputs[0].shape == (2, 3)
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.relu(a, name="r1")
+    s2 = mx.sym.sigmoid(a, name="s2")
+    g = mx.sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert first.list_outputs() == ["r1_output"]
+
+
+def test_arith_operators():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    ex = c.simple_bind(mx.cpu(), a=(2,), b=(2,))
+    out = ex.forward(is_train=False, a=np.array([2., 4.], "float32"),
+                     b=np.array([1., 2.], "float32"))
+    np.testing.assert_allclose(out[0].asnumpy(), [4., 10.])
+
+
+def test_executor_grads():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(4, 8))
+    for name in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[name][:] = np.random.randn(*ex.arg_dict[name].shape).astype("float32") * 0.1
+    ex.forward(is_train=True, data=np.random.randn(4, 8).astype("float32"),
+               softmax_label=np.array([0., 1., 2., 0.], "float32"))
+    ex.backward()
+    assert abs(ex.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+    assert ex.grad_dict.get("data") is None  # data has grad_req null
+
+
+def test_grad_req_add_executor():
+    x = mx.sym.Variable("x")
+    y = mx.sym.sum(x * 2)
+    ex = y.bind(mx.cpu(), {"x": nd.ones((3,))},
+                args_grad={"x": nd.zeros((3,))}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [4, 4, 4])
+
+
+def test_eval():
+    a = mx.sym.Variable("a")
+    out = (a * 3).eval(ctx=mx.cpu(), a=nd.ones((2,)))
+    np.testing.assert_allclose(out[0].asnumpy(), [3, 3])
+
+
+def test_infer_shape_conv_net():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8, name="c1")
+    p1 = mx.sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f1 = mx.sym.Flatten(p1)
+    fc = mx.sym.FullyConnected(f1, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(2, 1, 28, 28))
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (8, 1, 5, 5)
+    assert d["fc_weight"] == (10, 8 * 12 * 12)
+    assert out_shapes == [(2, 10)]
