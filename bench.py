@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 training throughput (fwd+bwd+SGD update) on one
+TPU chip, the headline metric of BASELINE.md (reference: 109 img/s train
+on a K80 at bs32, ``example/image-classification/README.md:154``).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.fused import TrainStep
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    dtype = "bfloat16" if "--bf16" in sys.argv else "float32"
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224))
+    step = TrainStep(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                       "rescale_grad": 1.0 / batch})
+    shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
+    params, aux, moms = step.init_state(shapes, dtype=dtype)
+
+    rng = jax.random.PRNGKey(0)
+    data = jax.random.normal(rng, shapes["data"], dtype)
+    label = jnp.zeros(shapes["softmax_label"], "float32")
+    batch_dict = {"data": data, "softmax_label": label}
+
+    # warmup/compile; completion is forced with a host fetch because
+    # block_until_ready does not synchronize through the axon tunnel
+    params, aux, moms, out = step(params, aux, moms, batch_dict, rng)
+    float(np.asarray(out[0, 0]))
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, aux, moms, out = step(params, aux, moms, batch_dict, rng)
+    float(np.asarray(out[0, 0]))  # forces the whole dependency chain
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    baseline = 109.0  # K80 bs32 train img/s, BASELINE.md
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

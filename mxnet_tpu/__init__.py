@@ -21,5 +21,19 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
+from . import initializer
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from . import callback
+from . import model
+from . import module
+from . import module as mod
+from .module import Module
+from . import parallel
+from .model import save_checkpoint, load_checkpoint
 
 __version__ = "0.1.0"

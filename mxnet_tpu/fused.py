@@ -1,0 +1,145 @@
+"""Fused train step — the TPU performance path.
+
+The reference's fastest path pushes per-node cached engine ops plus
+separate optimizer-update ops (SURVEY.md §3.1).  On TPU the whole thing —
+forward, backward, optimizer update, and (under a mesh) the gradient
+all-reduce — compiles into ONE XLA program with donated parameter buffers:
+zero host round-trips per step, maximal fusion, collectives overlapped
+with backward compute by XLA's scheduler.  This is what `Module` uses when
+`fit` runs with a compiled step, and what bench.py measures.
+"""
+from __future__ import annotations
+
+import functools
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+__all__ = ["compile_train_step", "TrainStep"]
+
+
+def _loss_from_outputs(outs):
+    """Seed the backward exactly like Executor.backward with ones head
+    grads: sum of outputs (loss heads carry custom vjp that ignores the
+    cotangent's value)."""
+    total = None
+    for o in outs:
+        s = o.sum()
+        total = s if total is None else total + s
+    return total
+
+
+class TrainStep:
+    """Compiled (params, aux, opt_state, batch) -> updated state step."""
+
+    def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
+                 mesh=None, data_names=("data",),
+                 label_names=("softmax_label",), dtype="float32",
+                 batch_sharding_axis="data"):
+        import jax
+
+        from .executor import _trace_fn
+
+        self.symbol = symbol
+        self._fwd_fn, self._arg_names, self._aux_names = _trace_fn(
+            symbol, is_train=True)
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.param_names = [n for n in self._arg_names
+                            if n not in self.data_names
+                            and n not in self.label_names]
+        self.mesh = mesh
+        opt_params = dict(optimizer_params or {})
+        self.lr = float(opt_params.get("learning_rate", 0.01))
+        self.momentum = float(opt_params.get("momentum", 0.0))
+        self.wd = float(opt_params.get("wd", 0.0))
+        self.rescale = float(opt_params.get("rescale_grad", 1.0))
+        if optimizer not in ("sgd",):
+            raise MXNetError("TrainStep currently compiles sgd; use Module "
+                             "update path for %r" % optimizer)
+
+        fwd_fn = self._fwd_fn
+        data_names, label_names = self.data_names, self.label_names
+        lr, momentum, wd, rescale = (self.lr, self.momentum, self.wd,
+                                     self.rescale)
+
+        frozen = frozenset(opt_params.get("fixed_param_names", ()))
+
+        def step(params, aux, moms, batch, rng, lr):
+            def loss_fn(p):
+                args = dict(p)
+                args.update(batch)
+                outs, new_aux = fwd_fn(args, aux, rng)
+                return _loss_from_outputs(outs), (outs, new_aux)
+
+            grads, (outs, new_aux) = jax.grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_moms = {}, {}
+            for k, g in grads.items():
+                if k in frozen:
+                    new_params[k] = params[k]
+                    new_moms[k] = moms[k]
+                    continue
+                g = g * rescale
+                if momentum:
+                    m = momentum * moms[k] - lr * (g + wd * params[k])
+                    new_params[k] = params[k] + m
+                    new_moms[k] = m
+                else:
+                    new_params[k] = params[k] - lr * (g + wd * params[k])
+                    new_moms[k] = moms[k]
+            return new_params, new_aux, new_moms, outs[0]
+
+        if mesh is not None:
+            from .parallel.sharding import named_sharding, replicated
+
+            repl = replicated(mesh)
+            bshard = named_sharding(mesh, batch_sharding_axis)
+            self._jit_step = jax.jit(
+                step,
+                in_shardings=(repl, repl, repl,
+                              {n: bshard for n in
+                               data_names + label_names}, repl, None),
+                out_shardings=(repl, repl, repl, bshard),
+                donate_argnums=(0, 1, 2))
+        else:
+            self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def __call__(self, params, aux, moms, batch, rng, lr=None):
+        return self._jit_step(params, aux, moms, batch, rng,
+                              self.lr if lr is None else lr)
+
+    def init_state(self, shapes, dtype="float32", seed=0):
+        """Allocate params/aux/momentum as raw jax arrays via the shape
+        inference pass + Xavier-ish scaling (bench/profiling convenience;
+        real training initializes through Module)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .symbol.symbol import _infer_param_shapes
+
+        all_shapes = _infer_param_shapes(self.symbol, dict(shapes))
+        key = jax.random.PRNGKey(seed)
+        params, aux, moms = {}, {}, {}
+        for n in self.param_names:
+            shp = all_shapes[n]
+            key, sub = jax.random.split(key)
+            if n.endswith(("_gamma",)):
+                params[n] = jnp.ones(shp, dtype)
+            elif n.endswith(("_bias", "_beta")):
+                params[n] = jnp.zeros(shp, dtype)
+            else:
+                fan_in = int(np.prod(shp[1:])) if len(shp) > 1 else shp[0]
+                scale = (2.0 / max(1, fan_in)) ** 0.5
+                params[n] = scale * jax.random.normal(sub, shp, dtype)
+            moms[n] = jnp.zeros(shp, dtype)
+        for n in self._aux_names:
+            shp = all_shapes[n]
+            aux[n] = jnp.ones(shp, "float32") if n.endswith("_var") \
+                else jnp.zeros(shp, "float32")
+        return params, aux, moms
+
+
+def compile_train_step(symbol, **kwargs):
+    return TrainStep(symbol, **kwargs)

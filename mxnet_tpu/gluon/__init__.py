@@ -1,0 +1,15 @@
+"""Gluon — the imperative high-level API (reference ``python/mxnet/gluon/``,
+new in the 0.11 reference)."""
+from . import parameter
+from .parameter import Parameter, ParameterDict
+from . import block
+from .block import Block, HybridBlock
+from . import nn
+from . import loss
+from .trainer import Trainer
+from . import utils
+from . import data
+from . import rnn
+
+__all__ = ["Parameter", "ParameterDict", "Block", "HybridBlock", "nn",
+           "loss", "Trainer", "utils", "data", "rnn"]

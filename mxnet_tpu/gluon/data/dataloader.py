@@ -1,0 +1,53 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference ``default_batchify_fn``)."""
+    if isinstance(data[0], NDArray):
+        return array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = np.asarray(data)
+    return array(data)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch must not be "
+                "specified if batch_sampler is")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __iter__(self):
+        for batch in self._batch_sampler:
+            yield self._batchify_fn([self._dataset[i] for i in batch])
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,108 @@
+"""Vision datasets (reference ``python/mxnet/gluon/data/vision.py``:
+MNIST, FashionMNIST, CIFAR10, ImageRecordDataset).
+
+No network egress in this build: the datasets read canonical files from
+``root`` if present and raise a clear error otherwise; ``SyntheticDataset``
+provides deterministic stand-in data for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import array
+from .dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "SyntheticDataset"]
+
+
+class SyntheticDataset(ArrayDataset):
+    """Deterministic synthetic image dataset for tests/benches."""
+
+    def __init__(self, num_samples=1000, shape=(1, 28, 28), num_classes=10,
+                 seed=0):
+        rng = np.random.RandomState(seed)
+        data = rng.rand(num_samples, *shape).astype("float32")
+        label = rng.randint(0, num_classes, num_samples).astype("int32")
+        super().__init__(data, label)
+
+
+class _IdxDataset(Dataset):
+    def __init__(self, root, image_file, label_file, train):
+        self._root = os.path.expanduser(root)
+        img_path = os.path.join(self._root, image_file)
+        lbl_path = os.path.join(self._root, label_file)
+        if not (_exists(img_path) and _exists(lbl_path)):
+            raise MXNetError(
+                "Dataset files not found under %s (no network in this "
+                "environment; place %s and %s there, or use "
+                "SyntheticDataset)" % (root, image_file, label_file))
+        self._data = _read_idx(img_path).astype("float32") / 255.0
+        self._data = self._data.reshape(self._data.shape[0],
+                                        self._data.shape[1],
+                                        self._data.shape[2], 1)
+        self._label = _read_idx(lbl_path).astype("int32")
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+def _exists(p):
+    return os.path.exists(p) or os.path.exists(p + ".gz")
+
+
+def _read_idx(path):
+    opener = open
+    if not os.path.exists(path):
+        path, opener = path + ".gz", gzip.open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+class MNIST(_IdxDataset):
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        image = "train-images-idx3-ubyte" if train else "t10k-images-idx3-ubyte"
+        label = "train-labels-idx1-ubyte" if train else "t10k-labels-idx1-ubyte"
+        super().__init__(root, image, label, train)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(Dataset):
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        root = os.path.expanduser(root)
+        files = ["data_batch_%d.bin" % i for i in range(1, 6)] if train \
+            else ["test_batch.bin"]
+        paths = [os.path.join(root, f) for f in files]
+        if not all(os.path.exists(p) for p in paths):
+            raise MXNetError(
+                "CIFAR10 binary batches not found under %s (no network in "
+                "this environment; use SyntheticDataset)" % root)
+        blobs = [np.frombuffer(open(p, "rb").read(), dtype=np.uint8)
+                 .reshape(-1, 3073) for p in paths]
+        blob = np.concatenate(blobs, axis=0)
+        self._label = blob[:, 0].astype("int32")
+        self._data = blob[:, 1:].reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1).astype("float32") / 255.0
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)

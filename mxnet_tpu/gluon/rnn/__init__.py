@@ -1,0 +1,9 @@
+"""Gluon RNN API (reference ``python/mxnet/gluon/rnn/``)."""
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, DropoutCell, ZoneoutCell,
+                       ResidualCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell", "RNN", "LSTM", "GRU"]

@@ -1,0 +1,315 @@
+"""RNN cells (reference ``python/mxnet/gluon/rnn/rnn_cell.py`` and the
+symbolic ``python/mxnet/rnn/rnn_cell.py`` cell algebra: unroll,
+Sequential/Residual/Zoneout/Bidirectional wrappers)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = tuple(batch_size if s == 0 else s
+                          for s in info["shape"])
+            states.append(nd.zeros(shape))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll over time (reference ``BaseRNNCell.unroll``)."""
+        from ... import ndarray as nd
+
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[layout.find("N")]
+            seq = [nd.squeeze(s, axis=axis) if s.shape[axis] == 1 else s
+                   for s in nd.split(inputs, num_outputs=length, axis=axis,
+                                     squeeze_axis=True)]
+            if length == 1:
+                seq = [seq] if not isinstance(seq, list) else seq
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return self.hybrid_call(inputs, states)
+
+    def hybrid_call(self, inputs, states):
+        raise NotImplementedError
+
+
+class RNNCell(RecurrentCell):
+    _num_gates = 1  # LSTM=4, GRU=3: weights stack all gates (reference
+    # cells do the same: i2h_weight is (num_gates*hidden, input))
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        ng = self._num_gates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng * hidden_size, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ng * hidden_size, hidden_size),
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_size,), init="zeros",
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_size,), init="zeros",
+                allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def _ensure(self, inputs, gates=None):
+        nh = self._hidden_size * (gates or self._num_gates)
+        if self.i2h_weight._data is None:
+            self.i2h_weight._shape_from_data((nh, inputs.shape[-1]))
+        if self.h2h_weight._data is None:
+            self.h2h_weight._shape_from_data((nh, self._hidden_size))
+        for b in (self.i2h_bias, self.h2h_bias):
+            if b._data is None:
+                b._shape_from_data((nh,))
+
+    def hybrid_call(self, inputs, states):
+        from ... import ndarray as nd
+
+        self._ensure(inputs)
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(),
+                                self.i2h_bias.data(),
+                                num_hidden=self._hidden_size)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(),
+                                self.h2h_bias.data(),
+                                num_hidden=self._hidden_size)
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RNNCell):
+    _num_gates = 4
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size=input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def hybrid_call(self, inputs, states):
+        from ... import ndarray as nd
+
+        nh = self._hidden_size
+        self._ensure(inputs, gates=4)
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(),
+                                self.i2h_bias.data(), num_hidden=nh * 4)
+        h2h = nd.FullyConnected(states[0], self.h2h_weight.data(),
+                                self.h2h_bias.data(), num_hidden=nh * 4)
+        gates = i2h + h2h
+        slices = nd.split(gates, num_outputs=4, axis=1)
+        in_gate = nd.sigmoid(slices[0])
+        forget_gate = nd.sigmoid(slices[1])
+        in_transform = nd.tanh(slices[2])
+        out_gate = nd.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * nd.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RNNCell):
+    _num_gates = 3
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size=input_size, **kwargs)
+
+    def hybrid_call(self, inputs, states):
+        from ... import ndarray as nd
+
+        nh = self._hidden_size
+        self._ensure(inputs, gates=3)
+        prev = states[0]
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(),
+                                self.i2h_bias.data(), num_hidden=nh * 3)
+        h2h = nd.FullyConnected(prev, self.h2h_weight.data(),
+                                self.h2h_bias.data(), num_hidden=nh * 3)
+        i2h_r, i2h_z, i2h_n = nd.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = nd.split(h2h, num_outputs=3, axis=1)
+        reset = nd.sigmoid(i2h_r + h2h_r)
+        update = nd.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = nd.tanh(i2h_n + reset * h2h_n)
+        next_h = (1. - update) * next_h_tmp + update * prev
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells (reference ``SequentialRNNCell``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        info = []
+        for cell in self._children.values():
+            info.extend(cell.state_info(batch_size))
+        return info
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def hybrid_call(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.register_child(base_cell, "base_cell")
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class DropoutCell(_ModifierCell):
+    def __init__(self, base_cell=None, rate=0.5, **kwargs):
+        if base_cell is None:
+            raise MXNetError("DropoutCell requires a base cell")
+        super().__init__(base_cell, **kwargs)
+        self._rate = rate
+
+    def hybrid_call(self, inputs, states):
+        from ... import ndarray as nd
+
+        out, states = self.base_cell(inputs, states)
+        if self._rate > 0:
+            out = nd.Dropout(out, p=self._rate)
+        return out, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def hybrid_call(self, inputs, states):
+        from ... import ndarray as nd
+        from ... import autograd
+
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            if self._zo > 0 and self._prev_output is not None:
+                mask = nd.Dropout(nd.ones_like(out), p=self._zo)
+                out = nd.where(mask, out, self._prev_output)
+            if self._zs > 0:
+                next_states = [
+                    nd.where(nd.Dropout(nd.ones_like(ns), p=self._zs),
+                             ns, s)
+                    for ns, s in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(_ModifierCell):
+    def hybrid_call(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.state_info(batch_size) + r.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.begin_state(batch_size, **kwargs) + \
+            r.begin_state(batch_size, **kwargs)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        from ... import ndarray as nd
+
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = nd.split(inputs, num_outputs=length, axis=axis,
+                              squeeze_axis=True)
+        batch = inputs[0].shape[0]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch)
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(length, inputs, states[:nl],
+                                        merge_outputs=False)
+        r_out, r_states = r_cell.unroll(length, list(reversed(inputs)),
+                                        states[nl:], merge_outputs=False)
+        outs = [nd.concat(lo, ro, dim=1)
+                for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs:
+            outs = nd.stack(*outs, axis=axis)
+        return outs, l_states + r_states

@@ -1,0 +1,87 @@
+"""Recurrent layers (reference ``python/mxnet/gluon/rnn/rnn_layer.py``:
+RNN/LSTM/GRU over whole sequences; the reference dispatches to the fused
+cuDNN RNN op — here the per-layer scan compiles through XLA, and the
+symbolic fused ``RNN`` op (``mxnet_tpu/ops/rnn_ops.py``) uses lax.scan)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import Block
+from .rnn_cell import RNNCell, LSTMCell, GRUCell, SequentialRNNCell, \
+    BidirectionalCell
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(Block):
+    def __init__(self, cell_factory, hidden_size, num_layers, layout,
+                 dropout, bidirectional, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError("layout must be TNC or NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        with self.name_scope():
+            stack = SequentialRNNCell(prefix="")
+            for i in range(num_layers):
+                if bidirectional:
+                    cell = BidirectionalCell(
+                        cell_factory(hidden_size, prefix="l%d_" % i),
+                        cell_factory(hidden_size, prefix="r%d_" % i))
+                else:
+                    cell = cell_factory(hidden_size, prefix="l%d_" % i)
+                stack.add(cell)
+            self._stack = stack
+            self.register_child(stack, "stack")
+
+    def state_info(self, batch_size=0):
+        return self._stack.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self._stack.begin_state(batch_size, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ... import ndarray as nd
+
+        t_axis = self._layout.find("T")
+        n_axis = self._layout.find("N")
+        length = inputs.shape[t_axis]
+        batch = inputs.shape[n_axis]
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(batch)
+        outputs, out_states = self._stack.unroll(
+            length, inputs, states, layout=self._layout, merge_outputs=True)
+        if return_states:
+            return outputs, out_states
+        return outputs
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        def factory(h, prefix):
+            return RNNCell(h, activation=activation, prefix=prefix)
+        super().__init__(factory, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        def factory(h, prefix):
+            return LSTMCell(h, prefix=prefix)
+        super().__init__(factory, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        def factory(h, prefix):
+            return GRUCell(h, prefix=prefix)
+        super().__init__(factory, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

@@ -1,0 +1,73 @@
+"""Gluon Trainer (reference ``python/mxnet/gluon/trainer.py``): applies an
+Optimizer over a ParameterDict through a KVStore."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .. import kvstore as kvs
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device"):
+        if isinstance(params, dict) or hasattr(params, "values"):
+            params = list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._scale = float(dict(optimizer_params or {}).get(
+            "rescale_grad", 1.0))
+        optimizer_params = dict(optimizer_params or {})
+        if isinstance(optimizer, opt.Optimizer):
+            self._optimizer = optimizer
+        else:
+            param_idx2name = {i: p.name for i, p in enumerate(self._params)}
+            self._optimizer = opt.create(
+                optimizer, param_idx2name=param_idx2name, **optimizer_params)
+        self._updater = opt.get_updater(self._optimizer)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+
+    def _init_kvstore(self):
+        if self._kvstore_type and "dist" in str(self._kvstore_type):
+            self._kvstore = kvs.create(self._kvstore_type)
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step using gradients accumulated on the
+        parameters (reference ``Trainer.step``: rescale 1/batch_size,
+        kvstore push/pull, then updater)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, p in enumerate(self._params):
+            if p._grad is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        "Parameter %s has no gradient; call backward first "
+                        "or set grad_req" % p.name)
+                continue
+            grad = p._grad
+            if self._kvstore is not None:
+                # dist: all-reduce the gradient, then update worker-side
+                self._kvstore.push(i, grad, priority=-i)
+                self._kvstore.pull(i, grad, priority=-i)
+            self._updater(i, grad, p.data())
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

@@ -1,0 +1,57 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``)."""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis (reference ``split_data``).  In SPMD mode a
+    single sharded array usually replaces explicit splitting; this remains
+    for API parity and host-side pipelines."""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise MXNetError(
+            "Too many slices for data with shape %s" % (data.shape,))
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            "data size %d cannot be evenly split into %d slices"
+            % (size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * len(data.shape)
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = array(data, ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so the joint L2 norm is at most max_norm."""
+    import numpy as np
+
+    total = 0.0
+    for arr in arrays:
+        n = float((arr * arr).sum().asscalar())
+        total += n
+    total = math.sqrt(total)
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total

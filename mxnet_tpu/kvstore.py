@@ -1,0 +1,207 @@
+"""KVStore — parameter synchronization.
+
+Reference: ``python/mxnet/kvstore.py`` over ``src/kvstore/`` (SURVEY.md
+§2.1/§2.3): ``local``/``device`` do in-process reductions (``CommCPU``/
+``CommDevice``), ``dist_sync``/``dist_async`` talk to ps-lite parameter
+servers over ZMQ.
+
+TPU-native re-design (the north star's ``dist_tpu_sync``): there are no
+parameter servers.  A KVStore is keyed storage plus a *reduction domain*:
+
+* ``local`` / ``device`` — single-process store; ``push`` sums gradient
+  lists with one jitted tree-add (the reference's Comm tree-reduce
+  collapses into an XLA fusion) and either applies the updater
+  (``update_on_kvstore``) or stores the merged gradient for ``pull``.
+* ``dist_tpu_sync`` / ``dist_sync`` / ``dist_device_sync`` — the same API
+  running under SPMD: every host runs the same program, and cross-chip
+  gradient summation is an XLA all-reduce over ICI inserted by the
+  compiler when the train step is jitted over a ``jax.sharding.Mesh``
+  (see ``mxnet_tpu.parallel``).  ``push`` therefore performs a
+  ``jax.lax.psum``-backed reduction via ``parallel.allreduce`` when a mesh
+  is active, and the updater runs identically on every replica — the
+  TPU equivalent of "update on server, pull updated weights" with zero
+  RPC.  ``rank``/``num_workers`` map to ``jax.process_index/count``.
+
+The gradient-priority overlap the reference gets from
+``priority=-param_index`` (``model.py:105``) comes for free: XLA schedules
+collectives asynchronously inside the fused step and overlaps them with
+remaining backward compute.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros, imperative_invoke
+
+__all__ = ["KVStore", "create"]
+
+_VALID_TYPES = ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "dist_sync", "dist_device_sync", "dist_async",
+                "dist_tpu_sync", "dist")
+
+
+def create(name="local"):
+    """Create a KVStore (reference ``kvstore.create``,
+    ``src/kvstore/kvstore.cc:34``)."""
+    if not isinstance(name, str) or name not in _VALID_TYPES:
+        raise MXNetError("Unknown KVStore type %r (valid: %s)"
+                         % (name, ", ".join(_VALID_TYPES)))
+    return KVStore(name)
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._merged = {}
+        self._updater = None
+        self._optimizer = None
+        self._is_dist = "dist" in kv_type
+
+    # -- identity -------------------------------------------------------
+    @property
+    def rank(self):
+        import jax
+
+        return jax.process_index() if self._is_dist else 0
+
+    @property
+    def num_workers(self):
+        import jax
+
+        return jax.process_count() if self._is_dist else 1
+
+    # -- core API -------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % k)
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        """Reduce gradients into the store.
+
+        ``value`` may be one NDArray or a per-device list (the reference's
+        multi-GPU path); lists are tree-added in one fused XLA op.  Under a
+        dist type with an active mesh, the merged gradient is all-reduced
+        over the mesh data axis (ICI collective).  ``priority`` is accepted
+        for API parity; XLA's scheduler owns collective ordering.
+        """
+        keys, values = self._normalize(key, value, allow_list=True)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            merged = self._reduce(vs)
+            if self._is_dist:
+                merged = self._cross_replica_sum(merged)
+            if self._updater is not None:
+                self._updater(self._key_index(k), merged, self._store[k])
+            else:
+                self._merged[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = self._normalize(key, out, allow_list=True)
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % k)
+            src = self._store[k] if self._updater is not None or \
+                k not in self._merged else self._merged[k]
+            targets = os_ if isinstance(os_, (list, tuple)) else [os_]
+            for tgt in targets:
+                src.copyto(tgt)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in ``row_ids`` (reference PullRowSparse).
+        Dense store + gather keeps shapes static for XLA."""
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = self._normalize(key, out, allow_list=True)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, os_, rid in zip(keys, outs, rids):
+            src = self._store[k]
+            rows = imperative_invoke("take", [src, rid], {"axis": 0})[0]
+            targets = os_ if isinstance(os_, (list, tuple)) else [os_]
+            for tgt in targets:
+                if tgt.shape == rows.shape:
+                    rows.copyto(tgt)
+                else:  # scatter rows back into a full-shape target
+                    tgt[:] = 0.0
+                    tgt._set_data(tgt._data.at[
+                        rid._data.astype("int32")].set(rows._data))
+
+    # -- optimizer plumbing --------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Install the optimizer server-side (reference pickles it to the
+        ps-lite servers via ``_send_command_to_servers``; here every
+        replica runs it identically inside the same program)."""
+        from . import optimizer as opt
+
+        # round-trip through pickle to mirror the reference contract that
+        # the optimizer must be serializable
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    @property
+    def updater(self):
+        return self._updater
+
+    # -- barriers / control --------------------------------------------
+    def barrier(self):
+        """Global barrier (reference ``MXKVStoreBarrier``).  Under SPMD all
+        replicas run in lockstep inside compiled steps; between steps we
+        only need to drain local async work."""
+        from .ndarray import waitall
+
+        waitall()
+
+    def _send_command_to_servers(self, head, body):
+        pass  # no servers in the TPU design
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- internals ------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value, allow_list=False):
+        if isinstance(key, (str, int)):
+            return [key], [value]
+        assert len(key) == len(value)
+        return list(key), list(value)
+
+    @staticmethod
+    def _key_index(k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @staticmethod
+    def _reduce(vs):
+        if isinstance(vs, NDArray):
+            return vs
+        if len(vs) == 1:
+            return vs[0]
+        return imperative_invoke("add_n", list(vs), {})[0]
+
+    @staticmethod
+    def _cross_replica_sum(arr):
+        """All-reduce across replicas when a mesh is active (ICI
+        collective); identity on a single replica."""
+        from .parallel import collectives
+
+        return collectives.allreduce_nd(arr)

@@ -1,0 +1,47 @@
+"""Checkpoint helpers (reference ``python/mxnet/model.py:340-404``).
+
+Format contract preserved: ``prefix-symbol.json`` holds the graph JSON,
+``prefix-%04d.params`` holds a flat dict of arrays with ``arg:``/``aux:``
+name prefixes.  The container for params is ``.npz`` instead of the
+dmlc::Stream binary (documented divergence; keys and layout match, so
+``load_checkpoint``/``save_checkpoint`` round-trip the same dicts).
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+from . import symbol as sym_mod
+from .ndarray import NDArray, save as nd_save, load as nd_load
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .module.base_module import BatchEndParam  # re-export (reference home)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+    # numpy appends .npz; keep the reference filename
+    if os.path.exists(param_name + ".npz"):
+        os.replace(param_name + ".npz", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    save_dict = nd_load(param_name)
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError("invalid param key %r" % k)
+    return symbol, arg_params, aux_params

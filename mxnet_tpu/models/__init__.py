@@ -1,0 +1,28 @@
+"""Model zoo: Symbol generators for the reference's example networks.
+
+Mirrors the coverage of ``example/image-classification/symbols/`` (lenet,
+mlp, alexnet, vgg, resnet, inception-bn) plus the RNN family from
+``example/rnn``.  Each returns a Symbol ending in SoftmaxOutput, ready for
+``Module``.
+"""
+from . import lenet
+from . import mlp
+from . import alexnet
+from . import vgg
+from . import resnet
+from . import inception_bn
+
+__all__ = ["lenet", "mlp", "alexnet", "vgg", "resnet", "inception_bn",
+           "get_model"]
+
+_MODELS = {m.__name__.rsplit(".", 1)[-1]: m.get_symbol
+           for m in (lenet, mlp, alexnet, vgg, resnet, inception_bn)}
+
+
+def get_model(name, **kwargs):
+    from ..base import MXNetError
+
+    if name not in _MODELS:
+        raise MXNetError("unknown model %r (have: %s)"
+                         % (name, sorted(_MODELS)))
+    return _MODELS[name](**kwargs)

@@ -1,0 +1,5 @@
+"""Module API (reference ``python/mxnet/module/``)."""
+from .base_module import BaseModule, BatchEndParam
+from .module import Module
+
+__all__ = ["BaseModule", "Module", "BatchEndParam"]

@@ -157,11 +157,20 @@ def _pooling(attrs, data):
                 extra[d] = stride[d] - rem
     padding = ((0, 0), (0, 0)) + tuple(
         (p, p + e) for p, e in zip(pad, extra))
+    # init values must be CONCRETE (numpy) scalars: a jnp array created
+    # under a jit trace is a tracer constant, which breaks reduce_window's
+    # linearization rule (jit(grad(maxpool)) fails with "Linearization
+    # failed to produce known values")
+    import numpy as _onp
+
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            init = _onp.array(-_onp.inf, data.dtype)
+        else:
+            init = _onp.array(_onp.iinfo(data.dtype).min, data.dtype)
+        return lax.reduce_window(data, init, lax.max,
                                  window, strides, padding)
-    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+    summed = lax.reduce_window(data, _onp.array(0, data.dtype), lax.add,
                                window, strides, padding)
     if pool_type == "sum":
         return summed
@@ -393,17 +402,32 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
 
+    # statistics in fp32 (bf16 inputs would lose precision in the mean/var
+    # reduction); normalization math back in the data dtype so bf16
+    # activations stay bf16 into the next conv
     if is_train:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
-        new_mean = momentum * moving_mean + (1 - momentum) * lax.stop_gradient(mean)
-        new_var = momentum * moving_var + (1 - momentum) * lax.stop_gradient(var)
+        data32 = data.astype(jnp.float32)
+        mean = jnp.mean(data32, axis=reduce_axes)
+        var = jnp.var(data32, axis=reduce_axes)
+        # keep the aux-state dtype stable: cast the fp32 batch stats to the
+        # moving buffers' dtype before blending, else bf16 aux would drift
+        # to fp32 after one step (retraces + checkpoint dtype mismatch)
+        new_mean = momentum * moving_mean + (1 - momentum) * \
+            lax.stop_gradient(mean).astype(moving_mean.dtype)
+        new_var = momentum * moving_var + (1 - momentum) * \
+            lax.stop_gradient(var).astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
 
-    inv = lax.rsqrt(var + eps).reshape(bshape)
-    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
+    mean32 = mean.astype(jnp.float32)
+    var32 = var.astype(jnp.float32)
+    g32 = g.astype(jnp.float32).reshape(bshape)
+    inv = lax.rsqrt(var32 + eps).reshape(bshape)
+    scale = (inv * g32).astype(data.dtype)
+    shift = (beta.astype(jnp.float32).reshape(bshape) -
+             mean32.reshape(bshape) * inv * g32).astype(data.dtype)
+    out = data * scale + shift
     return out, new_mean, new_var
 
 

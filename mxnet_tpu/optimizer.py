@@ -1,0 +1,481 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer.py`` — an ``Optimizer`` registry plus
+updaters.  The reference dispatches the hot optimizers to fused C++ ops
+(sgd_update/adam_update/…); here those same registered ops are pure XLA
+functions (``mxnet_tpu/ops/optimizer_ops.py``), so ``update()`` stays a
+single cached executable per parameter, and the fused Module train step can
+inline them into one program.
+
+Full reference set: SGD, DCASGD, NAG, SGLD, Adam, AdaGrad, RMSProp,
+AdaDelta, Ftrl, Adamax, Nadam, Test (+ ccSGD alias).  lr/wd multipliers,
+param_idx2name, rescale_grad, clip_gradient, lr_scheduler all match the
+reference semantics.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+from .base import MXNetError, _Registry
+from .ndarray import NDArray, zeros, ones, imperative_invoke
+from .ndarray import ndarray as _ndmod
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test",
+           "create", "register", "get_updater", "Updater"]
+
+_registry = _Registry("optimizer")
+
+
+def register(klass):
+    """Register an optimizer class by (lowercased) name (reference
+    ``Optimizer.register``)."""
+    _registry.register(klass.__name__.lower(), klass)
+    return klass
+
+
+def create(name, **kwargs):
+    return _registry.get(name.lower())(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference ``python/mxnet/optimizer.py:30``)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_attrs = sym.attr_dict() if sym is not None else {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    create_optimizer = staticmethod(create)
+
+    # -- state ---------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    # -- lr/wd plumbing (reference semantics incl. symbol attrs) -------
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        for name, attrs in self.sym_attrs.items():
+            if "__lr_mult__" in attrs:
+                self.lr_mult[name] = float(attrs["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # biases/gammas/betas get no weight decay by default
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        for name, attrs in self.sym_attrs.items():
+            if "__wd_mult__" in attrs:
+                self.wd_mult[name] = float(attrs["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, dispatching to the fused sgd(_mom)_update ops
+    (reference ``optimizer.py`` SGD + ``src/operator/optimizer_op.cc``).
+    ``multi_precision`` keeps an fp32 master copy for fp16 weights."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        import numpy as np
+
+        use_mp = self.multi_precision and weight.dtype == np.float16
+        mom = zeros(weight.shape, weight.context) \
+            if self.momentum != 0.0 else None
+        if use_mp:
+            w32 = weight.astype("float32")
+            return (mom, w32)
+        return mom
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        kw = self._common_kwargs()
+        if isinstance(state, tuple):
+            mom, w32 = state
+            if mom is not None:
+                imperative_invoke("mp_sgd_mom_update", [weight, grad, mom, w32],
+                                  dict(lr=lr, wd=wd, momentum=self.momentum,
+                                       **kw), out=weight)
+            else:
+                imperative_invoke("mp_sgd_update", [weight, grad, w32],
+                                  dict(lr=lr, wd=wd, **kw), out=weight)
+        elif state is not None:
+            imperative_invoke("sgd_mom_update", [weight, grad, state],
+                              dict(lr=lr, wd=wd, momentum=self.momentum,
+                                   **kw), out=weight)
+        else:
+            imperative_invoke("sgd_update", [weight, grad],
+                              dict(lr=lr, wd=wd, **kw), out=weight)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context) \
+            if self.momentum != 0.0 else None
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from . import random as _rnd
+        from .ndarray import random_normal
+
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        noise = random_normal(loc=0, scale=math.sqrt(lr),
+                              shape=weight.shape)
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = zeros(weight.shape, weight.context) \
+            if self.momentum != 0.0 else None
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = grad + self.lamda * grad * grad * (weight - prev)
+        if mom is not None:
+            mom *= self.momentum
+            mom += -lr * (comp + wd * weight)
+            delta = mom
+        else:
+            delta = -lr * (comp + wd * weight)
+        prev[:] = weight
+        weight += delta
+
+
+@register
+class Adam(Optimizer):
+    """Adam with the reference's bias-corrected lr and fused op."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        imperative_invoke("adam_update", [weight, grad, mean, var],
+                          dict(lr=lr, wd=wd, beta1=self.beta1,
+                               beta2=self.beta2, epsilon=self.epsilon,
+                               **self._common_kwargs()), out=weight)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight += -lr * (grad / (history + self.float_stable_eps).sqrt()
+                         + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp; centered=True uses Alex Graves' variant (reference)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context),
+                    zeros(weight.shape, weight.context))
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        kw = dict(lr=lr, wd=wd, gamma1=self.gamma1, epsilon=self.epsilon,
+                  **self._common_kwargs())
+        if self.centered:
+            n, g, delta = state
+            imperative_invoke("rmspropalex_update",
+                              [weight, grad, n, g, delta],
+                              dict(gamma2=self.gamma2, **kw), out=weight)
+        else:
+            imperative_invoke("rmsprop_update", [weight, grad, state], kw,
+                              out=weight)
+        if self.clip_weights:
+            weight._set_data(
+                weight.clip(-self.clip_weights, self.clip_weights)._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set_data((self.rho * acc_g + (1 - self.rho) * grad * grad)._data)
+        delta = (acc_delta + self.epsilon).sqrt() / \
+                (acc_g + self.epsilon).sqrt() * grad
+        acc_delta._set_data(
+            (self.rho * acc_delta + (1 - self.rho) * delta * delta)._data)
+        weight += -delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        z, n = state
+        imperative_invoke("ftrl_update", [weight, grad, z, n],
+                          dict(lr=lr, wd=wd, lamda1=self.lamda1,
+                               beta=self.beta, **self._common_kwargs()),
+                          out=weight)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t._set_data((self.beta1 * m_t + (1. - self.beta1) * grad)._data)
+        from .ndarray import elemwise_maximum
+
+        u_t._set_data(elemwise_maximum(self.beta2 * u_t, grad.abs())._data)
+        weight += -lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),
+                zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        grad_prime = grad / (1. - self.m_schedule)
+        m_t._set_data((self.beta1 * m_t + (1. - self.beta1) * grad)._data)
+        v_t._set_data((self.beta2 * v_t + (1. - self.beta2) * grad * grad)._data)
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t_prime = v_t / (1. - self.beta2 ** t)
+        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight += -lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by the reference test suite."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+ccSGD = SGD
+_registry.register("ccsgd", SGD)
+
+
+class Updater:
+    """Worker-side updater closure (reference ``get_updater`` /
+    ``Updater`` — the thing a KVStore calls per key)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,17 @@
+"""Parallelism: device meshes, sharding rules, collectives, ring attention.
+
+This package is the TPU-native replacement for everything in SURVEY.md §2.3:
+the reference's data-parallel Comm trees and ps-lite push/pull become XLA
+collectives over a ``jax.sharding.Mesh`` (ICI in-slice, DCN across slices),
+and the reference's manual ``group2ctx`` model parallelism becomes sharding
+annotations.  It also provides what the reference lacks and this framework
+adds as first-class: tensor parallelism, sequence/context parallelism
+(ring attention), and ZeRO-style sharded optimizer state.
+"""
+from . import mesh
+from . import collectives
+from . import sharding
+from .mesh import create_mesh, current_mesh, set_mesh, mesh_scope
+
+__all__ = ["mesh", "collectives", "sharding", "create_mesh", "current_mesh",
+           "set_mesh", "mesh_scope"]

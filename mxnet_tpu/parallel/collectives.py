@@ -1,0 +1,71 @@
+"""Collectives.
+
+Replaces the reference's two communication layers (SURVEY.md §2.3):
+in-process ``Comm`` tree reduction (``src/kvstore/comm.h``) and ps-lite
+push/pull RPC — with XLA collectives.  Inside a jitted program these are
+``lax.psum``/``all_gather``/``ppermute`` over mesh axes; at the imperative
+boundary (KVStore push outside jit) cross-*process* reduction uses the
+JAX multihost utilities (DCN), and single-controller SPMD needs no
+explicit action because gradients of a batch-sharded loss are already
+globally reduced by the compiler.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["allreduce_nd", "psum", "all_gather", "ppermute",
+           "reduce_scatter"]
+
+
+# -- in-jit collectives (thin lax wrappers, for shard_map'd kernels) -------
+
+def psum(x, axis_name):
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)
+
+
+# -- imperative-boundary allreduce (KVStore push path) ---------------------
+
+def allreduce_nd(arr):
+    """All-reduce an NDArray across worker processes.
+
+    Single process (the usual SPMD single-controller case): identity —
+    when the train step is jitted over a mesh with the batch sharded on
+    the 'data' axis, XLA already inserted the ICI all-reduce inside the
+    step; there is nothing left to reduce at the host level.
+
+    Multi-process (multi-host without a shared jit): sums the per-process
+    values over DCN via the multihost allgather utility.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(arr._data)
+    summed = gathered.sum(axis=0)
+    from ..ndarray.ndarray import NDArray
+
+    return NDArray(jax.device_put(summed), arr.context)

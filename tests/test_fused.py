@@ -1,0 +1,50 @@
+"""Fused train step: numerical equivalence with the split
+forward/backward/update path (the bulk-exec-to-one-program contract)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _sym():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _train(fused, steps=4):
+    import os
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(64, 10).astype("float32")
+    y = (np.random.RandomState(0).rand(64) * 3).astype("float32")
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        it = mx.io.NDArrayIter(X, y, batch_size=32)
+        mod = mx.mod.Module(_sym(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd", kvstore=None,
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        assert (mod._fused is not None) == fused
+        for _ in range(steps):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP", None)
+
+
+def test_fused_matches_split():
+    split = _train(fused=False)
+    fused = _train(fused=True)
+    assert set(split) == set(fused)
+    for k in split:
+        np.testing.assert_allclose(split[k], fused[k], rtol=2e-4,
+                                   atol=1e-5, err_msg=k)

@@ -1,0 +1,221 @@
+"""Gluon tests — mirrors reference tests/python/unittest/test_gluon*.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize(mx.initializer.One())
+    x = nd.ones((2, 3))
+    out = layer(x)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_deferred_init():
+    layer = nn.Dense(5)
+    layer.initialize()
+    out = layer(nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert layer.weight.shape == (5, 7)
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    out = net(nd.ones((4, 3)))
+    assert out.shape == (4, 2)
+    params = net.collect_params()
+    assert len(list(params.keys())) == 4
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(),
+                nn.Flatten(),
+                nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_batchnorm_layer():
+    bn = nn.BatchNorm()
+    bn.initialize()
+    x = nd.array(np.random.randn(4, 3, 5, 5).astype("float32"))
+    with autograd.record():
+        out = bn(x)
+    assert out.shape == x.shape
+    assert abs(bn.running_mean.data().asnumpy()).sum() > 0
+
+
+def test_gluon_trainer_convergence():
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(128, 10).astype("float32")
+    w = np.random.randn(10, 3).astype("float32")
+    y = (X @ w).argmax(1).astype("float32")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.initializer.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    data, label = nd.array(X), nd.array(y)
+    for _ in range(40):
+        with autograd.record():
+            loss = loss_fn(net(data), label)
+        loss.backward()
+        trainer.step(128)
+    acc = (net(data).asnumpy().argmax(1) == y).mean()
+    assert acc > 0.95, acc
+
+
+def test_save_load_params(tmp_path):
+    fname = str(tmp_path / "p.npz")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.Dense(2))
+    net.initialize(mx.initializer.Xavier())
+    x = nd.ones((1, 4))
+    ref = net(x).asnumpy()
+    net.save_params(fname)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8), nn.Dense(2))
+    net2.load_params(fname)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.randn(8, 6).astype("float32"))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    out = net(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_losses():
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([0.0, 1.0])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (2,)
+    expect = -np.log(np.exp([1.0, 4.0]) /
+                     np.exp([[1, 2], [3, 4]]).sum(1))
+    np.testing.assert_allclose(l.asnumpy(), expect, rtol=1e-5)
+    l2 = gluon.loss.L2Loss()(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l2.asnumpy(), [0.5, 2.0])
+    l1 = gluon.loss.L1Loss()(nd.array([1.0, -2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l1.asnumpy(), [1.0, 2.0])
+
+
+def test_lstm_cell_shapes():
+    cell = gluon.rnn.LSTMCell(16)
+    cell.initialize()
+    x = nd.ones((4, 8))
+    states = cell.begin_state(4)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 16)
+    assert cell.i2h_weight.shape == (64, 8)
+    assert len(new_states) == 2
+
+
+def test_gru_cell():
+    cell = gluon.rnn.GRUCell(8)
+    cell.initialize()
+    out, states = cell(nd.ones((2, 4)), cell.begin_state(2))
+    assert out.shape == (2, 8)
+    assert cell.i2h_weight.shape == (24, 4)
+
+
+def test_rnn_unroll_and_layer():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    seq = [nd.ones((2, 4)) for _ in range(5)]
+    outs, states = cell.unroll(5, seq)
+    assert len(outs) == 5 and outs[0].shape == (2, 8)
+    lstm = gluon.rnn.LSTM(8, num_layers=2)
+    lstm.initialize()
+    out = lstm(nd.ones((5, 2, 4)))
+    assert out.shape == (5, 2, 8)
+
+
+def test_bidirectional_cell():
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4),
+                                     gluon.rnn.LSTMCell(4))
+    bi.initialize()
+    outs, states = bi.unroll(3, [nd.ones((2, 5))] * 3)
+    assert outs[0].shape == (2, 8)  # concat of both directions
+
+
+def test_lstm_learns_dependency():
+    np.random.seed(1)
+    mx.random.seed(1)
+    T, N, C = 6, 64, 4
+    seq = np.random.randn(T, N, C).astype("float32")
+    lab = (seq.sum(axis=(0, 2)) > 0).astype("float32")
+
+    class Head(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            self.lstm = gluon.rnn.LSTM(16)
+            self.out = nn.Dense(2)
+
+        def forward(self, x):
+            h = self.lstm(x)
+            return self.out(h[-1])
+
+    head = Head()
+    head.initialize(mx.initializer.Xavier())
+    tr = gluon.Trainer(head.collect_params(), "adam",
+                       {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    sq, lb = nd.array(seq), nd.array(lab)
+    for _ in range(40):
+        with autograd.record():
+            loss = loss_fn(head(sq), lb)
+        loss.backward()
+        tr.step(N)
+    acc = (head(sq).asnumpy().argmax(1) == lab).mean()
+    assert acc > 0.9, acc
+
+
+def test_dataset_dataloader():
+    X = np.arange(40).reshape(10, 4).astype("float32")
+    y = np.arange(10).astype("float32")
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 10
+    loader = gluon.data.DataLoader(ds, batch_size=3, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    data, label = batches[0]
+    assert data.shape == (3, 4) and label.shape == (3,)
+    loader2 = gluon.data.DataLoader(ds, batch_size=3, last_batch="discard")
+    assert len(list(loader2)) == 3
+
+
+def test_split_and_load():
+    arr = nd.array(np.arange(12).reshape(6, 2).astype("float32"))
+    parts = gluon.utils.split_data(arr, 3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    loaded = gluon.utils.split_and_load(arr, [mx.cpu()])
+    assert loaded[0].shape == (6, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = sum(float((a * a).sum().asscalar()) for a in arrays)
+    assert abs(total - 1.0) < 1e-4

@@ -1,0 +1,69 @@
+"""Model zoo symbol tests: shapes infer, forward runs, tiny nets train."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize("name,kwargs,dshape", [
+    ("mlp", {"num_classes": 10}, (2, 784)),
+    ("lenet", {"num_classes": 10}, (2, 1, 28, 28)),
+    ("resnet", {"num_classes": 10, "num_layers": 18,
+                "image_shape": (3, 224, 224)}, (2, 3, 224, 224)),
+    ("resnet", {"num_classes": 10, "num_layers": 20,
+                "image_shape": (3, 32, 32)}, (2, 3, 32, 32)),
+])
+def test_model_forward_shapes(name, kwargs, dshape):
+    sym = models.get_model(name, **kwargs)
+    _, out_shapes, _ = sym.infer_shape(data=dshape)
+    assert out_shapes == [(dshape[0], kwargs["num_classes"])]
+    ex = sym.simple_bind(mx.cpu(), grad_req="null", data=dshape)
+    # init non-zero weights so the output is finite
+    for n, arr in ex.arg_dict.items():
+        if n.endswith("_weight"):
+            arr[:] = np.random.randn(*arr.shape).astype("float32") * 0.05
+    ex.forward(is_train=False,
+               data=np.random.randn(*dshape).astype("float32"),
+               softmax_label=np.zeros(dshape[0], "float32"))
+    out = ex.outputs[0].asnumpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(1), 1, rtol=1e-4)
+
+
+def test_resnet50_builds():
+    sym = models.get_model("resnet", num_classes=1000, num_layers=50)
+    args = sym.list_arguments()
+    # 53 convs + fc: spot-check parameter inventory
+    conv_ws = [a for a in args if "conv" in a and a.endswith("_weight")]
+    assert len(conv_ws) >= 49
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(data=(4, 3, 224, 224))
+    assert out_shapes == [(4, 1000)]
+    d = dict(zip(args, arg_shapes))
+    assert d["conv0_weight"] == (64, 3, 7, 7)
+    assert d["fc1_weight"] == (1000, 2048)
+    assert len(aux_shapes) > 0  # batchnorm moving stats present
+
+
+def test_alexnet_vgg_inception_build():
+    for name, kwargs in [("alexnet", {}), ("vgg", {"num_layers": 11}),
+                         ("inception_bn", {})]:
+        sym = models.get_model(name, num_classes=7, **kwargs)
+        _, out_shapes, _ = sym.infer_shape(data=(1, 3, 224, 224))
+        assert out_shapes == [(1, 7)], name
+
+
+def test_lenet_trains_on_synthetic_mnist():
+    """tests/python/train/test_conv.py analogue, synthetic data."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 1, 28, 28).astype("float32")
+    # two classes distinguished by the mean of the top-left patch
+    y = (X[:, 0, :14, :14].mean(axis=(1, 2)) > 0).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(models.get_model("lenet", num_classes=2),
+                        context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            num_epoch=25, initializer=mx.initializer.Xavier())
+    acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")[0][1]
+    assert acc > 0.9, acc

@@ -1,0 +1,199 @@
+"""Module tests — mirrors reference tests/python/unittest/test_module.py
+and the tests/python/train convergence tier."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp_sym(num_classes=3):
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _synth(n=600, d=20, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype("float32")
+    W = rng.randn(d, c).astype("float32")
+    y = (X @ W).argmax(1).astype("float32")
+    return X, y
+
+
+def test_module_fit_converges():
+    X, y = _synth()
+    train = mx.io.NDArrayIter(X[:500], y[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[500:], y[500:], batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            eval_metric="acc", num_epoch=15,
+            initializer=mx.initializer.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.85, score
+
+
+def test_module_bind_get_set_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 20))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params(initializer=mx.initializer.Normal(0.1))
+    args, auxs = mod.get_params()
+    assert set(args) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    # set_params round trip
+    args["fc1_weight"][:] = 7.0
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    np.testing.assert_allclose(args2["fc1_weight"].asnumpy(), 7.0)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _synth(n=100)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", num_epoch=2,
+            initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 2)
+    ref = mod.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc")[0][1]
+
+    mod2 = mx.mod.Module.load(prefix, 2)
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label, for_training=False)
+    mod2.init_params()
+    got = mod2.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc")[0][1]
+    assert abs(ref - got) < 1e-6
+
+
+def test_module_predict():
+    X, y = _synth(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (64, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(1), 1, rtol=1e-4)
+
+
+def test_module_update_on_kvstore_matches_local():
+    X, y = _synth(n=200, seed=3)
+
+    def run(kvstore):
+        mx.random.seed(0)
+        np.random.seed(0)
+        it = mx.io.NDArrayIter(X, y, batch_size=50)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(it, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, num_epoch=3,
+                kvstore=kvstore, initializer=mx.initializer.Xavier())
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    a = run("local")
+    b = run("device")
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
+
+
+def test_optimizers_step():
+    # every registered optimizer performs a step without error and moves
+    # the weight
+    X, y = _synth(n=100)
+    for name in ["sgd", "adam", "adagrad", "rmsprop", "adadelta", "ftrl",
+                 "adamax", "nadam", "nag", "sgld", "dcasgd"]:
+        it = mx.io.NDArrayIter(X, y, batch_size=50)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+        mod.init_optimizer(optimizer=name, kvstore=None)
+        batch = next(iter(it))
+        mod.forward_backward(batch)
+        mod.update()
+        after = mod.get_params()[0]["fc1_weight"].asnumpy()
+        assert not np.allclose(before, after), name
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    ms = mx.lr_scheduler.MultiFactorScheduler([5, 8], factor=0.1, base_lr=1.0)
+    assert ms(4) == 1.0
+    assert abs(ms(6) - 0.1) < 1e-12
+    assert abs(ms(9) - 0.01) < 1e-12
+
+
+def test_metrics():
+    acc = mx.metric.create("acc")
+    acc.update([nd.array([1.0, 0.0])],
+               [nd.array([[0.3, 0.7], [0.6, 0.4]])])
+    assert acc.get()[1] == 1.0
+    mse = mx.metric.create("mse")
+    mse.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+    top2 = mx.metric.create("top_k_accuracy", top_k=2)
+    top2.update([nd.array([2.0])], [nd.array([[0.1, 0.5, 0.4]])])
+    assert top2.get()[1] == 1.0
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+def test_ndarray_iter():
+    X = np.arange(20).reshape(10, 2).astype("float32")
+    y = np.arange(10).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it2 = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_prefetching_iter():
+    X, y = _synth(n=60)
+    base = mx.io.NDArrayIter(X, y, batch_size=10)
+    pf = mx.io.PrefetchingIter(base)
+    n = sum(1 for _ in pf)
+    assert n == 6
+    pf.reset()
+    assert sum(1 for _ in pf) == 6
+
+
+def test_kvstore_basic():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), 1)
+    # push a list -> summed
+    kv._set_updater(lambda i, g, w: w._set_data((w + g)._data))
+    kv.push(3, [nd.ones((2, 3))] * 4)
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), 5)
+
+
+def test_initializers():
+    for init, check in [
+        (mx.initializer.Uniform(0.1), lambda a: abs(a).max() <= 0.1),
+        (mx.initializer.Normal(0.01), lambda a: abs(a).mean() < 0.1),
+        (mx.initializer.Xavier(), lambda a: a.std() > 0),
+        (mx.initializer.One(), lambda a: (a == 1).all()),
+        (mx.initializer.Zero(), lambda a: (a == 0).all()),
+    ]:
+        arr = nd.zeros((16, 16)) if not isinstance(init, (mx.initializer.One,)) \
+            else nd.zeros((16, 16))
+        init(mx.initializer.InitDesc("fake_weight"), arr)
+        assert check(arr.asnumpy()), init
+    # name-pattern dispatch
+    arr = nd.zeros((4,))
+    mx.initializer.Xavier()(mx.initializer.InitDesc("bn_gamma"), arr)
+    np.testing.assert_allclose(arr.asnumpy(), 1)

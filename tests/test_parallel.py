@@ -1,0 +1,131 @@
+"""Parallelism tests on the 8-device virtual CPU mesh (SURVEY.md §4:
+multi-host collective tests runnable on a single host)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import create_mesh, mesh_scope
+from mxnet_tpu.parallel import sharding as shd
+
+
+def test_create_mesh_axes():
+    mesh = create_mesh({"data": 4, "model": 2})
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    mesh2 = create_mesh({"data": -1})
+    assert mesh2.shape["data"] == 8
+
+
+def test_create_mesh_errors():
+    with pytest.raises(mx.MXNetError):
+        create_mesh({"data": 3, "model": 2})  # 6 != 8
+    with pytest.raises(mx.MXNetError):
+        create_mesh({"data": -1, "model": -1})
+
+
+def test_mesh_scope():
+    from mxnet_tpu.parallel import current_mesh
+
+    assert current_mesh() is None
+    mesh = create_mesh({"data": 8})
+    with mesh_scope(mesh):
+        assert current_mesh() is mesh
+    assert current_mesh() is None
+
+
+def test_shard_batch_layout():
+    import jax
+
+    mesh = create_mesh({"data": 8})
+    x = np.arange(64, dtype="float32").reshape(8, 8)
+    sx = shd.shard_batch(mesh, x)
+    assert sx.shape == (8, 8)
+    # each device holds one batch row
+    assert len(sx.addressable_shards) == 8
+    assert sx.addressable_shards[0].data.shape == (1, 8)
+
+
+def test_data_parallel_train_step_matches_single_device():
+    """The SPMD-sharded fused step must produce the same updated params
+    as the unsharded step (the dist_tpu_sync correctness contract —
+    reference tests/nightly/dist_sync_kvstore.py analogue)."""
+    import jax
+
+    from mxnet_tpu.fused import TrainStep
+    from mxnet_tpu.models import mlp
+
+    sym = mlp.get_symbol(num_classes=4)
+    shapes = {"data": (16, 10), "softmax_label": (16,)}
+    rng = jax.random.PRNGKey(7)
+    data = jax.random.normal(rng, shapes["data"], "float32")
+    label = jax.numpy.zeros(shapes["softmax_label"], "float32")
+
+    def run(mesh):
+        step = TrainStep(sym, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9,
+                                           "rescale_grad": 1.0 / 16},
+                         mesh=mesh)
+        params, aux, moms = step.init_state(shapes, seed=3)
+        if mesh is not None:
+            d = shd.shard_batch(mesh, data)
+            l = shd.shard_batch(mesh, label)
+        else:
+            d, l = data, label
+        batch = {"data": d, "softmax_label": l}
+        for _ in range(3):
+            params, aux, moms, out = step(params, aux, moms, batch, rng)
+        return {k: np.asarray(v) for k, v in params.items()}
+
+    single = run(None)
+    mesh = create_mesh({"data": 8})
+    sharded = run(mesh)
+    for k in single:
+        np.testing.assert_allclose(single[k], sharded[k], rtol=2e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_tensor_parallel_constraint_compiles():
+    """Model-axis sharded matmul compiles and matches the replicated
+    result (the group2ctx → sharding-annotation replacement)."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = create_mesh({"data": 2, "model": 4})
+    W = jax.random.normal(jax.random.PRNGKey(0), (32, 64), "float32")
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32), "float32")
+
+    with mesh_scope(mesh):
+        def fn(x, w):
+            w = shd.constraint(w, None, "model")  # column-parallel
+            y = x @ w
+            return shd.constraint(y, "data", None)
+
+        out = jax.jit(fn)(x, W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(W),
+                               rtol=1e-4)
+
+
+def test_fsdp_param_sharding_rules():
+    mesh = create_mesh({"data": 8})
+    params = {"fc1_weight": np.zeros((128, 64)), "fc1_bias": np.zeros((17,))}
+    shardings = shd.apply_rules(mesh, params,
+                                shd.param_sharding_rules("fsdp"))
+    spec = shardings["fc1_weight"].spec
+    assert tuple(spec) == ("data", None)
+    # 17 not divisible by 8 -> replicated
+    assert tuple(shardings["fc1_bias"].spec) in ((None,), ())
+
+
+def test_dryrun_multichip_entrypoint():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_allreduce_nd_single_process_identity():
+    from mxnet_tpu.parallel.collectives import allreduce_nd
+    from mxnet_tpu import nd
+
+    a = nd.ones((3,))
+    out = allreduce_nd(a)
+    np.testing.assert_allclose(out.asnumpy(), 1)
