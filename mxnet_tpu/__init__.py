@@ -22,6 +22,7 @@ from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
 from . import initializer
+from . import initializer as init
 from . import optimizer
 from . import lr_scheduler
 from . import metric

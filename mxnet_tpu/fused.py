@@ -1,19 +1,35 @@
 """Fused train step — the TPU performance path.
 
 The reference's fastest path pushes per-node cached engine ops plus
-separate optimizer-update ops (SURVEY.md §3.1).  On TPU the whole thing —
-forward, backward, optimizer update, and (under a mesh) the gradient
-all-reduce — compiles into ONE XLA program with donated parameter buffers:
-zero host round-trips per step, maximal fusion, collectives overlapped
-with backward compute by XLA's scheduler.  This is what `Module` uses when
-`fit` runs with a compiled step, and what bench.py measures.
+separate optimizer-update ops (SURVEY.md §3.1, fused update ops in
+``src/operator/optimizer_op.cc``).  On TPU the whole thing — forward,
+backward, optimizer update, and (under a mesh) the gradient all-reduce —
+compiles into ONE XLA program with donated parameter buffers: zero host
+round-trips per step, maximal fusion, collectives overlapped with
+backward compute by XLA's scheduler.  This is what ``Module`` uses when
+``fit`` runs with a compiled step, and what bench.py measures.
+
+Any registered :class:`~mxnet_tpu.optimizer.Optimizer` that implements
+``fused_update`` (all of the built-in family) compiles in; per-parameter
+``lr_mult``/``wd_mult`` (symbol ``__lr_mult__``/``__wd_mult__`` attrs and
+the no-decay-for-bias default) are honored exactly like the split
+``Optimizer._get_lr/_get_wd`` path.
+
+Extra TPU-first knobs the reference exposes differently:
+
+* ``compute_dtype='bfloat16'`` — mixed precision: parameters stay fp32
+  (master weights, the reference's ``mp_sgd_*`` contract) and are cast to
+  bf16 for the forward/backward so matmuls/convs hit the MXU at full
+  rate; gradients come back fp32 for the update.
+* ``remat`` — gradient checkpointing (the reference's
+  ``MXNET_BACKWARD_DO_MIRROR`` / ``__force_mirroring__``,
+  ``src/executor/graph_executor.cc:273-296``): ``'full'`` recomputes all
+  activations in the backward, or pass a named jax checkpoint policy
+  (e.g. ``'dots_with_no_batch_dims_saveable'``).
 """
 from __future__ import annotations
 
-import functools
-
 from .base import MXNetError
-from .ops import registry as _registry
 
 __all__ = ["compile_train_step", "TrainStep"]
 
@@ -24,21 +40,47 @@ def _loss_from_outputs(outs):
     cotangent's value)."""
     total = None
     for o in outs:
-        s = o.sum()
+        s = o.astype("float32").sum()
         total = s if total is None else total + s
     return total
 
 
+def _buffer_key(x):
+    """Identity of the underlying device buffer (best effort)."""
+    try:
+        return ("ptr", x.unsafe_buffer_pointer())
+    except Exception:
+        return ("id", id(x))
+
+
+def _resolve_remat(remat):
+    import jax
+
+    if remat is None or remat is False:
+        return None
+    if remat is True or remat == "full":
+        return "full"
+    if isinstance(remat, str):
+        policy = getattr(jax.checkpoint_policies, remat, None)
+        if policy is None:
+            raise MXNetError("unknown remat policy %r" % remat)
+        return policy
+    return remat  # a jax checkpoint policy callable
+
+
 class TrainStep:
-    """Compiled (params, aux, opt_state, batch) -> updated state step."""
+    """Compiled (params, aux, opt_states, batch) -> updated state step."""
 
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_names=("data",),
                  label_names=("softmax_label",), dtype="float32",
-                 batch_sharding_axis="data"):
+                 batch_sharding_axis="data", compute_dtype=None,
+                 remat=None, fixed_param_names=()):
         import jax
+        import jax.numpy as jnp
 
         from .executor import _trace_fn
+        from . import optimizer as opt_mod
 
         self.symbol = symbol
         self._fwd_fn, self._arg_names, self._aux_names = _trace_fn(
@@ -49,46 +91,75 @@ class TrainStep:
                             if n not in self.data_names
                             and n not in self.label_names]
         self.mesh = mesh
+
         opt_params = dict(optimizer_params or {})
-        self.lr = float(opt_params.get("learning_rate", 0.01))
-        self.momentum = float(opt_params.get("momentum", 0.0))
-        self.wd = float(opt_params.get("wd", 0.0))
-        self.rescale = float(opt_params.get("rescale_grad", 1.0))
-        if optimizer not in ("sgd",):
-            raise MXNetError("TrainStep currently compiles sgd; use Module "
-                             "update path for %r" % optimizer)
+        fixed = frozenset(fixed_param_names) | frozenset(
+            opt_params.pop("fixed_param_names", ()))
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **opt_params)
+        elif isinstance(optimizer, opt_mod.Optimizer):
+            if opt_params:
+                raise MXNetError(
+                    "optimizer_params must not be set when passing an "
+                    "Optimizer instance (got %r); configure the instance "
+                    "instead" % sorted(opt_params))
+        else:
+            raise MXNetError("optimizer must be a name or Optimizer")
+        if not optimizer.supports_fused:
+            raise MXNetError("optimizer %s has no fused form"
+                             % type(optimizer).__name__)
+        self.optimizer = optimizer
+        self.lr = optimizer.lr
+
+        # static per-parameter multipliers, resolved by name exactly like
+        # Optimizer._get_lr/_get_wd
+        lr_mults = {n: optimizer.lr_mult.get(n, 1.0)
+                    for n in self.param_names}
+        wd_mults = {n: optimizer.wd_mult.get(n, 1.0)
+                    for n in self.param_names}
+        base_wd = optimizer.wd
 
         fwd_fn = self._fwd_fn
-        data_names, label_names = self.data_names, self.label_names
-        lr, momentum, wd, rescale = (self.lr, self.momentum, self.wd,
-                                     self.rescale)
+        remat_policy = _resolve_remat(remat)
+        if remat_policy == "full":
+            fwd_fn = jax.checkpoint(fwd_fn)
+        elif remat_policy is not None:
+            fwd_fn = jax.checkpoint(fwd_fn, policy=remat_policy)
+        cdtype = compute_dtype
+        frozen = fixed
 
-        frozen = frozenset(opt_params.get("fixed_param_names", ()))
+        def cast_compute(x):
+            return x.astype(cdtype) if jnp.issubdtype(
+                x.dtype, jnp.floating) else x
 
-        def step(params, aux, moms, batch, rng, lr):
+        def step(params, aux, states, batch, rng, lr, t):
             def loss_fn(p):
                 args = dict(p)
                 args.update(batch)
-                outs, new_aux = fwd_fn(args, aux, rng)
+                a = aux
+                if cdtype is not None:
+                    args = {k: cast_compute(v) for k, v in args.items()}
+                    a = {k: cast_compute(v) for k, v in aux.items()}
+                outs, new_aux = fwd_fn(args, a, rng)
+                if cdtype is not None:
+                    new_aux = {k: v.astype(aux[k].dtype)
+                               for k, v in new_aux.items()}
                 return _loss_from_outputs(outs), (outs, new_aux)
 
             grads, (outs, new_aux) = jax.grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_moms = {}, {}
-            for k, g in grads.items():
+            new_params, new_states = {}, {}
+            for i, k in enumerate(sorted(grads)):
+                g = grads[k]
                 if k in frozen:
                     new_params[k] = params[k]
-                    new_moms[k] = moms[k]
+                    new_states[k] = states[k]
                     continue
-                g = g * rescale
-                if momentum:
-                    m = momentum * moms[k] - lr * (g + wd * params[k])
-                    new_params[k] = params[k] + m
-                    new_moms[k] = m
-                else:
-                    new_params[k] = params[k] - lr * (g + wd * params[k])
-                    new_moms[k] = moms[k]
-            return new_params, new_aux, new_moms, outs[0]
+                new_params[k], new_states[k] = optimizer.fused_update(
+                    params[k], g, states[k],
+                    lr * lr_mults[k], base_wd * wd_mults[k], t,
+                    jax.random.fold_in(rng, i + 1))
+            return new_params, new_aux, new_states, outs[0]
 
         if mesh is not None:
             from .parallel.sharding import named_sharding, replicated
@@ -99,20 +170,50 @@ class TrainStep:
                 step,
                 in_shardings=(repl, repl, repl,
                               {n: bshard for n in
-                               data_names + label_names}, repl, None),
+                               self.data_names + self.label_names},
+                              repl, None, None),
                 out_shardings=(repl, repl, repl, bshard),
                 donate_argnums=(0, 1, 2))
         else:
             self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._t = 0
 
-    def __call__(self, params, aux, moms, batch, rng, lr=None):
-        return self._jit_step(params, aux, moms, batch, rng,
-                              self.lr if lr is None else lr)
+    def __call__(self, params, aux, states, batch, rng, lr=None, t=None):
+        import jax
+        import jax.numpy as jnp
+
+        if t is None:
+            self._t += 1
+            t = self._t
+        else:
+            self._t = int(t)
+        # Two input hygiene passes before the donated call:
+        # 1. commit uncommitted arrays (jnp.zeros products) so the jit
+        #    signature is identical on every step — no recompiles;
+        # 2. donated pytrees must not alias each other (some optimizers
+        #    seed state from the weight buffer; XLA may also alias
+        #    identical outputs) — copy duplicates.
+        seen = set()
+
+        def dedupe(x):
+            if not getattr(x, "committed", True):
+                x = jax.device_put(x, next(iter(x.devices())))
+            k = _buffer_key(x)
+            if k in seen:
+                return jnp.copy(x)
+            seen.add(k)
+            return x
+
+        params, aux, states = jax.tree.map(
+            dedupe, (params, aux, states))
+        return self._jit_step(params, aux, states, batch, rng,
+                              self.lr if lr is None else lr,
+                              jnp.asarray(t, "int32"))
 
     def init_state(self, shapes, dtype="float32", seed=0):
-        """Allocate params/aux/momentum as raw jax arrays via the shape
-        inference pass + Xavier-ish scaling (bench/profiling convenience;
-        real training initializes through Module)."""
+        """Allocate params/aux/optimizer-states as raw jax arrays via the
+        shape inference pass + Xavier-ish scaling (bench/profiling
+        convenience; real training initializes through Module)."""
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -121,7 +222,7 @@ class TrainStep:
 
         all_shapes = _infer_param_shapes(self.symbol, dict(shapes))
         key = jax.random.PRNGKey(seed)
-        params, aux, moms = {}, {}, {}
+        params, aux, states = {}, {}, {}
         for n in self.param_names:
             shp = all_shapes[n]
             key, sub = jax.random.split(key)
@@ -133,12 +234,12 @@ class TrainStep:
                 fan_in = int(np.prod(shp[1:])) if len(shp) > 1 else shp[0]
                 scale = (2.0 / max(1, fan_in)) ** 0.5
                 params[n] = scale * jax.random.normal(sub, shp, dtype)
-            moms[n] = jnp.zeros(shp, dtype)
+            states[n] = self.optimizer.init_fused_state(params[n])
         for n in self._aux_names:
             shp = all_shapes[n]
             aux[n] = jnp.ones(shp, "float32") if n.endswith("_var") \
                 else jnp.zeros(shp, "float32")
-        return params, aux, moms
+        return params, aux, states
 
 
 def compile_train_step(symbol, **kwargs):

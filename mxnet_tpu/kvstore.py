@@ -58,6 +58,20 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._is_dist = "dist" in kv_type
+        self._mesh = None
+        if "async" in kv_type:
+            # In the reference, dist_async servers apply each worker's
+            # gradient immediately without a merge barrier
+            # (kvstore_dist_server.h sync_mode_=false).  The SPMD design
+            # has no servers and every replica steps in lockstep, so
+            # async degenerates to synchronous updates.  This is a
+            # documented alias, not silent: warn once.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "kvstore %r: asynchronous server semantics do not exist "
+                "under single-controller SPMD; updates are synchronous "
+                "(equivalent to dist_tpu_sync)", kv_type)
 
     # -- identity -------------------------------------------------------
     @property
@@ -198,10 +212,13 @@ class KVStore:
             return vs[0]
         return imperative_invoke("add_n", list(vs), {})[0]
 
-    @staticmethod
-    def _cross_replica_sum(arr):
-        """All-reduce across replicas when a mesh is active (ICI
-        collective); identity on a single replica."""
+    def _cross_replica_sum(self, arr):
+        """All-reduce across replicas: over the active mesh's data axis
+        for per-chip partial gradients (ICI collective), over DCN for
+        multi-process values; identity when the pushed gradient is
+        already global (the fused SPMD step's case)."""
         from .parallel import collectives
+        from .parallel.mesh import current_mesh
 
-        return collectives.allreduce_nd(arr)
+        mesh = getattr(self, "_mesh", None) or current_mesh()
+        return collectives.allreduce_nd(arr, mesh=mesh)

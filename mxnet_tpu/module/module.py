@@ -189,6 +189,7 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         optimizer.set_lr_mult({})
         optimizer.set_wd_mult({})
+        self._mesh = self._decide_mesh(kvstore_inst)
 
         if kvstore_inst:
             # init keys: index -> weight
@@ -199,47 +200,112 @@ class Module(BaseModule):
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)
 
+        self._maybe_compile_fused()
+        self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
-        self.optimizer_initialized = True
-        self._maybe_compile_fused()
+
+    def _decide_mesh(self, kvstore_inst):
+        """Choose the device mesh for this fit (reference: kvstore type
+        selects the comm layer, ``src/kvstore/kvstore.cc:34-62``; here
+        'device'/'dist*' types select SPMD over a ``jax.sharding.Mesh``
+        and XLA inserts the gradient all-reduce over ICI)."""
+        if kvstore_inst is None:
+            return None
+        if not ("dist" in kvstore_inst.type or "device" in kvstore_inst.type):
+            return None
+        import jax
+
+        from ..parallel import current_mesh, create_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            devices = [c.jax_device for c in self._context] \
+                if len(self._context) > 1 else list(jax.devices())
+            if len(devices) <= 1:
+                return None
+            mesh = create_mesh({"data": len(devices)}, devices=devices)
+        # the global batch must divide over the data axis
+        axis = mesh.shape.get("data", 1)
+        batch = self._data_shapes[0].shape[0]
+        if axis > 1 and batch % axis != 0:
+            self.logger.warning(
+                "batch size %d not divisible by mesh data axis %d; "
+                "running replicated", batch, axis)
+            return None
+        kvstore_inst._mesh = mesh
+        return mesh
 
     def _maybe_compile_fused(self):
-        """Compile fwd+bwd+update into ONE XLA program when the optimizer
-        is plain SGD(+momentum) and all params use grad_req 'write'.
+        """Compile fwd+bwd+allreduce+update into ONE XLA program.
 
         This is the TPU analogue of the reference's bulk-exec segments
         (``InitOpSegs``, env ``MXNET_EXEC_BULK_EXEC_TRAIN``) taken to its
         limit: the whole train step — including the optimizer and, under a
         mesh, the gradient all-reduce — is a single device call per batch,
         which removes the per-op host round-trips that dominate when the
-        device is behind a network tunnel.  Set MXNET_FUSED_STEP=0 to
-        disable (falls back to forward/backward/update calls)."""
+        device is behind a network tunnel.  Works for every optimizer with
+        a ``fused_update`` (the whole built-in family); per-param lr/wd
+        multipliers and fixed params are honored.  Set MXNET_FUSED_STEP=0
+        to disable (falls back to forward/backward/update calls)."""
         from ..base import get_env
 
         self._fused = None
-        self._fused_moms = None
+        self._fused_states = None
         self._fused_ran = False
         if not get_env("MXNET_FUSED_STEP", True, bool):
             return
-        o = self._optimizer
-        if type(o).__name__ != "SGD" or getattr(o, "multi_precision", False):
+        if self.inputs_need_grad:
+            # the fused step does not populate grad_dict for data inputs;
+            # get_input_grads needs the split executor path
             return
-        if self._grad_req != "write" or self._fixed_param_names:
+        o = self._optimizer
+        if not o.supports_fused:
+            self.logger.debug("optimizer %s has no fused form; using the "
+                              "split update path", type(o).__name__)
+            return
+        req = self._grad_req
+        if isinstance(req, str):
+            ok = req == "write"
+        else:  # dict: fixed params null, everything else write
+            ok = all(v == "write" or (k in self._fixed_param_names and
+                                      v == "null")
+                     for k, v in req.items())
+        if not ok:
             return
         try:
             from ..fused import TrainStep
 
+            remat = "full" if get_env("MXNET_BACKWARD_DO_MIRROR", False,
+                                      bool) else None
             self._fused = TrainStep(
-                self._symbol, optimizer="sgd",
-                optimizer_params={
-                    "learning_rate": o.lr, "momentum": o.momentum,
-                    "wd": o.wd, "rescale_grad": o.rescale_grad},
-                data_names=self._data_names, label_names=self._label_names)
+                self._symbol, optimizer=o, mesh=self._mesh,
+                data_names=self._data_names, label_names=self._label_names,
+                fixed_param_names=self._fixed_param_names, remat=remat)
         except Exception as e:  # fall back to the split path
             self.logger.debug("fused step unavailable: %s", e)
             self._fused = None
+        if self._fused is None and self._mesh is not None and \
+                max(self._mesh.shape.values()) > 1:
+            self.logger.warning(
+                "dist kvstore requested but the fused SPMD step is "
+                "unavailable; training runs single-device (full batch)")
+
+    def _init_fused_states(self):
+        """Seed fused optimizer states, honoring any states preloaded into
+        the updater (checkpoint resume)."""
+        o = self._optimizer
+        states = {}
+        preloaded = self._updater.states if self._updater is not None else \
+            (self._kvstore.updater.states
+             if self._kvstore is not None and self._kvstore.updater else {})
+        for i, n in enumerate(self._param_names):
+            if i in preloaded and preloaded[i] is not None:
+                states[n] = o.fused_state_from_nd(preloaded[i])
+            else:
+                states[n] = o.init_fused_state(self._exec.arg_dict[n]._data)
+        return states
 
     def _fused_forward_backward_update(self, data_batch):
         import jax.numpy as jnp
@@ -250,9 +316,8 @@ class Module(BaseModule):
         o = self._optimizer
         params = {n: self._exec.arg_dict[n]._data for n in self._param_names}
         aux = {n: self._exec.aux_dict[n]._data for n in self._aux_names}
-        if self._fused_moms is None:
-            self._fused_moms = {n: jnp.zeros_like(v)
-                                for n, v in params.items()}
+        if self._fused_states is None:
+            self._fused_states = self._init_fused_states()
         batch = {}
         for name, arr in zip(self._data_names, data_batch.data):
             batch[name] = arr._data if isinstance(arr, NDArray) else \
@@ -260,10 +325,16 @@ class Module(BaseModule):
         for name, arr in zip(self._label_names, data_batch.label or []):
             batch[name] = arr._data if isinstance(arr, NDArray) else \
                 jnp.asarray(arr)
-        o._update_count(0)
-        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler else o.lr
-        new_params, new_aux, self._fused_moms, out = self._fused(
-            params, aux, self._fused_moms, batch, _rnd.next_key(), lr)
+        if self._mesh is not None:
+            from ..parallel.sharding import shard_batch
+
+            batch = {k: shard_batch(self._mesh, v) for k, v in batch.items()}
+        for i in range(len(self._param_names)):
+            o._update_count(i)
+        t = o.num_update
+        lr = o.lr_scheduler(t) if o.lr_scheduler else o.lr
+        new_params, new_aux, self._fused_states, out = self._fused(
+            params, aux, self._fused_states, batch, _rnd.next_key(), lr, t)
         for n, v in new_params.items():
             self._exec.arg_dict[n]._set_data(v)
         for n, v in new_aux.items():
@@ -380,6 +451,18 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        if getattr(self, "_fused_states", None) is not None:
+            # sync live fused states back into the updater structure so
+            # the on-disk format is identical to the split path's
+            import pickle
+
+            o = self._optimizer
+            states = {i: o.fused_state_to_nd(self._fused_states[n],
+                                             self._context[0])
+                      for i, n in enumerate(self._param_names)}
+            with open(fname, "wb") as f:
+                f.write(pickle.dumps(states))
+            return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -388,11 +471,13 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._update_on_kvstore and self._kvstore.updater is not None:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
+        # force the fused path to re-seed from the freshly loaded states
+        self._fused_states = None
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded

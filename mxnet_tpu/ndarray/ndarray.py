@@ -460,13 +460,17 @@ def onehot_encode(indices, out):
 
 def waitall():
     """Block until all pending computation completes (reference
-    ``MXNDArrayWaitAll``).  XLA dispatch is async exactly like the engine."""
+    ``MXNDArrayWaitAll``).  XLA dispatch is async exactly like the
+    engine; this is where deferred execution errors surface, so
+    exceptions propagate to the caller (the reference engine's fatal
+    handler contract, ``threaded_engine.h:347``)."""
     import jax
 
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
+    else:  # older jax: synchronize via a device round-trip
+        jax.device_put(0.0).block_until_ready()
 
 
 # -- save/load: the reference's binary NDArray dict format is replaced by

@@ -117,6 +117,65 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    # -- fused (in-XLA-program) form -----------------------------------
+    # The reference dispatches hot optimizers to fused engine ops
+    # (src/operator/optimizer_op.cc:36,132); here every optimizer exposes
+    # a *pure* update so the whole step — forward, backward, allreduce,
+    # update — compiles into one XLA program (mxnet_tpu/fused.py).
+    #
+    # ``init_fused_state(weight)`` returns a pytree of raw jax arrays
+    # mirroring ``create_state``'s structure; ``fused_update`` maps
+    # (weight, grad, state, lr, wd, t, rng) -> (new_weight, new_state)
+    # where grad is the raw (pre-rescale) gradient, lr/wd already carry
+    # the per-parameter multipliers, and ``t`` is the traced update count
+    # (for bias correction), starting at 1 on the first call.
+
+    def init_fused_state(self, weight):
+        raise NotImplementedError(
+            "%s has no fused form; Module falls back to the split "
+            "update path" % type(self).__name__)
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        raise NotImplementedError
+
+    @property
+    def supports_fused(self):
+        return type(self).fused_update is not Optimizer.fused_update
+
+    def _fused_prep(self, grad):
+        import jax.numpy as jnp
+
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def fused_state_to_nd(self, fused, ctx):
+        """Convert a fused state pytree back to the ``create_state``
+        NDArray structure (for optimizer-state checkpoints)."""
+        return _tree_jax_to_nd(fused, ctx)
+
+    def fused_state_from_nd(self, state):
+        """Convert a ``create_state``-structured state (NDArrays) to the
+        fused raw-jax pytree."""
+        return _tree_nd_to_jax(state)
+
+
+def _tree_jax_to_nd(x, ctx):
+    if x is None:
+        return None
+    if isinstance(x, tuple):
+        return tuple(_tree_jax_to_nd(e, ctx) for e in x)
+    return NDArray(x, ctx)
+
+
+def _tree_nd_to_jax(x):
+    if x is None:
+        return None
+    if isinstance(x, tuple):
+        return tuple(_tree_nd_to_jax(e) for e in x)
+    return x._data if isinstance(x, NDArray) else x
+
 
 @register
 class SGD(Optimizer):
@@ -130,9 +189,12 @@ class SGD(Optimizer):
         self.multi_precision = multi_precision
 
     def create_state(self, index, weight):
+        import jax.numpy as jnp
         import numpy as np
 
-        use_mp = self.multi_precision and weight.dtype == np.float16
+        # half types: fp16 (reference) and bf16 (the TPU-native half)
+        use_mp = self.multi_precision and weight.dtype in (
+            np.float16, jnp.bfloat16)
         mom = zeros(weight.shape, weight.context) \
             if self.momentum != 0.0 else None
         if use_mp:
@@ -160,6 +222,32 @@ class SGD(Optimizer):
         else:
             imperative_invoke("sgd_update", [weight, grad],
                               dict(lr=lr, wd=wd, **kw), out=weight)
+
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        if self.multi_precision and weight.dtype in (jnp.float16,
+                                                     jnp.bfloat16):
+            mom = jnp.zeros(weight.shape, "float32") \
+                if self.momentum != 0.0 else None
+            return (mom, weight.astype("float32"))
+        return jnp.zeros_like(weight) if self.momentum != 0.0 else None
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        g = self._fused_prep(grad)
+        if isinstance(state, tuple):  # multi-precision master weights
+            mom, w32 = state
+            g = g.astype("float32")
+            if mom is not None:
+                new_mom = self.momentum * mom - lr * (g + wd * w32)
+                new_w32 = w32 + new_mom
+                return new_w32.astype(weight.dtype), (new_mom, new_w32)
+            new_w32 = w32 - lr * (g + wd * w32)
+            return new_w32.astype(weight.dtype), (None, new_w32)
+        if state is not None:
+            new_mom = self.momentum * state - lr * (g + wd * weight)
+            return weight + new_mom, new_mom
+        return weight - lr * (g + wd * weight), None
 
 
 @register
@@ -190,6 +278,19 @@ class NAG(Optimizer):
         else:
             weight += -lr * (grad + wd * weight)
 
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(weight) if self.momentum != 0.0 else None
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        g = self._fused_prep(grad)
+        if state is None:
+            return weight - lr * (g + wd * weight), None
+        g = g + wd * weight
+        new_mom = self.momentum * state + g
+        return weight - lr * (g + self.momentum * new_mom), new_mom
+
 
 @register
 class SGLD(Optimizer):
@@ -207,6 +308,17 @@ class SGLD(Optimizer):
         noise = random_normal(loc=0, scale=math.sqrt(lr),
                               shape=weight.shape)
         weight += -lr / 2 * (grad + wd * weight) + noise
+
+    def init_fused_state(self, weight):
+        return None
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax
+
+        g = self._fused_prep(grad)
+        noise = jax.numpy.sqrt(lr) * jax.random.normal(
+            rng, weight.shape, weight.dtype)
+        return weight - lr / 2 * (g + wd * weight) + noise, None
 
 
 @register
@@ -241,6 +353,22 @@ class DCASGD(Optimizer):
         prev[:] = weight
         weight += delta
 
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        mom = jnp.zeros_like(weight) if self.momentum != 0.0 else None
+        # device copy: the state must not alias the (donated) weight buffer
+        return (mom, jnp.copy(weight))
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        g = self._fused_prep(grad)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            new_mom = self.momentum * mom - lr * (comp + wd * weight)
+            return weight + new_mom, (new_mom, weight)
+        return weight - lr * (comp + wd * weight), (None, weight)
+
 
 @register
 class Adam(Optimizer):
@@ -270,6 +398,25 @@ class Adam(Optimizer):
                                beta2=self.beta2, epsilon=self.epsilon,
                                **self._common_kwargs()), out=weight)
 
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return (jnp.zeros_like(weight), jnp.zeros_like(weight))
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        tf = t.astype("float32") if hasattr(t, "astype") else float(t)
+        coef1 = 1.0 - jnp.power(self.beta1, tf)
+        coef2 = 1.0 - jnp.power(self.beta2, tf)
+        lr = lr * jnp.sqrt(coef2) / coef1
+        g = self._fused_prep(grad) + wd * weight
+        mean, var = state
+        new_mean = self.beta1 * mean + (1 - self.beta1) * g
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+        new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + self.epsilon)
+        return new_w, (new_mean, new_var)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -290,6 +437,20 @@ class AdaGrad(Optimizer):
         history += grad * grad
         weight += -lr * (grad / (history + self.float_stable_eps).sqrt()
                          + wd * weight)
+
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(weight)
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_prep(grad)
+        new_hist = state + g * g
+        new_w = weight - lr * (
+            g / jnp.sqrt(new_hist + self.float_stable_eps) + wd * weight)
+        return new_w, new_hist
 
 
 @register
@@ -329,6 +490,34 @@ class RMSProp(Optimizer):
             weight._set_data(
                 weight.clip(-self.clip_weights, self.clip_weights)._data)
 
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        if self.centered:
+            return (jnp.zeros_like(weight), jnp.zeros_like(weight),
+                    jnp.zeros_like(weight))
+        return jnp.zeros_like(weight)
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_prep(grad) + wd * weight
+        if self.centered:
+            n, gs, delta = state
+            new_n = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            new_g = (1 - self.gamma1) * g + self.gamma1 * gs
+            new_delta = (self.gamma2 * delta - lr * g / jnp.sqrt(
+                new_n - jnp.square(new_g) + self.epsilon))
+            new_w = weight + new_delta
+            if self.clip_weights:
+                new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+            return new_w, (new_n, new_g, new_delta)
+        new_n = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * state
+        new_w = weight - lr * g / jnp.sqrt(new_n + self.epsilon)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w, new_n
+
 
 @register
 class AdaDelta(Optimizer):
@@ -355,6 +544,22 @@ class AdaDelta(Optimizer):
             (self.rho * acc_delta + (1 - self.rho) * delta * delta)._data)
         weight += -delta - wd * weight
 
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return (jnp.zeros_like(weight), jnp.zeros_like(weight))
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_prep(grad)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = (jnp.sqrt(acc_delta + self.epsilon) /
+                 jnp.sqrt(new_acc_g + self.epsilon) * g)
+        new_acc_delta = self.rho * acc_delta + (1 - self.rho) * delta * delta
+        return weight - delta - wd * weight, (new_acc_g, new_acc_delta)
+
 
 @register
 class Ftrl(Optimizer):
@@ -375,6 +580,26 @@ class Ftrl(Optimizer):
                           dict(lr=lr, wd=wd, lamda1=self.lamda1,
                                beta=self.beta, **self._common_kwargs()),
                           out=weight)
+
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return (jnp.zeros_like(weight), jnp.zeros_like(weight))
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        g = self._fused_prep(grad)
+        z, n = state
+        new_n = n + jnp.square(g)
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        new_z = z + g - sigma * weight
+        new_w = jnp.where(
+            jnp.abs(new_z) <= self.lamda1,
+            jnp.zeros_like(weight),
+            -(new_z - jnp.sign(new_z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(new_n)) / lr + wd))
+        return new_w, (new_z, new_n)
 
 
 @register
@@ -402,6 +627,24 @@ class Adamax(Optimizer):
 
         u_t._set_data(elemwise_maximum(self.beta2 * u_t, grad.abs())._data)
         weight += -lr * m_t / u_t
+
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return (jnp.zeros_like(weight), jnp.zeros_like(weight))
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        tf = t.astype("float32") if hasattr(t, "astype") else float(t)
+        lr = lr / (1.0 - jnp.power(self.beta1, tf))
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        new_m = self.beta1 * m_t + (1 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u_t, jnp.abs(g))
+        return weight - lr * new_m / new_u, (new_m, new_u)
 
 
 @register
@@ -440,6 +683,53 @@ class Nadam(Optimizer):
         m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
         weight += -lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
 
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        # (m, v) mirror create_state; the scalar m_schedule rides along in
+        # the fused state (the split path keeps it on the optimizer object
+        # and, like the reference, loses it across checkpoints).
+        # Divergence note: the reference multiplies the SHARED m_schedule
+        # once per parameter per step (update() is called per index), so
+        # its trajectory depends on parameter iteration order.  The fused
+        # form keeps a per-parameter schedule — the Nadam paper's actual
+        # recursion — so fused and split trajectories differ slightly.
+        return (jnp.zeros_like(weight), jnp.zeros_like(weight),
+                jnp.asarray(1.0, "float32"))
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        import jax.numpy as jnp
+
+        tf = t.astype("float32") if hasattr(t, "astype") else float(t)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (
+            1. - 0.5 * jnp.power(0.96, tf * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (
+            1. - 0.5 * jnp.power(0.96, (tf + 1) * self.schedule_decay))
+        m_t, v_t, m_schedule = state
+        m_schedule = m_schedule * momentum_t
+        m_schedule_next = m_schedule * momentum_t_1
+        grad_prime = g / (1. - m_schedule)
+        new_m = self.beta1 * m_t + (1. - self.beta1) * g
+        new_v = self.beta2 * v_t + (1. - self.beta2) * g * g
+        m_t_prime = new_m / (1. - m_schedule_next)
+        v_t_prime = new_v / (1. - jnp.power(self.beta2, tf))
+        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        new_w = weight - lr * m_t_bar / (jnp.sqrt(v_t_prime) + self.epsilon)
+        return new_w, (new_m, new_v, m_schedule)
+
+    def fused_state_to_nd(self, fused, ctx):
+        m, v, _ = fused
+        return (NDArray(m, ctx), NDArray(v, ctx))
+
+    def fused_state_from_nd(self, state):
+        import jax.numpy as jnp
+
+        m, v = state
+        return (m._data, v._data, jnp.asarray(self.m_schedule, "float32"))
+
 
 @register
 class Test(Optimizer):
@@ -451,6 +741,15 @@ class Test(Optimizer):
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad
         state[:] = weight
+
+    def init_fused_state(self, weight):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(weight)
+
+    def fused_update(self, weight, grad, state, lr, wd, t, rng):
+        new_w = weight + grad * self.rescale_grad
+        return new_w, new_w
 
 
 ccSGD = SGD

@@ -47,25 +47,48 @@ def reduce_scatter(x, axis_name, scatter_dimension=0):
 
 # -- imperative-boundary allreduce (KVStore push path) ---------------------
 
-def allreduce_nd(arr):
-    """All-reduce an NDArray across worker processes.
+def allreduce_nd(arr, mesh=None):
+    """All-reduce an NDArray across the active reduction domain.
 
-    Single process (the usual SPMD single-controller case): identity —
-    when the train step is jitted over a mesh with the batch sharded on
-    the 'data' axis, XLA already inserted the ICI all-reduce inside the
-    step; there is nothing left to reduce at the host level.
+    Three cases, mirroring where the reference reduces gradients
+    (``src/kvstore/comm.h`` tree + ps-lite push):
 
-    Multi-process (multi-host without a shared jit): sums the per-process
-    values over DCN via the multihost allgather utility.
+    1. **In-chip SPMD (single controller, mesh active)** — when the train
+       step is jitted over a mesh with the batch sharded on the 'data'
+       axis, XLA already inserted the ICI all-reduce inside the step and
+       a pushed gradient is the *global*-batch gradient: identity.
+       If, however, the caller hands per-chip partial gradients stacked
+       on a leading axis that is sharded over the mesh's data axis (the
+       analogue of the reference's per-device gradient list), they are
+       summed on-device into a replicated result.
+    2. **Multi-process (multi-host)** — per-process values are summed
+       over DCN via the multihost allgather utility.
+    3. Single process, no mesh — identity.
     """
     import jax
 
+    from ..ndarray.ndarray import NDArray
+
+    x = arr._data
+    if mesh is not None and mesh.shape.get("data", 1) > 1 and \
+            x.ndim >= 1 and x.shape[0] == mesh.shape["data"]:
+        sh = getattr(x, "sharding", None)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # contract: partials are STACKED on a leading axis laid out
+        # exactly over the mesh data axis (spec[0] == 'data'); anything
+        # else — replicated global grads, batch-sharded activations — is
+        # not a partial-gradient stack and falls through
+        if isinstance(sh, NamedSharding) and len(sh.spec) >= 1 and \
+                sh.spec[0] == "data":
+            summed = jax.jit(
+                lambda v: v.sum(axis=0),
+                out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+            return NDArray(summed, arr.context)
     if jax.process_count() == 1:
         return arr
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(arr._data)
+    gathered = multihost_utils.process_allgather(x)
     summed = gathered.sum(axis=0)
-    from ..ndarray.ndarray import NDArray
-
     return NDArray(jax.device_put(summed), arr.context)
