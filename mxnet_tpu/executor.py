@@ -12,10 +12,12 @@ buffer assignment and rematerialization replace PlanMemory and the
 ``MXNET_BACKWARD_DO_MIRROR`` mirror pass; bulk-exec segments are moot since
 the whole graph is a single executable (SURVEY.md §7 item 5).
 
-The ``Forward``/``Backward`` split API is preserved: ``forward`` runs the
-forward executable; ``backward`` runs the fused executable seeded with head
-gradients and scatters into the grad arrays honoring ``grad_req``
-(write/add/null — reference ``kWriteTo/kAddTo/kNullOp``).
+The ``Forward``/``Backward`` split API is preserved: ``forward(is_train=
+True)`` runs a jitted program that also produces the vjp (residuals =
+saved activations); ``backward`` applies the cached vjp seeded with head
+gradients — no forward recompute — and scatters into the grad arrays
+honoring ``grad_req`` (write/add/null — reference
+``kWriteTo/kAddTo/kNullOp``).
 """
 from __future__ import annotations
 
@@ -100,23 +102,32 @@ class Executor:
                      if grad_req.get(n, "null") != "null"]
         self._grad_args = grad_args
 
-        def fwd_bwd(args, aux, rng, head_grads):
+        # Training forward computes the outputs AND the vjp in one pass;
+        # the vjp is a jax Partial pytree (residual arrays + static
+        # closed jaxpr) that crosses the jit boundary, so ``backward``
+        # applies it WITHOUT re-running the forward — the analogue of the
+        # reference's cached fwd+bwd graph (``InitCachedOps``) with the
+        # residuals playing the role of the saved activations.
+        def fwd_vjp(args, aux, rng):
             const_args = {n: v for n, v in args.items() if n not in grad_args}
 
-            def loss_fn(garg_vals):
+            def run(garg_vals):
                 full = dict(const_args)
                 full.update(garg_vals)
-                outs, new_aux = self._fwd_train_fn(full, aux, rng)
-                return outs, new_aux
+                return self._fwd_train_fn(full, aux, rng)
 
             gvals = {n: args[n] for n in grad_args}
-            (outs, new_aux), vjp = jax.vjp(loss_fn, gvals)
+            (outs, new_aux), vjp = jax.vjp(run, gvals)
+            return outs, new_aux, vjp
+
+        def bwd(vjp, head_grads, new_aux):
             grads, = vjp((head_grads, jax.tree.map(
                 lambda x: jax.numpy.zeros_like(x), new_aux)))
-            return outs, new_aux, grads
+            return grads
 
-        self._jit_fwd_bwd = jax.jit(fwd_bwd)
-        self._last_run = None  # (args jax dict, aux jax dict, rng)
+        self._jit_fwd_vjp = jax.jit(fwd_vjp)
+        self._jit_bwd = jax.jit(bwd)
+        self._last_vjp = None  # (vjp Partial, new_aux dict)
 
     # ------------------------------------------------------------------
     @property
@@ -149,12 +160,17 @@ class Executor:
         args = {n: a._data for n, a in self.arg_dict.items()}
         aux = {n: a._data for n, a in self.aux_dict.items()}
         rng = _random.next_key()
-        fn = self._jit_train if is_train else self._jit_eval
-        outs, new_aux = fn(args, aux, rng)
+        if is_train and self._grad_args:
+            outs, new_aux, vjp = self._jit_fwd_vjp(args, aux, rng)
+            self._last_vjp = (vjp, new_aux)
+        else:
+            fn = self._jit_train if is_train else self._jit_eval
+            outs, new_aux = fn(args, aux, rng)
+            if is_train:
+                self._train_fwd_ran = True
         if is_train:
             for n, v in new_aux.items():
                 self.aux_dict[n]._set_data(v)
-            self._last_run = (args, aux, rng)
         from .ndarray.ndarray import NDArray as _ND
 
         self.outputs = [_ND(o, self._ctx) for o in outs]
@@ -164,18 +180,21 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):
-        """Compute gradients into ``grad_dict`` honoring grad_req.  Runs the
-        fused forward+backward executable (XLA dedups the forward work it
-        can reuse; the extra forward flops are traded for a single fused
-        program — the TPU-idiomatic form of the reference's cached
-        fwd+bwd graph)."""
+        """Compute gradients into ``grad_dict`` honoring grad_req.
+
+        Applies the vjp cached by ``forward(is_train=True)`` — the
+        forward is NOT re-run; the saved residuals are consumed exactly
+        like the reference's backward over the cached fwd+bwd graph."""
         import jax.numpy as jnp
 
         from .ndarray.ndarray import NDArray
 
-        if self._last_run is None:
+        if self._last_vjp is None:
+            if not self._grad_args and getattr(self, "_train_fwd_ran",
+                                               False):
+                return  # all grad_req 'null': backward is a no-op (kNullOp)
             raise MXNetError("backward called before forward(is_train=True)")
-        args, aux, rng = self._last_run
+        vjp, new_aux = self._last_vjp
         # head gradients: default ones (loss heads use their own custom vjp)
         out_shapes = [o._data for o in self.outputs]
         if out_grads is None:
@@ -187,7 +206,7 @@ class Executor:
                 jnp.ones_like(o) if g is None else
                 (g._data if isinstance(g, NDArray) else jnp.asarray(g))
                 for o, g in zip(out_shapes, out_grads))
-        outs, new_aux, grads = self._jit_fwd_bwd(args, aux, rng, heads)
+        grads = self._jit_bwd(vjp, heads, new_aux)
         for n, g in grads.items():
             tgt = self.grad_dict.get(n)
             if tgt is None:

@@ -329,10 +329,13 @@ class Module(BaseModule):
             from ..parallel.sharding import shard_batch
 
             batch = {k: shard_batch(self._mesh, v) for k, v in batch.items()}
+        # split-path parity: the scheduler is consulted at the
+        # PRE-increment num_update (Optimizer.update calls _get_lr before
+        # _update_count); bias-correction t is the POST-increment count
+        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler else o.lr
         for i in range(len(self._param_names)):
             o._update_count(i)
         t = o.num_update
-        lr = o.lr_scheduler(t) if o.lr_scheduler else o.lr
         new_params, new_aux, self._fused_states, out = self._fused(
             params, aux, self._fused_states, batch, _rnd.next_key(), lr, t)
         for n, v in new_params.items():

@@ -11,6 +11,8 @@ globally reduced by the compiler.
 """
 from __future__ import annotations
 
+import functools
+
 from ..base import MXNetError
 
 __all__ = ["allreduce_nd", "psum", "all_gather", "ppermute",
@@ -47,6 +49,17 @@ def reduce_scatter(x, axis_name, scatter_dimension=0):
 
 # -- imperative-boundary allreduce (KVStore push path) ---------------------
 
+@functools.lru_cache(maxsize=8)
+def _stacked_sum(mesh):
+    """Per-mesh cached executable summing stacked partial gradients to a
+    replicated result (jit caches by function identity, so the jitted fn
+    must be reused across pushes)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.jit(lambda v: v.sum(axis=0),
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
 def allreduce_nd(arr, mesh=None):
     """All-reduce an NDArray across the active reduction domain.
 
@@ -81,9 +94,7 @@ def allreduce_nd(arr, mesh=None):
         # not a partial-gradient stack and falls through
         if isinstance(sh, NamedSharding) and len(sh.spec) >= 1 and \
                 sh.spec[0] == "data":
-            summed = jax.jit(
-                lambda v: v.sum(axis=0),
-                out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+            summed = _stacked_sum(mesh)(x)
             return NDArray(summed, arr.context)
     if jax.process_count() == 1:
         return arr
