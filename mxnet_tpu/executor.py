@@ -161,6 +161,9 @@ class Executor:
         aux = {n: a._data for n, a in self.aux_dict.items()}
         rng = _random.next_key()
         if is_train and self._grad_args:
+            # release the previous step's residuals before the new forward
+            # (holding them would double peak activation memory)
+            self._last_vjp = None
             outs, new_aux, vjp = self._jit_fwd_vjp(args, aux, rng)
             self._last_vjp = (vjp, new_aux)
         else:

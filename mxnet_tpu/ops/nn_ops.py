@@ -46,10 +46,11 @@ def _fully_connected(attrs, data, weight, *bias):
     """Reference ``src/operator/fully_connected.cc``: Y = X W^T + b."""
     if bool(attrs.get("flatten", True)) and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
+    # bf16 inputs produce bf16 outputs; the MXU accumulates in fp32
+    # internally, and an explicit preferred_element_type=f32 would break
+    # the conv/dot transpose rules (f32 cotangent vs bf16 operand)
     out = lax.dot_general(
-        data, weight, (((data.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+        data, weight, (((data.ndim - 1,), (1,)), ((), ())))
     if bias:
         out = out + bias[0]
     return out
@@ -85,8 +86,7 @@ def _convolution(attrs, data, weight, *bias):
         rhs_dilation=dilate,
         dimension_numbers=_conv_dims(nd),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
-    ).astype(data.dtype)
+    )
     if bias:
         b = bias[0].reshape((1, -1) + (1,) * nd)
         out = out + b
@@ -406,9 +406,13 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     # reduction); normalization math back in the data dtype so bf16
     # activations stay bf16 into the next conv
     if is_train:
-        data32 = data.astype(jnp.float32)
-        mean = jnp.mean(data32, axis=reduce_axes)
-        var = jnp.var(data32, axis=reduce_axes)
+        # fp32-accumulated moments without materializing an fp32 copy of
+        # the activations (E[x^2]-E[x]^2 keeps the two reductions fused
+        # over the bf16 input — HBM traffic stays half-width)
+        mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
+        mean_sq = jnp.mean(jnp.square(data.astype(jnp.float32)),
+                           axis=reduce_axes)
+        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
         # keep the aux-state dtype stable: cast the fp32 batch stats to the
         # moving buffers' dtype before blending, else bf16 aux would drift
         # to fp32 after one step (retraces + checkpoint dtype mismatch)

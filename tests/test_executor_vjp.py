@@ -26,6 +26,16 @@ def test_backward_uses_cached_vjp():
     # gradients are populated and finite
     g = ex.grad_dict["fc_weight"].asnumpy()
     assert np.isfinite(g).all() and (g != 0).any()
+    # behavioral no-recompute check: the bwd program must contain only
+    # the backward matmuls (wgrad for the single FC = 1 dot); a
+    # forward-recompute implementation would carry the forward dot too
+    import jax.numpy as jnp
+
+    vjp, new_aux = ex._last_vjp
+    heads = (jnp.ones((4, 8), "float32"),)
+    text = ex._jit_bwd.lower(vjp, heads, new_aux).as_text()
+    assert text.count("dot_general") <= 1, \
+        "bwd program re-runs forward matmuls:\n%s" % text
 
 
 def test_backward_before_forward_raises():

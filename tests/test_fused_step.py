@@ -166,3 +166,33 @@ def test_no_recompute_single_execution_per_step():
     # jax caches by (shapes, dtypes): compiling happened once
     sizes = mod._fused._jit_step._cache_size()
     assert sizes == 1, "expected a single cached executable, got %r" % sizes
+
+
+def test_mixed_precision_bf16_compute():
+    """compute_dtype='bfloat16': fp32 master weights, bf16 forward; the
+    step trains and keeps params fp32 (mp_sgd_* contract on TPU)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.fused import TrainStep
+
+    sym = _mlp_sym()
+    step = TrainStep(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1,
+                                       "momentum": 0.9},
+                     compute_dtype="bfloat16")
+    shapes = {"data": (16, 8), "softmax_label": (16,)}
+    params, aux, states = step.init_state(shapes)
+    import jax
+
+    rng = jax.random.PRNGKey(0)
+    bd = {"data": jax.random.normal(rng, (16, 8), "float32"),
+          "softmax_label": jnp.zeros((16,), "float32")}
+    p0 = {k: np.asarray(v) for k, v in params.items()}
+    for _ in range(3):
+        params, aux, states, out = step(params, aux, states, bd, rng)
+    assert out.dtype == jnp.bfloat16
+    for k, v in params.items():
+        assert v.dtype == jnp.float32, k
+        assert np.isfinite(np.asarray(v, "float32")).all()
+    assert not np.allclose(p0["fc1_weight"],
+                           np.asarray(params["fc1_weight"]))
