@@ -35,6 +35,7 @@ from . import module
 from . import module as mod
 from .module import Module
 from . import parallel
+from . import test_utils
 from .model import save_checkpoint, load_checkpoint
 
 __version__ = "0.1.0"

@@ -406,13 +406,20 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     # reduction); normalization math back in the data dtype so bf16
     # activations stay bf16 into the next conv
     if is_train:
-        # fp32-accumulated moments without materializing an fp32 copy of
-        # the activations (E[x^2]-E[x]^2 keeps the two reductions fused
-        # over the bf16 input — HBM traffic stays half-width)
-        mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
-        mean_sq = jnp.mean(jnp.square(data.astype(jnp.float32)),
-                           axis=reduce_axes)
-        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+        if data.dtype == jnp.bfloat16:
+            # fp32-accumulated moments without materializing an fp32 copy
+            # of the activations (keeps the reductions fused over the
+            # bf16 input — HBM traffic stays half-width).  E[x^2]-E[x]^2
+            # cancellation is bounded by bf16 input precision here; the
+            # fp32 path below keeps the stable two-pass form.
+            mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
+            mean_sq = jnp.mean(jnp.square(data.astype(jnp.float32)),
+                               axis=reduce_axes)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+        else:
+            data32 = data.astype(jnp.float32)
+            mean = jnp.mean(data32, axis=reduce_axes)
+            var = jnp.var(data32, axis=reduce_axes)
         # keep the aux-state dtype stable: cast the fp32 batch stats to the
         # moving buffers' dtype before blending, else bf16 aux would drift
         # to fp32 after one step (retraces + checkpoint dtype mismatch)

@@ -1,0 +1,330 @@
+"""Test harness utilities.
+
+Reference: ``python/mxnet/test_utils.py`` — the de-facto op-validation
+toolkit (SURVEY.md §4): ``check_numeric_gradient`` (``:620``) runs a
+finite-difference check of any symbol's gradients,
+``check_symbolic_forward/backward`` (``:744,:809``) compare against numpy
+references, and ``check_consistency`` (``:987``) cross-validates one
+symbol across context/dtype combos (the reference's CPU↔GPU pattern; here
+dtype combos and, when available, cpu↔tpu).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array, zeros
+
+__all__ = [
+    "default_context", "set_default_context", "same", "almost_equal",
+    "assert_almost_equal", "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
+    "rand_ndarray", "random_arrays", "simple_forward", "numeric_grad",
+    "check_numeric_gradient", "check_symbolic_forward",
+    "check_symbolic_backward", "check_consistency", "check_speed",
+]
+
+_default_ctx = None
+
+
+def default_context():
+    """The context tests run on (reference ``default_context()``,
+    env-switchable)."""
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    a, b = _as_np(a), _as_np(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        idx = np.unravel_index(
+            np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
+        raise AssertionError(
+            "%s and %s differ: max |diff| %g at %s (rtol=%g atol=%g)\n%r\n%r"
+            % (names[0], names[1], np.max(np.abs(a - b)), idx, rtol, atol,
+               a, b))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(np.random.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None):
+    return array(np.random.uniform(-1, 1, size=shape).astype(dtype),
+                 ctx or default_context())
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype("float32") if s else
+              np.array(np.random.randn(), "float32") for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def _highest_precision():
+    """Numeric checks compare against fp32 numpy references, so force
+    full-precision matmuls for their executors (TPUs default to
+    bf16-accumulated fp32 matmuls, ~1e-2 relative error)."""
+    import jax
+
+    return jax.default_matmul_precision("highest")
+
+
+def _with_highest_precision(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _highest_precision():
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+def _parse_location(sym, location, ctx):
+    """location: dict name->np/NDArray, or list in list_arguments order."""
+    if isinstance(location, dict):
+        bad = set(location) - set(sym.list_arguments())
+        if bad:
+            raise MXNetError("location has unknown arguments %s" % bad)
+        loc = location
+    else:
+        loc = dict(zip(sym.list_arguments(), location))
+    return {k: (v if isinstance(v, NDArray) else array(v, ctx))
+            for k, v in loc.items()}
+
+
+def _parse_aux(sym, aux_states, ctx):
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, dict):
+        aux = aux_states
+    else:
+        aux = dict(zip(sym.list_auxiliary_states(), aux_states))
+    return {k: (v if isinstance(v, NDArray) else array(v, ctx))
+            for k, v in aux.items()}
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol with the given inputs; returns numpy output(s)."""
+    ctx = ctx or default_context()
+    ex = sym.bind(ctx, args=_parse_location(sym, inputs, ctx))
+    outs = [o.asnumpy() for o in ex.forward(is_train=is_train)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences of sum(outputs) wrt each location entry
+    (reference ``numeric_grad``, ``test_utils.py:573``)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().copy()
+        g = np.zeros_like(base, dtype="float64")
+        flat = base.reshape(-1)
+        gf = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps / 2
+            executor.arg_dict[name][:] = base.reshape(base.shape)
+            outs = executor.forward(is_train=use_forward_train)
+            fp = sum(o.asnumpy().astype("float64").sum() for o in outs)
+            flat[i] = orig - eps / 2
+            executor.arg_dict[name][:] = base.reshape(base.shape)
+            outs = executor.forward(is_train=use_forward_train)
+            fm = sum(o.asnumpy().astype("float64").sum() for o in outs)
+            flat[i] = orig
+            executor.arg_dict[name][:] = base.reshape(base.shape)
+            gf[i] = (fp - fm) / eps
+        grads[name] = g.astype("float32")
+    return grads
+
+
+@_with_highest_precision
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None,
+                           use_forward_train=True):
+    """Finite-difference check of a symbol's gradients (reference
+    ``check_numeric_gradient``, ``test_utils.py:620``).
+
+    The analytic gradient of ``sum(outputs)`` from ``Executor.backward``
+    must match central differences for every (or each of ``grad_nodes``)
+    argument.
+    """
+    ctx = ctx or default_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states, ctx)
+    if grad_nodes is None:
+        grad_nodes = [n for n in loc
+                      if n in sym.list_arguments()]
+    grad_req = {n: ("write" if n in grad_nodes else "null")
+                for n in sym.list_arguments()}
+
+    args = {n: loc[n].copy() for n in loc}
+    grad_dict = {n: zeros(loc[n].shape, ctx) for n in grad_nodes}
+    ex = sym.bind(ctx, args=args, args_grad=grad_dict, grad_req=grad_req,
+                  aux_states={n: a.copy() for n, a in aux.items()} or None)
+    ex.forward(is_train=use_forward_train)
+    ex.backward()
+    analytic = {n: grad_dict[n].asnumpy() for n in grad_nodes}
+
+    # fresh executor for the numeric pass (aux must not carry train-mode
+    # updates from the analytic pass)
+    ex_num = sym.bind(ctx, args={n: loc[n].copy() for n in loc},
+                      aux_states={n: a.copy() for n, a in aux.items()}
+                      or None, grad_req={n: "null" for n in
+                                         sym.list_arguments()})
+    numeric = numeric_grad(ex_num, {n: loc[n] for n in grad_nodes},
+                           eps=numeric_eps,
+                           use_forward_train=use_forward_train)
+    for name in grad_nodes:
+        assert_almost_equal(analytic[name], numeric[name], rtol=rtol,
+                            atol=atol if atol is not None else 1e-4,
+                            names=("analytic d%s" % name,
+                                   "numeric d%s" % name))
+
+
+@_with_highest_precision
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None, is_train=False):
+    """Compare a symbol's outputs against numpy references (reference
+    ``check_symbolic_forward``, ``test_utils.py:744``)."""
+    ctx = ctx or default_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states, ctx)
+    ex = sym.bind(ctx, args=loc, aux_states=aux or None,
+                  grad_req={n: "null" for n in sym.list_arguments()})
+    outputs = ex.forward(is_train=is_train)
+    if isinstance(expected, dict):
+        expected = [expected[n] for n in sym.list_outputs()]
+    for out, exp, name in zip(outputs, expected, sym.list_outputs()):
+        assert_almost_equal(out, exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-5,
+                            names=("forward[%s]" % name, "expected"))
+    return [o.asnumpy() for o in outputs]
+
+
+@_with_highest_precision
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Compare a symbol's input gradients against numpy references
+    (reference ``check_symbolic_backward``, ``test_utils.py:809``)."""
+    ctx = ctx or default_context()
+    loc = _parse_location(sym, location, ctx)
+    aux = _parse_aux(sym, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    if isinstance(grad_req, str):
+        grad_req = {n: (grad_req if n in expected else "null")
+                    for n in sym.list_arguments()}
+    grad_dict = {n: zeros(loc[n].shape, ctx) for n in expected}
+    ex = sym.bind(ctx, args=loc, args_grad=grad_dict, grad_req=grad_req,
+                  aux_states=aux or None)
+    ex.forward(is_train=True)
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    if out_grads is not None:
+        out_grads = [g if isinstance(g, NDArray) else array(g, ctx)
+                     for g in out_grads]
+    ex.backward(out_grads)
+    for name, exp in expected.items():
+        assert_almost_equal(grad_dict[name], exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-5,
+                            names=("grad[%s]" % name, "expected"))
+    return {n: grad_dict[n].asnumpy() for n in expected}
+
+
+@_with_highest_precision
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      rtol=1e-3, atol=1e-4):
+    """Run one symbol across several context/dtype configs and
+    cross-compare outputs and gradients (reference ``check_consistency``,
+    ``test_utils.py:987`` — the CPU↔GPU validation pattern; here the
+    combos are (ctx, dtype) dicts with a ``ctx`` key and shape kwargs).
+    """
+    if len(ctx_list) < 2:
+        raise MXNetError("check_consistency needs >= 2 configs")
+    arg_names = sym.list_arguments()
+    # generate inputs once, from the first config's shapes
+    shapes = {k: v for k, v in ctx_list[0].items()
+              if k not in ("ctx", "type_dict")}
+    inputs = {n: np.random.normal(size=shapes[n], scale=scale)
+              .astype("float64") for n in shapes if n in arg_names}
+    results = []
+    for cfg in ctx_list:
+        ctx = cfg.get("ctx", default_context())
+        type_dict = cfg.get("type_dict", {})
+        loc = {n: array(v.astype(type_dict.get(n, "float32")), ctx)
+               for n, v in inputs.items()}
+        # params not in shapes get zeros
+        full_shapes = dict(shapes)
+        ex = sym.simple_bind(ctx, grad_req=grad_req, **full_shapes)
+        for n, v in loc.items():
+            ex.arg_dict[n][:] = v.asnumpy()
+        outs = [o.asnumpy().astype("float64")
+                for o in ex.forward(is_train=True)]
+        ex.backward()
+        grads = {n: g.asnumpy().astype("float64")
+                 for n, g in ex.grad_dict.items() if g is not None}
+        results.append((outs, grads))
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for a, b in zip(ref_outs, outs):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                names=("ctx0 out", "ctxN out"))
+        for n in ref_grads:
+            assert_almost_equal(ref_grads[n], grads[n], rtol=rtol,
+                                atol=atol, names=("ctx0 d%s" % n,
+                                                  "ctxN d%s" % n))
+    return results
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
+                **shapes):
+    """Time N forward+backward executions (reference ``check_speed``,
+    ``test_utils.py:913``)."""
+    import time
+
+    ctx = ctx or default_context()
+    if location is None:
+        ex = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+    else:
+        loc = _parse_location(sym, location, ctx)
+        grad_dict = {n: zeros(v.shape, ctx) for n, v in loc.items()}
+        ex = sym.bind(ctx, args=loc, args_grad=grad_dict, grad_req=grad_req)
+    ex.forward(is_train=True)
+    ex.backward()
+    for o in ex.outputs:
+        o.wait_to_read()
+    tic = time.time()
+    for _ in range(N):
+        ex.forward(is_train=True)
+        ex.backward()
+    for o in ex.outputs:
+        o.wait_to_read()
+    return (time.time() - tic) / N
