@@ -103,13 +103,28 @@ class KVStore:
         over the mesh data axis (ICI collective).  ``priority`` is accepted
         for API parity; XLA's scheduler owns collective ordering.
         """
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, values = self._normalize(key, value, allow_list=True)
         for k, vs in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
             merged = self._reduce(vs)
             if self._is_dist:
-                merged = self._cross_replica_sum(merged)
+                if isinstance(merged, BaseSparseNDArray):
+                    import jax
+
+                    if jax.process_count() > 1:
+                        # multi-host: densify, reduce over DCN, re-sparsify
+                        # (ragged per-host nnz cannot ride the dense
+                        # allgather directly)
+                        from .ndarray.sparse import cast_storage
+
+                        stype = merged.stype
+                        dense = self._cross_replica_sum(merged.todense())
+                        merged = cast_storage(dense, stype)
+                else:
+                    merged = self._cross_replica_sum(merged)
             if self._updater is not None:
                 self._updater(self._key_index(k), merged, self._store[k])
             else:
@@ -127,23 +142,70 @@ class KVStore:
                 src.copyto(tgt)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in ``row_ids`` (reference PullRowSparse).
-        Dense store + gather keeps shapes static for XLA."""
+        """Pull only the rows in ``row_ids`` (reference ``PullRowSparse``,
+        ``src/kvstore/kvstore_dist.h:346-385``).  The store keeps weights
+        dense; requested rows are gathered with static shapes.  ``out``
+        may be a RowSparseNDArray (filled with deduped sorted rows — the
+        reference's unique-keys contract) or a dense NDArray."""
+        import numpy as np
+
+        from .ndarray.sparse import RowSparseNDArray
+
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
         keys, outs = self._normalize(key, out, allow_list=True)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        import jax.numpy as jnp
+
         for k, os_, rid in zip(keys, outs, rids):
             src = self._store[k]
-            rows = imperative_invoke("take", [src, rid], {"axis": 0})[0]
+            orig_ids = np.asarray(
+                rid.asnumpy() if isinstance(rid, NDArray) else rid
+            ).astype("int32")
+
+            def gather(idx_np):
+                idx = jnp.asarray(idx_np, "int32")
+                from .ndarray.sparse import RowSparseNDArray as _RSP
+
+                if isinstance(src, _RSP):
+                    # lookup logical rows in sorted sparse storage
+                    nnz = src._data.shape[0]
+                    if nnz == 0:
+                        return jnp.zeros((len(idx_np),) + src.shape[1:],
+                                         src._data.dtype)
+                    pos = jnp.clip(jnp.searchsorted(src._indices, idx),
+                                   0, nnz - 1)
+                    found = src._indices[pos] == idx
+                    rows = src._data[pos]
+                    return jnp.where(
+                        found.reshape((-1,) + (1,) * (rows.ndim - 1)),
+                        rows, 0)
+                base = src.todense() if isinstance(
+                    src, BaseSparseNDArray) else src
+                return base._data[idx]
+
             targets = os_ if isinstance(os_, (list, tuple)) else [os_]
             for tgt in targets:
-                if tgt.shape == rows.shape:
-                    rows.copyto(tgt)
-                else:  # scatter rows back into a full-shape target
+                if isinstance(tgt, RowSparseNDArray):
+                    # deduped sorted rows (reference unique-keys contract)
+                    uniq = np.unique(orig_ids)
+                    tgt._indices = jnp.asarray(uniq, "int32")
+                    tgt._sp_shape = tuple(src.shape)
+                    tgt._set_data(gather(uniq))
+                elif tgt.shape == (len(orig_ids),) + tuple(src.shape[1:]):
+                    # dense per-request rows, original order incl. dups
+                    tgt._set_data(gather(orig_ids))
+                elif tgt.shape == tuple(src.shape):
+                    # full-shape target: scatter requested rows
+                    uniq = np.unique(orig_ids)
                     tgt[:] = 0.0
                     tgt._set_data(tgt._data.at[
-                        rid._data.astype("int32")].set(rows._data))
+                        jnp.asarray(uniq, "int32")].set(gather(uniq)))
+                else:
+                    raise MXNetError(
+                        "row_sparse_pull: target shape %s matches neither "
+                        "the request (%d rows) nor the store %s"
+                        % (tgt.shape, len(orig_ids), src.shape))
 
     # -- optimizer plumbing --------------------------------------------
     def set_optimizer(self, optimizer):
@@ -206,10 +268,20 @@ class KVStore:
 
     @staticmethod
     def _reduce(vs):
-        if isinstance(vs, NDArray):
+        from .ndarray.sparse import (BaseSparseNDArray, RowSparseNDArray)
+        from .ndarray import sparse as _sp
+
+        if isinstance(vs, NDArray) and not isinstance(vs,
+                                                      BaseSparseNDArray):
+            return vs
+        if isinstance(vs, BaseSparseNDArray):
             return vs
         if len(vs) == 1:
             return vs[0]
+        if all(isinstance(v, RowSparseNDArray) for v in vs):
+            return _sp.add_n(list(vs))  # sparse merge, no densify
+        vs = [v.todense() if isinstance(v, BaseSparseNDArray) else v
+              for v in vs]
         return imperative_invoke("add_n", list(vs), {})[0]
 
     def _cross_replica_sum(self, arr):

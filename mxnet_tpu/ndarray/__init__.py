@@ -89,9 +89,22 @@ def _init_module():
 _init_module()
 
 
+from . import sparse  # noqa: E402  (storage types; reference nd.sparse)
+from .sparse import BaseSparseNDArray, CSRNDArray, RowSparseNDArray  # noqa
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        return sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                          transpose_b=transpose_b)
     return imperative_invoke("dot", [lhs, rhs], {
         "transpose_a": transpose_a, "transpose_b": transpose_b})[0]
+
+
+def cast_storage(arr, stype):
+    """Convert an array's storage type (reference ``nd.cast_storage``)."""
+    return sparse.cast_storage(arr, stype)
 
 
 def split(data, num_outputs, axis=1, squeeze_axis=False, **kwargs):

@@ -107,6 +107,19 @@ class NDArray:
         """The raw jax.Array (stands in for the C-ABI NDArrayHandle)."""
         return self._data
 
+    stype = "default"
+
+    def tostype(self, stype):
+        """Convert storage type (reference ``NDArray.tostype``)."""
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+
+        return cast_storage(self, stype)
+
+    def todense(self):
+        return self
+
     # -- sync & host transfer ----------------------------------------------
     def wait_to_read(self):
         import jax

@@ -1,0 +1,442 @@
+"""Sparse NDArray storage types: ``row_sparse`` and ``csr``.
+
+Reference: ``include/mxnet/ndarray.h:82-86`` (storage types),
+``python/mxnet/ndarray/sparse.py`` (``CSRNDArray``/``RowSparseNDArray``),
+and the FComputeEx sparse op set (SURVEY.md Appendix A): dot(csr, dense),
+sparse_retain, square_sum, cast_storage, elemwise add, sparse sgd/adam
+updates, kvstore row-sparse push/pull
+(``src/kvstore/kvstore_dist.h:346-385``).
+
+TPU-first design: a sparse array is a set of **static-shape component
+arrays** (values + indices [+ indptr]) — the ragged encoding the SURVEY
+names hard part (a).  Component shapes are fixed per instance, so every
+sparse kernel jit-compiles per (nnz, dense-shape) exactly like the
+reference's per-shape executable cache; imperative code with varying nnz
+pays a recompile per new nnz, the same trade BucketingModule makes per
+bucket.  Ops that have no sparse implementation fall back to dense
+(reference storage-fallback, ``src/common/utils.h`` SetupDefaultBlobs)
+via ``tostype('default')``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros",
+           "dot", "retain", "square_sum", "elemwise_add", "add_n",
+           "sgd_update", "sgd_mom_update", "adam_update"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior for sparse storage types.
+
+    ``_data`` holds the values component; extra slots carry the index
+    structure.  Dense-only operations transparently fall back through
+    ``tostype('default')`` (storage-fallback semantics).
+    """
+
+    __slots__ = ("_sp_shape", "_indices", "_indptr")
+
+    stype = "undefined"
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def data(self):
+        """The values component (reference ``.data``)."""
+        return NDArray(self._data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, self._ctx)
+
+    def asnumpy(self):
+        return _np.asarray(self._to_dense_jax())
+
+    def todense(self):
+        return self.tostype("default")
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return NDArray(self._to_dense_jax(), self._ctx)
+        return cast_storage(self, stype)
+
+    def copy(self):
+        # fresh wrapper sharing the immutable component buffers (dense
+        # NDArray.copy has the same sharing-safety: mutation rebinds)
+        if isinstance(self, RowSparseNDArray):
+            return RowSparseNDArray(self._data, self._indices,
+                                    self._sp_shape, self._ctx)
+        return CSRNDArray(self._data, self._indices, self._indptr,
+                          self._sp_shape, self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, BaseSparseNDArray):
+            raise MXNetError("copyto between sparse arrays is not "
+                             "supported; use tostype")
+        return self.todense().copyto(other)
+
+    def __repr__(self):
+        return "<%s %s @%s, nnz-storage %s>" % (
+            type(self).__name__, "x".join(map(str, self.shape)), self._ctx,
+            self._data.shape)
+
+    # dense fallback for registry op methods ONLY (reference storage
+    # fallback: cast to dense, run the dense kernel); other attribute
+    # probes (hasattr, pickle/numpy protocols) must fail fast without
+    # densifying
+    def __getattr__(self, name):
+        from ..ops import registry as _reg
+
+        if name.startswith("_") or not _reg.exists(name):
+            raise AttributeError(
+                "'%s' object has no attribute %r"
+                % (type(self).__name__, name))
+        return getattr(self.todense(), name)
+
+    def _binary(self, other, op, scalar_op, rop=False):
+        return self.todense()._binary(other, op, scalar_op, rop=rop)
+
+    def _to_dense_jax(self):
+        raise NotImplementedError
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First dim sparse: ``values[(nnz,) + shape[1:]]`` + sorted unique
+    ``indices[(nnz,)]`` (reference ``kRowSparseStorage``)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, ctx=None):
+        import jax.numpy as jnp
+
+        super().__init__(data, ctx)
+        self._indices = indices.astype(jnp.int32) \
+            if hasattr(indices, "astype") else jnp.asarray(indices, "int32")
+        self._sp_shape = tuple(int(s) for s in shape)
+
+    def _to_dense_jax(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self._sp_shape, self._data.dtype)
+        if self._data.shape[0] == 0:
+            return out
+        return out.at[self._indices].set(self._data)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed sparse row: ``data[(nnz,)]``, ``indices[(nnz,)]``,
+    ``indptr[(m+1,)]`` (reference ``kCSRStorage``)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        import jax.numpy as jnp
+
+        super().__init__(data, ctx)
+        self._indices = jnp.asarray(indices).astype(jnp.int32)
+        self._indptr = jnp.asarray(indptr).astype(jnp.int32)
+        self._sp_shape = tuple(int(s) for s in shape)
+        if len(self._sp_shape) != 2:
+            raise MXNetError("csr storage is 2-D only")
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr, self._ctx)
+
+    def _row_ids(self):
+        """Row id per stored element, from indptr (static nnz)."""
+        import jax.numpy as jnp
+
+        nnz = self._data.shape[0]
+        return (jnp.searchsorted(self._indptr, jnp.arange(nnz),
+                                 side="right") - 1).astype(jnp.int32)
+
+    def _to_dense_jax(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self._sp_shape, self._data.dtype)
+        if self._data.shape[0] == 0:
+            return out
+        return out.at[self._row_ids(), self._indices].set(self._data)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference python/mxnet/ndarray/sparse.py)
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    """Build a RowSparseNDArray from (data, indices) or a dense source."""
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        data = _dense_array(data, ctx, dtype)._data
+        indices = jnp.asarray(_np.asarray(indices), "int32") \
+            if not isinstance(indices, NDArray) else \
+            indices._data.astype("int32")
+        if shape is None:
+            raise MXNetError("shape required with (data, indices)")
+        return RowSparseNDArray(data, indices, shape, ctx)
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else _np.asarray(
+        arg, dtype=dtype or "float32")
+    nz_rows = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0,
+                                axis=1))[0]
+    return RowSparseNDArray(
+        jnp.asarray(dense[nz_rows]), jnp.asarray(nz_rows, "int32"),
+        dense.shape, ctx)
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    """Build a CSRNDArray from (data, indices, indptr) or a dense source."""
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        if shape is None:
+            raise MXNetError("shape required with (data, indices, indptr)")
+        return CSRNDArray(
+            _dense_array(data, ctx, dtype)._data,
+            _np.asarray(indices, "int32"), _np.asarray(indptr, "int32"),
+            shape, ctx)
+    if isinstance(arg, CSRNDArray):
+        return arg
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else _np.asarray(
+        arg, dtype=dtype or "float32")
+    if dense.ndim != 2:
+        raise MXNetError("csr_matrix needs a 2-D source")
+    rows, cols = _np.nonzero(dense)
+    indptr = _np.zeros(dense.shape[0] + 1, "int32")
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr).astype("int32")
+    return CSRNDArray(jnp.asarray(dense[rows, cols]), cols.astype("int32"),
+                      indptr, dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    import jax.numpy as jnp
+
+    ctx = ctx or current_context()
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dtype),
+            jnp.zeros((0,), "int32"), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), "int32"),
+                          jnp.zeros(shape[0] + 1, "int32"), shape, ctx)
+    from .ndarray import zeros as dzeros
+
+    return dzeros(shape, ctx, dtype)
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (reference ``cast_storage`` op,
+    ``src/operator/tensor/cast_storage-inl.h``)."""
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "row_sparse":
+        return row_sparse_array(
+            arr.todense() if isinstance(arr, BaseSparseNDArray) else arr)
+    if stype == "csr":
+        return csr_matrix(
+            arr.todense() if isinstance(arr, BaseSparseNDArray) else arr)
+    raise MXNetError("unknown storage type %r" % stype)
+
+
+# ---------------------------------------------------------------------------
+# sparse kernels (reference FComputeEx set)
+# ---------------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """dot with sparse support: csr x dense and csr^T x dense (reference
+    ``src/operator/tensor/dot-inl.h``); dense falls through to nd.dot."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            raise MXNetError("dot(csr, dense, transpose_b) unsupported")
+        if not isinstance(rhs, NDArray) or isinstance(rhs, BaseSparseNDArray):
+            raise MXNetError("dot(csr, rhs): rhs must be dense")
+        m, k = lhs.shape
+        row_ids = lhs._row_ids()
+        vals, cols, dense = lhs._data, lhs._indices, rhs._data
+        if transpose_a:
+            # out[k, n] = sum over stored (r, c, v): out[c] += v * dense[r]
+            out = jax.ops.segment_sum(
+                vals[:, None] * dense[row_ids], cols, num_segments=k)
+        else:
+            out = jax.ops.segment_sum(
+                vals[:, None] * dense[cols], row_ids, num_segments=m)
+        return NDArray(out, lhs.context)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs,
+                                                        BaseSparseNDArray):
+        raise MXNetError("unsupported sparse dot combination")
+    from . import dot as dense_dot
+
+    return dense_dot(lhs, rhs, transpose_a=transpose_a,
+                     transpose_b=transpose_b)
+
+
+def retain(rsp, row_ids):
+    """Keep only the requested rows (reference ``_sparse_retain``)."""
+    import jax.numpy as jnp
+
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    rid = row_ids._data.astype("int32") if isinstance(row_ids, NDArray) \
+        else jnp.asarray(_np.asarray(row_ids), "int32")
+    # membership of each stored row in row_ids
+    keep = (rsp._indices[:, None] == rid[None, :]).any(axis=1)
+    keep_np = _np.asarray(keep)
+    sel = _np.where(keep_np)[0]
+    return RowSparseNDArray(rsp._data[sel], rsp._indices[sel], rsp.shape,
+                            rsp.context)
+
+
+def square_sum(rsp, axis=None, keepdims=False):
+    """sum(x^2) over a row-sparse array without densifying (reference
+    ``_square_sum``)."""
+    import jax.numpy as jnp
+
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("square_sum expects a RowSparseNDArray")
+    sq = jnp.square(rsp._data)
+    if axis is None:
+        return NDArray(sq.sum(), rsp.context)
+    if axis in (1, -1) and len(rsp.shape) == 2:
+        out = jnp.zeros(rsp.shape[0], rsp._data.dtype)
+        out = out.at[rsp._indices].set(sq.sum(axis=1))
+        if keepdims:
+            out = out[:, None]
+        return NDArray(out, rsp.context)
+    return NDArray(jnp.square(rsp._to_dense_jax()).sum(
+        axis=axis, keepdims=keepdims), rsp.context)
+
+
+def _merge_rsp(arrays):
+    """Sum row-sparse arrays into one with sorted unique indices."""
+    import jax.numpy as jnp
+
+    shape = arrays[0].shape
+    idx = _np.concatenate([_np.asarray(a._indices) for a in arrays])
+    uniq, inv = _np.unique(idx, return_inverse=True)
+    vals = jnp.concatenate([a._data for a in arrays], axis=0)
+    import jax
+
+    summed = jax.ops.segment_sum(vals, jnp.asarray(inv, "int32"),
+                                 num_segments=len(uniq))
+    return RowSparseNDArray(summed, jnp.asarray(uniq, "int32"), shape,
+                            arrays[0].context)
+
+
+def elemwise_add(lhs, rhs):
+    """rsp + rsp stays sparse (reference FComputeEx elemwise_add)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("shape mismatch %s vs %s"
+                             % (lhs.shape, rhs.shape))
+        return _merge_rsp([lhs, rhs])
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return l + r
+
+
+def add_n(*arrays):
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    if all(isinstance(a, RowSparseNDArray) for a in arrays):
+        return _merge_rsp(list(arrays))
+    out = arrays[0].todense() if isinstance(arrays[0], BaseSparseNDArray) \
+        else arrays[0].copy()
+    for a in arrays[1:]:
+        out = elemwise_add(out, a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sparse optimizer updates (reference sparse-aware sgd/adam,
+# src/operator/optimizer_op.cc "lazy update": only rows present in the
+# gradient are touched — weight decay included)
+# ---------------------------------------------------------------------------
+
+def _prep(grad_vals, rescale, clip):
+    import jax.numpy as jnp
+
+    g = grad_vals * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=None, out=None):
+    if not isinstance(grad, RowSparseNDArray):
+        raise MXNetError("sparse.sgd_update expects a row_sparse grad")
+    idx = grad._indices
+    g = _prep(grad._data, rescale_grad, clip_gradient)
+    w = weight._data
+    rows = w[idx]
+    new_rows = rows - lr * (g + wd * rows)
+    new_w = w.at[idx].set(new_rows)
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w)
+    return tgt
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None, out=None):
+    if not isinstance(grad, RowSparseNDArray):
+        raise MXNetError("sparse.sgd_mom_update expects a row_sparse grad")
+    idx = grad._indices
+    g = _prep(grad._data, rescale_grad, clip_gradient)
+    w, m = weight._data, mom._data
+    rows_w, rows_m = w[idx], m[idx]
+    new_m = momentum * rows_m - lr * (g + wd * rows_w)
+    mom._set_data(m.at[idx].set(new_m))
+    new_w = w.at[idx].add(new_m)
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w)
+    return tgt
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                out=None):
+    import jax.numpy as jnp
+
+    if not isinstance(grad, RowSparseNDArray):
+        raise MXNetError("sparse.adam_update expects a row_sparse grad")
+    idx = grad._indices
+    g = _prep(grad._data, rescale_grad, clip_gradient)
+    w = weight._data
+    g = g + wd * w[idx]
+    new_mean_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    new_var_rows = beta2 * var._data[idx] + (1 - beta2) * jnp.square(g)
+    mean._set_data(mean._data.at[idx].set(new_mean_rows))
+    var._set_data(var._data.at[idx].set(new_var_rows))
+    new_w = w.at[idx].add(-lr * new_mean_rows /
+                          (jnp.sqrt(new_var_rows) + epsilon))
+    tgt = out if out is not None else weight
+    tgt._set_data(new_w)
+    return tgt

@@ -206,6 +206,24 @@ class SGD(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         self._update_count(index)
         kw = self._common_kwargs()
+        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray import sparse as _sp
+
+        if isinstance(grad, RowSparseNDArray):
+            # lazy update: only rows present in the gradient are touched
+            # (reference sparse-aware sgd, src/operator/optimizer_op.cc)
+            if isinstance(state, tuple):
+                raise MXNetError("multi-precision sparse sgd unsupported")
+            if state is not None:
+                _sp.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                                   momentum=self.momentum,
+                                   rescale_grad=self.rescale_grad,
+                                   clip_gradient=self.clip_gradient)
+            else:
+                _sp.sgd_update(weight, grad, lr=lr, wd=wd,
+                               rescale_grad=self.rescale_grad,
+                               clip_gradient=self.clip_gradient)
+            return
         if isinstance(state, tuple):
             mom, w32 = state
             if mom is not None:
@@ -393,6 +411,16 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray import sparse as _sp
+
+        if isinstance(grad, RowSparseNDArray):
+            _sp.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
+                            beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=self.clip_gradient)
+            return
         imperative_invoke("adam_update", [weight, grad, mean, var],
                           dict(lr=lr, wd=wd, beta1=self.beta1,
                                beta2=self.beta2, epsilon=self.epsilon,

@@ -1,0 +1,202 @@
+"""Sparse storage types (reference ``tests/python/unittest/test_sparse_*``:
+round trips, FComputeEx kernels, sparse optimizer updates, kvstore
+row-sparse pull, and an embedding-style training loop)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(m, n, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.randn(m, n).astype("float32")
+    d[rng.rand(m, n) > density] = 0.0
+    return d
+
+
+def test_rsp_round_trip():
+    d = _rand_dense(6, 4)
+    d[2] = 0  # a fully-zero row must vanish from storage
+    rsp = sparse.row_sparse_array(d)
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (6, 4)
+    assert rsp.data.shape[0] == len(np.asarray(rsp.indices.asnumpy()))
+    np.testing.assert_allclose(rsp.asnumpy(), d)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), d)
+
+
+def test_csr_round_trip_and_dot():
+    d = _rand_dense(5, 7)
+    csr = sparse.csr_matrix(d)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), d)
+    rhs = np.random.RandomState(1).randn(7, 3).astype("float32")
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5,
+                               atol=1e-5)
+    # transpose_a
+    outT = sparse.dot(csr, mx.nd.array(
+        np.random.RandomState(2).randn(5, 2).astype("float32")),
+        transpose_a=True)
+    assert outT.shape == (7, 2)
+    # dispatch through nd.dot
+    out2 = mx.nd.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out2.asnumpy(), d @ rhs, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_csr_dot_transpose_values():
+    d = _rand_dense(4, 6, seed=3)
+    csr = sparse.csr_matrix(d)
+    rhs = np.random.RandomState(4).randn(4, 3).astype("float32")
+    out = sparse.dot(csr, mx.nd.array(rhs), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), d.T @ rhs, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_retain_and_square_sum():
+    d = _rand_dense(8, 3, seed=5)
+    d[1] += 1.0  # ensure row 1 nonzero
+    rsp = sparse.row_sparse_array(d)
+    kept = sparse.retain(rsp, [1, 4])
+    expect = np.zeros_like(d)
+    for r in (1, 4):
+        expect[r] = d[r]
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+    ss = sparse.square_sum(rsp)
+    np.testing.assert_allclose(float(ss.asnumpy()), (d ** 2).sum(),
+                               rtol=1e-5)
+
+
+def test_elemwise_add_and_add_n_sparse():
+    a = sparse.row_sparse_array(_rand_dense(6, 2, seed=6))
+    b = sparse.row_sparse_array(_rand_dense(6, 2, seed=7))
+    out = sparse.elemwise_add(a, b)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() + b.asnumpy(),
+                               rtol=1e-6)
+    out3 = sparse.add_n(a, b, a)
+    np.testing.assert_allclose(out3.asnumpy(),
+                               2 * a.asnumpy() + b.asnumpy(), rtol=1e-6)
+
+
+def test_sparse_sgd_lazy_update():
+    """Only rows present in the gradient move (lazy update semantics)."""
+    w0 = np.random.RandomState(8).randn(10, 4).astype("float32")
+    w = mx.nd.array(w0)
+    gvals = np.random.RandomState(9).randn(2, 4).astype("float32")
+    grad = sparse.row_sparse_array((gvals, [2, 7]), shape=(10, 4))
+    sparse.sgd_update(w, grad, lr=0.5, wd=0.1)
+    out = w.asnumpy()
+    for r in range(10):
+        if r in (2, 7):
+            i = [2, 7].index(r)
+            np.testing.assert_allclose(
+                out[r], w0[r] - 0.5 * (gvals[i] + 0.1 * w0[r]), rtol=1e-5)
+        else:
+            np.testing.assert_array_equal(out[r], w0[r])
+
+
+def test_sparse_optimizer_dispatch():
+    """Optimizer.update routes row_sparse grads to the sparse kernels."""
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9)
+    w0 = np.random.RandomState(10).randn(6, 3).astype("float32")
+    w = mx.nd.array(w0)
+    state = opt.create_state(0, w)
+    gvals = np.ones((2, 3), "float32")
+    grad = sparse.row_sparse_array((gvals, [0, 3]), shape=(6, 3))
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    assert not np.allclose(out[0], w0[0])
+    np.testing.assert_array_equal(out[1], w0[1])  # untouched row
+    # adam dispatch
+    opt2 = mx.optimizer.Adam(learning_rate=0.1)
+    w2 = mx.nd.array(w0)
+    st2 = opt2.create_state(0, w2)
+    opt2.update(0, w2, grad, st2)
+    assert not np.allclose(w2.asnumpy()[3], w0[3])
+    np.testing.assert_array_equal(w2.asnumpy()[2], w0[2])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.random.RandomState(11).randn(8, 3).astype("float32")
+    kv.init("emb", mx.nd.array(w))
+    out = sparse.zeros("row_sparse", (8, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([5, 1, 5]))
+    # deduped + sorted rows
+    np.testing.assert_array_equal(np.asarray(out.indices.asnumpy()), [1, 5])
+    np.testing.assert_allclose(out.asnumpy()[1], w[1], rtol=1e-6)
+    np.testing.assert_allclose(out.asnumpy()[5], w[5], rtol=1e-6)
+    assert (out.asnumpy()[0] == 0).all()
+    # dense full-shape target: scatter
+    dense_out = mx.nd.zeros((8, 3))
+    kv.row_sparse_pull("emb", out=dense_out, row_ids=mx.nd.array([2]))
+    np.testing.assert_allclose(dense_out.asnumpy()[2], w[2], rtol=1e-6)
+    assert (dense_out.asnumpy()[3] == 0).all()
+
+
+def test_kvstore_sparse_push():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.zeros((6, 2)))
+    g1 = sparse.row_sparse_array(
+        (np.ones((1, 2), "float32"), [1]), shape=(6, 2))
+    g2 = sparse.row_sparse_array(
+        (2 * np.ones((2, 2), "float32"), [1, 4]), shape=(6, 2))
+    kv._set_updater(lambda i, g, w: w.__isub__(
+        g.todense() if hasattr(g, "todense") else g))
+    kv.push(0, [g1, g2])
+    out = mx.nd.zeros((6, 2))
+    kv.pull(0, out)
+    expect = np.zeros((6, 2), "float32")
+    expect[1] = -3.0
+    expect[4] = -2.0
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_matrix_factorization_with_sparse_grads():
+    """Embedding-style workload: MF trained with row_sparse gradients
+    through the sparse Adam kernel converges (reference sparse FM/MF
+    example parity)."""
+    rng = np.random.RandomState(12)
+    n_users, n_items, k = 30, 20, 4
+    true_u = rng.randn(n_users, k).astype("float32")
+    true_v = rng.randn(n_items, k).astype("float32")
+    users = rng.randint(0, n_users, 512)
+    items = rng.randint(0, n_items, 512)
+    ratings = (true_u[users] * true_v[items]).sum(1)
+
+    U = mx.nd.array(0.1 * rng.randn(n_users, k).astype("float32"))
+    V = mx.nd.array(0.1 * rng.randn(n_items, k).astype("float32"))
+    opt = mx.optimizer.Adam(learning_rate=0.05)
+    stU = opt.create_state(0, U)
+    stV = opt.create_state(1, V)
+
+    def loss():
+        pred = (U.asnumpy()[users] * V.asnumpy()[items]).sum(1)
+        return float(((pred - ratings) ** 2).mean())
+
+    l0 = loss()
+    bs = 64
+    for epoch in range(30):
+        for s in range(0, 512, bs):
+            u, it, r = users[s:s+bs], items[s:s+bs], ratings[s:s+bs]
+            Un, Vn = U.asnumpy(), V.asnumpy()
+            err = (Un[u] * Vn[it]).sum(1) - r
+            gu_rows = 2 * err[:, None] * Vn[it] / bs
+            gv_rows = 2 * err[:, None] * Un[u] / bs
+            # accumulate duplicate indices sparsely
+            uu, uinv = np.unique(u, return_inverse=True)
+            gu = np.zeros((len(uu), k), "float32")
+            np.add.at(gu, uinv, gu_rows)
+            vv, vinv = np.unique(it, return_inverse=True)
+            gv = np.zeros((len(vv), k), "float32")
+            np.add.at(gv, vinv, gv_rows)
+            opt.update(0, U, sparse.row_sparse_array(
+                (gu, uu), shape=(n_users, k)), stU)
+            opt.update(1, V, sparse.row_sparse_array(
+                (gv, vv), shape=(n_items, k)), stV)
+    l1 = loss()
+    assert l1 < 0.3 * l0, (l0, l1)
