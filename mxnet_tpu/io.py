@@ -5,8 +5,7 @@ PrefetchingIter, MXDataIter) over the C++ iterator chain in ``src/io/``
 (SURVEY.md §3.5).  The TPU build keeps the iterator-chain design —
 source → batcher → background prefetcher — with the prefetcher as a Python
 thread double-buffering host→device transfers (the role of
-``PrefetcherIter``/``dmlc::ThreadedIter``); the C++ RecordIO reader lives
-in ``mxnet_tpu/recordio.py`` + ``src/`` (native).
+``PrefetcherIter``/``dmlc::ThreadedIter``).
 """
 from __future__ import annotations
 
@@ -272,16 +271,24 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         self._stop.set()
+        # Drain so a worker blocked on a full queue can observe _stop and
+        # exit; it may still enqueue the batch it was holding, so drain
+        # again AFTER the join to guarantee no stale pre-reset batch
+        # survives into the next epoch.
+        self._drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._drain()
+        for i in self.iters:
+            i.reset()
+        self._start()
+
+    def _drain(self):
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        for i in self.iters:
-            i.reset()
-        self._start()
 
     def iter_next(self):
         batches = self._queue.get()

@@ -94,14 +94,16 @@ class KVStore:
                 raise MXNetError("key %r already initialized" % k)
             self._store[k] = v.copy() if isinstance(v, NDArray) else v
 
-    def push(self, key, value, priority=0):
+    def push(self, key, value, priority=0, is_partial_stack=False):
         """Reduce gradients into the store.
 
         ``value`` may be one NDArray or a per-device list (the reference's
         multi-GPU path); lists are tree-added in one fused XLA op.  Under a
         dist type with an active mesh, the merged gradient is all-reduced
-        over the mesh data axis (ICI collective).  ``priority`` is accepted
-        for API parity; XLA's scheduler owns collective ordering.
+        over the mesh data axis (ICI collective).  A caller holding
+        per-chip partials stacked on a leading device axis must pass
+        ``is_partial_stack=True``.  ``priority`` is accepted for API
+        parity; XLA's scheduler owns collective ordering.
         """
         from .ndarray.sparse import BaseSparseNDArray
 
@@ -121,10 +123,13 @@ class KVStore:
                         from .ndarray.sparse import cast_storage
 
                         stype = merged.stype
-                        dense = self._cross_replica_sum(merged.todense())
+                        dense = self._cross_replica_sum(
+                            merged.todense(),
+                            is_partial_stack=is_partial_stack)
                         merged = cast_storage(dense, stype)
                 else:
-                    merged = self._cross_replica_sum(merged)
+                    merged = self._cross_replica_sum(
+                        merged, is_partial_stack=is_partial_stack)
             if self._updater is not None:
                 self._updater(self._key_index(k), merged, self._store[k])
             else:
@@ -149,7 +154,7 @@ class KVStore:
         reference's unique-keys contract) or a dense NDArray."""
         import numpy as np
 
-        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
 
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
@@ -284,13 +289,15 @@ class KVStore:
               for v in vs]
         return imperative_invoke("add_n", list(vs), {})[0]
 
-    def _cross_replica_sum(self, arr):
+    def _cross_replica_sum(self, arr, is_partial_stack=False):
         """All-reduce across replicas: over the active mesh's data axis
-        for per-chip partial gradients (ICI collective), over DCN for
-        multi-process values; identity when the pushed gradient is
+        for per-chip partial gradients (ICI collective, requires the
+        caller to declare the stack via ``is_partial_stack``), over DCN
+        for multi-process values; identity when the pushed gradient is
         already global (the fused SPMD step's case)."""
         from .parallel import collectives
         from .parallel.mesh import current_mesh
 
         mesh = getattr(self, "_mesh", None) or current_mesh()
-        return collectives.allreduce_nd(arr, mesh=mesh)
+        return collectives.allreduce_nd(arr, mesh=mesh,
+                                        is_partial_stack=is_partial_stack)

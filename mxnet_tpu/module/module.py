@@ -131,15 +131,24 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing parameters"
+        if initializer is None:
+            # reference Module.init_params default (module.py:246):
+            # leaving params at their simple_bind zeros would dead-relu
+            # every net whose caller skipped the initializer argument
+            from ..initializer import Uniform
+
+            initializer = Uniform(0.01)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
                 arg_params[name].copyto(arr)
-            elif initializer is not None:
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError("parameter %s missing from arg_params" % name)
+            else:
+                # covers both no-arg_params and allow_missing fine-tune
+                # flows: missing params get the initializer, never zeros
                 desc = InitDesc(name, self._symbol.attr_dict().get(name, {}))
                 initializer(desc, arr)
-            elif not allow_missing and arg_params is not None:
-                raise MXNetError("parameter %s missing from arg_params" % name)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
@@ -329,6 +338,14 @@ class Module(BaseModule):
             from ..parallel.sharding import shard_batch
 
             batch = {k: shard_batch(self._mesh, v) for k, v in batch.items()}
+        else:
+            # load_data semantics: batches follow the module's device, not
+            # the default platform (a cpu-context module on a TPU host gets
+            # NDArrayIter batches materialized on the accelerator)
+            import jax
+
+            dev = self._context[0].jax_device
+            batch = {k: jax.device_put(v, dev) for k, v in batch.items()}
         # split-path parity: the scheduler is consulted at the
         # PRE-increment num_update (Optimizer.update calls _get_lr before
         # _update_count); bias-correction t is the POST-increment count

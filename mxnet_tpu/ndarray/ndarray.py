@@ -183,12 +183,20 @@ class NDArray:
         if isinstance(value, NDArray):
             value = value._data
         if key is Ellipsis or key == slice(None):
+            import jax
+
+            # materialize on THIS array's context device: jnp.* default to
+            # the default platform, which silently migrates a cpu-context
+            # param to the accelerator on multi-platform hosts
+            dev = self._ctx.jax_device
             if _np.isscalar(value):
-                self._set_data(jnp.full(self.shape, value, self.dtype))
+                self._set_data(jax.device_put(
+                    jnp.full(self.shape, value, self.dtype), dev))
             else:
                 arr = _as_jax(value, self.dtype, self._ctx) \
                     if not hasattr(value, "dtype") or isinstance(value, _np.ndarray) else value
-                self._set_data(jnp.broadcast_to(arr, self.shape).astype(self.dtype))
+                self._set_data(jax.device_put(
+                    jnp.broadcast_to(arr, self.shape).astype(self.dtype), dev))
             return
         if isinstance(value, _np.ndarray):
             value = _as_jax(value, self.dtype, self._ctx)

@@ -76,4 +76,6 @@ def expected_inputs(op_name, attrs):
     if op_name in ("SequenceMask", "SequenceLast", "SequenceReverse") and \
             not attrs.get("use_sequence_length"):
         names = ["data"]
+    if op_name == "RNN" and attrs.get("mode", "lstm") != "lstm":
+        names = [n for n in names if n != "state_cell"]
     return tuple(names), tuple(aux)

@@ -1,0 +1,198 @@
+"""Fused multi-layer RNN operator.
+
+Reference: ``src/operator/rnn-inl.h`` (the legacy ``RNN`` op, cuDNN-fused
+LSTM/GRU/vanilla RNN; SURVEY.md §2.1 "Operators — neural net").  The
+reference hands the whole stacked, optionally bidirectional network to one
+cuDNN call over a packed parameter blob.
+
+TPU-native form: one ``lax.scan`` per (layer, direction) with the input
+projection for the *entire sequence* hoisted out of the scan into a single
+batched matmul — the (T·N, G·H) GEMM rides the MXU while the scan carries
+only the (N, G·H) recurrent term.  Gradients fall out of ``jax.vjp``
+through the scan (XLA keeps the standard scan-transpose memory plan);
+there is no hand-written backward like the reference's
+``RNNOp::Backward``.
+
+Parameter packing matches the reference/cuDNN layout so checkpoints and
+``rnn.unfuse()`` slicing line up: for each layer, for each direction,
+``W_x`` then ``W_h`` (row-major, gate-major), then for each layer and
+direction ``b_x`` then ``b_h``.  Gate order: LSTM ``i, f, g, o``; GRU
+``r, z, n``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = ["rnn_param_size", "rnn_gates"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_gates(mode):
+    try:
+        return _GATES[mode]
+    except KeyError:
+        raise MXNetError("RNN mode must be one of %s, got %r"
+                         % (sorted(_GATES), mode)) from None
+
+
+def rnn_param_size(input_size, state_size, num_layers, mode,
+                   bidirectional=False):
+    """Total packed-parameter length (reference ``rnn-inl.h``
+    ``GetRnnParamSize``)."""
+    g = rnn_gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        # per direction: W_x (g*H, in) + W_h (g*H, H) + b_x + b_h
+        size += d * (g * state_size * (in_sz + state_size)
+                     + 2 * g * state_size)
+    return size
+
+
+def _unpack_params(params, input_size, state_size, num_layers, mode, d):
+    """Slice the flat blob into per-(layer, direction) weight/bias arrays.
+
+    Returns [(Wx, Wh, bx, bh), ...] ordered layer-major then direction —
+    matching the packing in :func:`rnn_param_size`.
+    """
+    g = rnn_gates(mode)
+    h = state_size
+    mats, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * d
+        for _ in range(d):
+            wx = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            mats.append((wx, wh))
+    for layer in range(num_layers):
+        for _ in range(d):
+            bx = params[off:off + g * h]
+            off += g * h
+            bh = params[off:off + g * h]
+            off += g * h
+            biases.append((bx, bh))
+    return [(wx, wh, bx, bh)
+            for (wx, wh), (bx, bh) in zip(mats, biases)]
+
+
+def _run_direction(mode, x, wx, wh, bx, bh, h0, c0, reverse):
+    """Scan one (layer, direction). x: (T, N, in). Returns (out, hT, cT)."""
+    t, n = x.shape[0], x.shape[1]
+    # hoist the input projection out of the scan: one (T*N, in)x(in, G*H)
+    # MXU matmul instead of T small ones
+    xp = (x.reshape(t * n, -1) @ wx.T + bx).reshape(t, n, -1)
+    wh_t = wh.T
+
+    if mode == "lstm":
+        def step(carry, xpt):
+            hidden, cell = carry
+            gates = xpt + hidden @ wh_t + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * cell + jax.nn.sigmoid(i) * jnp.tanh(g)
+            new_h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (new_h, c), new_h
+
+        (h_f, c_f), out = lax.scan(step, (h0, c0), xp, reverse=reverse)
+        return out, h_f, c_f
+    if mode == "gru":
+        def step(hidden, xpt):
+            hp = hidden @ wh_t + bh
+            rx, zx, nx = jnp.split(xpt, 3, axis=-1)
+            rh, zh, nh = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            nval = jnp.tanh(nx + r * nh)
+            new_h = (1.0 - z) * nval + z * hidden
+            return new_h, new_h
+
+        h_f, out = lax.scan(step, h0, xp, reverse=reverse)
+        return out, h_f, None
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+    def step(hidden, xpt):
+        new_h = act(xpt + hidden @ wh_t + bh)
+        return new_h, new_h
+
+    h_f, out = lax.scan(step, h0, xp, reverse=reverse)
+    return out, h_f, None
+
+
+def _rnn_num_outputs(attrs):
+    if not bool(attrs.get("state_outputs", False)):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+@register("RNN", needs_rng=True, uses_train_mode=True,
+          num_outputs=_rnn_num_outputs)
+def _rnn(attrs, rng, data, parameters, *states):
+    """Fused stacked RNN (reference ``src/operator/rnn-inl.h``).
+
+    ``data``: (T, N, input_size) time-major (TNC — the legacy op's layout).
+    ``parameters``: flat blob (see :func:`rnn_param_size`).
+    ``states``: initial hidden state (L*D, N, H), plus cell state for LSTM.
+    """
+    mode = attrs.get("mode", "lstm")
+    h = int(attrs["state_size"])
+    layers = int(attrs.get("num_layers", 1))
+    bidir = bool(attrs.get("bidirectional", False))
+    p = float(attrs.get("p", 0.0))
+    state_outputs = bool(attrs.get("state_outputs", False))
+    is_train = bool(attrs.get("__is_train__", False))
+    d = 2 if bidir else 1
+    g = rnn_gates(mode)
+
+    if data.ndim != 3:
+        raise MXNetError("RNN expects (seq_len, batch, input) data, got %s"
+                         % (data.shape,))
+    input_size = data.shape[2]
+    expect = rnn_param_size(input_size, h, layers, mode, bidir)
+    if parameters.shape != (expect,):
+        raise MXNetError(
+            "RNN parameter blob has shape %s, expected (%d,) for "
+            "input_size=%d state_size=%d num_layers=%d mode=%s bidir=%s"
+            % (parameters.shape, expect, input_size, h, layers, mode, bidir))
+    del g  # used only through helpers
+
+    h0 = states[0]
+    c0_all = states[1] if mode == "lstm" else None
+    slots = _unpack_params(parameters, input_size, h, layers, mode, d)
+
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(layers):
+        if layer > 0 and p > 0 and is_train:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0).astype(x.dtype)
+        outs = []
+        for direction in range(d):
+            idx = layer * d + direction
+            wx, wh, bx, bh = slots[idx]
+            c0 = c0_all[idx] if c0_all is not None else None
+            out, h_f, c_f = _run_direction(
+                mode, x, wx, wh, bx, bh, h0[idx], c0,
+                reverse=(direction == 1))
+            outs.append(out)
+            h_finals.append(h_f)
+            if c_f is not None:
+                c_finals.append(c_f)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+
+    if not state_outputs:
+        return x
+    hT = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return x, hT, jnp.stack(c_finals, axis=0)
+    return x, hT

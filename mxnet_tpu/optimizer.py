@@ -700,10 +700,19 @@ class Nadam(Optimizer):
         momentum_t = self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
         momentum_t_1 = self.beta1 * (1. - 0.5 * 0.96 **
                                      ((t + 1) * self.schedule_decay))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t_1
-        m_t, v_t = state
-        grad_prime = grad / (1. - self.m_schedule)
+        if len(state) == 3:
+            # state saved by the fused path carries a per-param schedule
+            # as a (1,) NDArray; keep advancing it in place so a fused
+            # checkpoint resumes correctly on the split path too
+            m_t, v_t, sched = state
+            m_schedule = float(sched.asnumpy()[0]) * momentum_t
+            sched[:] = m_schedule
+        else:
+            m_t, v_t = state
+            self.m_schedule = self.m_schedule * momentum_t
+            m_schedule = self.m_schedule
+        m_schedule_next = m_schedule * momentum_t_1
+        grad_prime = grad / (1. - m_schedule)
         m_t._set_data((self.beta1 * m_t + (1. - self.beta1) * grad)._data)
         v_t._set_data((self.beta2 * v_t + (1. - self.beta2) * grad * grad)._data)
         m_t_prime = m_t / (1. - m_schedule_next)
@@ -714,6 +723,8 @@ class Nadam(Optimizer):
     def init_fused_state(self, weight):
         import jax.numpy as jnp
 
+        import jax
+
         # (m, v) mirror create_state; the scalar m_schedule rides along in
         # the fused state (the split path keeps it on the optimizer object
         # and, like the reference, loses it across checkpoints).
@@ -722,8 +733,11 @@ class Nadam(Optimizer):
         # its trajectory depends on parameter iteration order.  The fused
         # form keeps a per-parameter schedule — the Nadam paper's actual
         # recursion — so fused and split trajectories differ slightly.
-        return (jnp.zeros_like(weight), jnp.zeros_like(weight),
-                jnp.asarray(1.0, "float32"))
+        dev = list(weight.devices())[0] if hasattr(weight, "devices") else None
+        sched = jnp.asarray(1.0, "float32")
+        if dev is not None:
+            sched = jax.device_put(sched, dev)
+        return (jnp.zeros_like(weight), jnp.zeros_like(weight), sched)
 
     def fused_update(self, weight, grad, state, lr, wd, t, rng):
         import jax.numpy as jnp
@@ -749,14 +763,27 @@ class Nadam(Optimizer):
         return new_w, (new_m, new_v, m_schedule)
 
     def fused_state_to_nd(self, fused, ctx):
-        m, v, _ = fused
-        return (NDArray(m, ctx), NDArray(v, ctx))
+        # Persist the on-device m_schedule too: dropping it and re-seeding
+        # from self.m_schedule (which the fused path never advances) would
+        # snap bias correction back to step-0 behavior after a
+        # save/load round-trip.
+        m, v, m_schedule = fused
+        return (NDArray(m, ctx), NDArray(v, ctx),
+                NDArray(m_schedule.reshape((1,)), ctx))
 
     def fused_state_from_nd(self, state):
         import jax.numpy as jnp
 
-        m, v = state
-        return (m._data, v._data, jnp.asarray(self.m_schedule, "float32"))
+        if len(state) == 3:
+            m, v, m_schedule = state
+            return (m._data, v._data,
+                    m_schedule._data.reshape(()).astype("float32"))
+        import jax
+
+        m, v = state  # split-path state: no per-param schedule saved
+        sched = jax.device_put(jnp.asarray(self.m_schedule, "float32"),
+                               list(m._data.devices())[0])
+        return (m._data, v._data, sched)
 
 
 @register

@@ -60,7 +60,7 @@ def _stacked_sum(mesh):
     return jax.jit(lambda v: v.sum(axis=0),
                    out_shardings=NamedSharding(mesh, PartitionSpec()))
 
-def allreduce_nd(arr, mesh=None):
+def allreduce_nd(arr, mesh=None, is_partial_stack=False):
     """All-reduce an NDArray across the active reduction domain.
 
     Three cases, mirroring where the reference reduces gradients
@@ -70,10 +70,13 @@ def allreduce_nd(arr, mesh=None):
        step is jitted over a mesh with the batch sharded on the 'data'
        axis, XLA already inserted the ICI all-reduce inside the step and
        a pushed gradient is the *global*-batch gradient: identity.
-       If, however, the caller hands per-chip partial gradients stacked
-       on a leading axis that is sharded over the mesh's data axis (the
-       analogue of the reference's per-device gradient list), they are
-       summed on-device into a replicated result.
+       If the caller built a stack of per-chip partial gradients on a
+       leading axis (the analogue of the reference's per-device gradient
+       list), it must say so with ``is_partial_stack=True``; the stack is
+       then summed on-device into a replicated result.  This is explicit
+       because shape+sharding alone cannot distinguish a partial stack
+       from a batch-sharded value whose dim0 happens to equal the device
+       count.
     2. **Multi-process (multi-host)** — per-process values are summed
        over DCN via the multihost allgather utility.
     3. Single process, no mesh — identity.
@@ -83,19 +86,14 @@ def allreduce_nd(arr, mesh=None):
     from ..ndarray.ndarray import NDArray
 
     x = arr._data
-    if mesh is not None and mesh.shape.get("data", 1) > 1 and \
-            x.ndim >= 1 and x.shape[0] == mesh.shape["data"]:
-        sh = getattr(x, "sharding", None)
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        # contract: partials are STACKED on a leading axis laid out
-        # exactly over the mesh data axis (spec[0] == 'data'); anything
-        # else — replicated global grads, batch-sharded activations — is
-        # not a partial-gradient stack and falls through
-        if isinstance(sh, NamedSharding) and len(sh.spec) >= 1 and \
-                sh.spec[0] == "data":
-            summed = _stacked_sum(mesh)(x)
-            return NDArray(summed, arr.context)
+    if is_partial_stack:
+        if mesh is None or x.ndim < 1 or \
+                x.shape[0] != mesh.shape.get("data", 1):
+            raise MXNetError(
+                "is_partial_stack=True requires a mesh and a leading axis "
+                "of size mesh.shape['data'] (got shape %s)" % (x.shape,))
+        summed = _stacked_sum(mesh)(x)
+        return NDArray(summed, arr.context)
     if jax.process_count() == 1:
         return arr
     from jax.experimental import multihost_utils

@@ -97,5 +97,5 @@ def test_kvstore_partial_grad_allreduce():
     partial = np.arange(8 * 4, dtype="float32").reshape(8, 4)
     arr = mx.nd.NDArray(
         jax.device_put(partial, NamedSharding(mesh, PartitionSpec("data"))))
-    out = kv._cross_replica_sum(arr)
+    out = kv._cross_replica_sum(arr, is_partial_stack=True)
     np.testing.assert_allclose(out.asnumpy(), partial.sum(axis=0))

@@ -196,3 +196,44 @@ def test_mixed_precision_bf16_compute():
         assert np.isfinite(np.asarray(v, "float32")).all()
     assert not np.allclose(p0["fc1_weight"],
                            np.asarray(params["fc1_weight"]))
+
+
+def test_nadam_fused_state_loads_on_split_path(tmp_path):
+    """A fused Nadam checkpoint (3-tuple per-param state incl. the
+    m_schedule scalar) must resume on the SPLIT update path too — and the
+    schedule must keep advancing from its saved value, not reset to 1."""
+    mod, _ = _run("nadam", {"learning_rate": 0.01}, fused=True, steps=3)
+    states_file = str(tmp_path / "nadam.states")
+    mod.save_optimizer_states(states_file)
+
+    # resume split (MXNET_FUSED_STEP=0)
+    os.environ["MXNET_FUSED_STEP"] = "0"
+    try:
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 8).astype("float32")
+        y = (rng.rand(64) * 4).astype("float32")
+        it = mx.io.NDArrayIter(X, y, batch_size=16)
+        mod2 = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        arg_params, aux_params = mod.get_params()
+        mod2.init_params(arg_params=arg_params, aux_params=aux_params)
+        mod2.init_optimizer(optimizer="nadam",
+                            optimizer_params={"learning_rate": 0.01},
+                            kvstore=None)
+        assert mod2._fused is None
+        mod2.load_optimizer_states(states_file)
+        # first state entry carries (m, v, schedule)
+        st = mod2._updater.states
+        assert len(st) > 0 and len(next(iter(st.values()))) == 3
+        sched_before = float(next(iter(st.values()))[2].asnumpy()[0])
+        assert sched_before < 1.0  # advanced during the fused run
+        for b in it:
+            mod2.forward_backward(b)
+            mod2.update()
+            break
+        sched_after = float(next(iter(st.values()))[2].asnumpy()[0])
+        assert sched_after < sched_before  # kept advancing, not reset
+        for _, v in mod2.get_params()[0].items():
+            assert np.isfinite(v.asnumpy()).all()
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP", None)

@@ -243,6 +243,13 @@ def _build_specs():
     s["SequenceReverse"] = ([_f(5, 3, 2), np.array([3, 2, 5], "float32")],
                             {"use_sequence_length": True})
 
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+    s["RNN"] = (
+        [_f(5, 2, 3), _f(rnn_param_size(3, 4, 1, "lstm")),
+         _f(1, 2, 4), _f(1, 2, 4)],
+        {"state_size": 4, "num_layers": 1, "mode": "lstm",
+         "state_outputs": True})
+
     # -- optimizer updates -------------------------------------------------
     s["sgd_update"] = ([_f(4), _f(4)], {"lr": 0.1})
     s["sgd_mom_update"] = ([_f(4), _f(4), _f(4)], {"lr": 0.1,

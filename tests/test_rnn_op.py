@@ -1,0 +1,159 @@
+"""Fused RNN op correctness vs. a plain numpy unroll.
+
+Mirrors the reference's ``tests/python/unittest/test_operator.py`` RNN
+coverage (the cuDNN fused op was checked against the symbolic unroll;
+here the check is against an explicit numpy recurrence).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import imperative_invoke
+from mxnet_tpu.ops.rnn_ops import rnn_param_size, rnn_gates
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _unpack(params, input_size, h, layers, mode, d):
+    g = rnn_gates(mode)
+    mats, biases = [], []
+    off = 0
+    for layer in range(layers):
+        in_sz = input_size if layer == 0 else h * d
+        for _ in range(d):
+            wx = params[off:off + g * h * in_sz].reshape(g * h, in_sz)
+            off += g * h * in_sz
+            wh = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            mats.append((wx, wh))
+    for layer in range(layers):
+        for _ in range(d):
+            bx = params[off:off + g * h]; off += g * h
+            bh = params[off:off + g * h]; off += g * h
+            biases.append((bx, bh))
+    return [m + b for m, b in zip(mats, biases)]
+
+
+def _np_cell(mode, x_t, hidden, cell, wx, wh, bx, bh):
+    pre_x = x_t @ wx.T + bx
+    pre_h = hidden @ wh.T + bh
+    if mode == "lstm":
+        i, f, g, o = np.split(pre_x + pre_h, 4, axis=-1)
+        c = _sigmoid(f) * cell + _sigmoid(i) * np.tanh(g)
+        return _sigmoid(o) * np.tanh(c), c
+    if mode == "gru":
+        rx, zx, nx = np.split(pre_x, 3, axis=-1)
+        rh, zh, nh = np.split(pre_h, 3, axis=-1)
+        r = _sigmoid(rx + rh)
+        z = _sigmoid(zx + zh)
+        n = np.tanh(nx + r * nh)
+        return (1 - z) * n + z * hidden, None
+    act = np.tanh if mode == "rnn_tanh" else lambda v: np.maximum(v, 0)
+    return act(pre_x + pre_h), None
+
+
+def _np_rnn(mode, data, params, h0, c0, h, layers, d):
+    slots = _unpack(params, data.shape[2], h, layers, mode, d)
+    x = data
+    h_fin, c_fin = [], []
+    for layer in range(layers):
+        outs = []
+        for direction in range(d):
+            idx = layer * d + direction
+            wx, wh, bx, bh = slots[idx]
+            hidden = h0[idx]
+            cell = c0[idx] if c0 is not None else None
+            seq = range(x.shape[0])
+            if direction == 1:
+                seq = reversed(list(seq))
+            out = np.zeros((x.shape[0], x.shape[1], h), "float64")
+            for t in seq:
+                hidden, cell = _np_cell(mode, x[t], hidden, cell,
+                                        wx, wh, bx, bh)
+                out[t] = hidden
+            outs.append(out)
+            h_fin.append(hidden)
+            if cell is not None:
+                c_fin.append(cell)
+        x = outs[0] if d == 1 else np.concatenate(outs, axis=-1)
+    return x, np.stack(h_fin), (np.stack(c_fin) if c_fin else None)
+
+
+@pytest.mark.parametrize("mode", ["rnn_relu", "rnn_tanh", "gru", "lstm"])
+@pytest.mark.parametrize("layers,bidir", [(1, False), (2, False), (1, True),
+                                          (2, True)])
+def test_rnn_matches_numpy(mode, layers, bidir):
+    rs = np.random.RandomState(7)
+    t, n, i, h = 5, 3, 4, 6
+    d = 2 if bidir else 1
+    data = rs.randn(t, n, i).astype("float32")
+    params = (rs.randn(rnn_param_size(i, h, layers, mode, bidir))
+              * 0.2).astype("float32")
+    h0 = rs.randn(layers * d, n, h).astype("float32") * 0.1
+    c0 = rs.randn(layers * d, n, h).astype("float32") * 0.1
+
+    inputs = [mx.nd.array(data), mx.nd.array(params), mx.nd.array(h0)]
+    if mode == "lstm":
+        inputs.append(mx.nd.array(c0))
+    attrs = {"state_size": h, "num_layers": layers, "mode": mode,
+             "bidirectional": bidir, "state_outputs": True}
+    outs = imperative_invoke("RNN", inputs, attrs)
+
+    ref_out, ref_h, ref_c = _np_rnn(
+        mode, data.astype("float64"), params.astype("float64"),
+        h0.astype("float64"), c0.astype("float64") if mode == "lstm"
+        else None, h, layers, d)
+
+    np.testing.assert_allclose(outs[0].asnumpy(), ref_out,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(outs[1].asnumpy(), ref_h,
+                               rtol=1e-4, atol=1e-4)
+    if mode == "lstm":
+        np.testing.assert_allclose(outs[2].asnumpy(), ref_c,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_single_output_and_param_size_mismatch():
+    t, n, i, h = 3, 2, 4, 5
+    data = mx.nd.array(np.zeros((t, n, i), "float32"))
+    params = mx.nd.array(np.zeros(rnn_param_size(i, h, 1, "gru"), "float32"))
+    h0 = mx.nd.array(np.zeros((1, n, h), "float32"))
+    outs = imperative_invoke("RNN", [data, params, h0],
+                             {"state_size": h, "num_layers": 1,
+                              "mode": "gru"})
+    assert len(outs) == 1 and outs[0].shape == (t, n, h)
+
+    bad = mx.nd.array(np.zeros(7, "float32"))
+    with pytest.raises(mx.base.MXNetError):
+        imperative_invoke("RNN", [data, bad, h0],
+                          {"state_size": h, "num_layers": 1, "mode": "gru"})
+
+
+def test_rnn_gradient_flows():
+    """Symbolic fwd/bwd through the fused op (tape + vjp path)."""
+    rs = np.random.RandomState(3)
+    t, n, i, h = 4, 2, 3, 4
+    data = mx.sym.Variable("data")
+    params = mx.sym.Variable("parameters")
+    state = mx.sym.Variable("state")
+    out = mx.sym.RNN(data=data, parameters=params, state=state,
+                     state_size=h, num_layers=1, mode="rnn_tanh")
+    loss = mx.sym.sum(out)
+    ex = loss.bind(mx.cpu(), {
+        "data": mx.nd.array(rs.randn(t, n, i).astype("float32")),
+        "parameters": mx.nd.array(
+            (rs.randn(rnn_param_size(i, h, 1, "rnn_tanh")) * 0.3
+             ).astype("float32")),
+        "state": mx.nd.array(np.zeros((1, n, h), "float32")),
+    }, args_grad={
+        "data": mx.nd.zeros((t, n, i)),
+        "parameters": mx.nd.zeros((rnn_param_size(i, h, 1, "rnn_tanh"),)),
+        "state": mx.nd.zeros((1, n, h)),
+    })
+    ex.forward(is_train=True)
+    ex.backward()
+    for name in ("data", "parameters", "state"):
+        g = ex.grad_dict[name].asnumpy()
+        assert np.abs(g).sum() > 0, "zero gradient wrt %s" % name
