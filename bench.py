@@ -18,9 +18,13 @@ import time
 
 sys.path.insert(0, ".")
 
-# fwd+bwd model FLOPs per 224x224 image for ResNet-50 (fwd ~4.1 GFLOPs
-# counting multiply-add as 2; backward ~2x forward)
-TRAIN_FLOPS_PER_IMG = 12.3e9
+# fwd+bwd model FLOPs per 224x224 image for ResNet-50 under the standard
+# MFU convention (multiply-add = 2 FLOPs, the same convention as the
+# chip's peak spec): fwd ≈ 4.1 GMACs → 8.2 GFLOPs, train ≈ 3x fwd.
+# Cross-checked against XLA's cost analysis of the compiled step, which
+# reports ~24.0e9/img for fwd+bwd+SGD.  (Rounds 1-2 used 12.3e9 — the
+# MAC=1 count — understating MFU 2x vs the peak's MAC=2 convention.)
+TRAIN_FLOPS_PER_IMG = 24.6e9
 
 # bf16 peak TFLOP/s by TPU generation (public spec sheets)
 PEAK_BF16 = {
@@ -52,6 +56,18 @@ def _measure(step, shapes, batch, iters=20):
         "data": jax.random.normal(rng, shapes["data"], "float32"),
         "softmax_label": jnp.zeros(shapes["softmax_label"], "float32"),
     }
+    # XLA's own FLOP count of the compiled step (MAC=2 convention,
+    # includes fwd+bwd+optimizer) — the honest numerator for MFU
+    xla_flops = None
+    try:
+        comp = step._jit_step.lower(
+            params, aux, states, batch_dict, rng, step.lr,
+            jnp.asarray(1, "int32")).compile()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        xla_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
     # warmup/compile; completion is forced with a host fetch because
     # block_until_ready does not synchronize through the axon tunnel
     params, aux, states, out = step(params, aux, states, batch_dict, rng)
@@ -60,7 +76,7 @@ def _measure(step, shapes, batch, iters=20):
     for _ in range(iters):
         params, aux, states, out = step(params, aux, states, batch_dict, rng)
     float(np.asarray(out[0, 0]))  # forces the whole dependency chain
-    return batch * iters / (time.perf_counter() - t0)
+    return batch * iters / (time.perf_counter() - t0), xla_flops
 
 
 def main():
@@ -76,22 +92,26 @@ def main():
     if "--sweep" in sys.argv:
         batches = sorted(set(batches) | {64, 128, 256, 512})
 
+    layout = "NHWC" if "--nhwc" in sys.argv else "NCHW"
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
-                            image_shape=(3, 224, 224))
-    best = (0.0, None)
+                            image_shape=(3, 224, 224), layout=layout)
+    best = (0.0, None, None)
     for batch in batches:
         step = TrainStep(
             sym, optimizer="sgd",
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                               "rescale_grad": 1.0 / batch},
             compute_dtype=compute_dtype)
-        shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
-        img_s = _measure(step, shapes, batch)
+        dshape = (batch, 3, 224, 224) if layout == "NCHW" \
+            else (batch, 224, 224, 3)
+        shapes = {"data": dshape, "softmax_label": (batch,)}
+        img_s, xla_flops = _measure(step, shapes, batch)
         if img_s > best[0]:
-            best = (img_s, batch)
+            best = (img_s, batch, xla_flops)
 
-    img_s, batch = best
-    achieved = img_s * TRAIN_FLOPS_PER_IMG
+    img_s, batch, xla_flops = best
+    flops_per_img = (xla_flops / batch) if xla_flops else TRAIN_FLOPS_PER_IMG
+    achieved = img_s * flops_per_img
     # peak table is bf16; fp32 peak differs per generation, so report
     # MFU only for the bf16 path
     peak = None if fp32 else _peak_flops(jax.devices()[0])
@@ -103,7 +123,10 @@ def main():
         "vs_baseline": round(img_s / baseline, 2),
         "batch_size": batch,
         "precision": "float32" if fp32 else "bf16+fp32-master",
+        "layout": layout,
         "achieved_tflops": round(achieved / 1e12, 2),
+        "flops_accounting": "xla_cost_analysis" if xla_flops
+                            else "analytic_mac2",
         "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }))

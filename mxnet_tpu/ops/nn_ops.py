@@ -20,6 +20,8 @@ TPU-first notes:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -60,21 +62,39 @@ def _fully_connected(attrs, data, weight, *bias):
 # Convolution / Deconvolution / Pooling
 # ---------------------------------------------------------------------------
 
-def _conv_dims(kernel_ndim):
-    # NCHW-family dimension numbers for 1/2/3 spatial dims
-    spec = {1: ("NCH", "OIH", "NCH"),
-            2: ("NCHW", "OIHW", "NCHW"),
-            3: ("NCDHW", "OIDHW", "NCDHW")}[kernel_ndim]
+def _conv_dims(kernel_ndim, layout=None):
+    # Dimension numbers for 1/2/3 spatial dims.  Default is the MXNet
+    # NCHW family; channels-last layouts (NWC/NHWC/NDHWC — the TPU-native
+    # choice: C rides the 128-lane dim, so BN reductions are
+    # lane-parallel and convs skip relayouts) use OHWI-style weights,
+    # matching the reference's cuDNN-NHWC convention (weight (O, *k, I),
+    # ``src/operator/convolution-inl.h`` layout param).
+    if layout in (None, "NCW", "NCHW", "NCDHW"):
+        spec = {1: ("NCH", "OIH", "NCH"),
+                2: ("NCHW", "OIHW", "NCHW"),
+                3: ("NCDHW", "OIDHW", "NCDHW")}[kernel_ndim]
+    elif layout in ("NWC", "NHWC", "NDHWC"):
+        spec = {1: ("NHC", "OHI", "NHC"),
+                2: ("NHWC", "OHWI", "NHWC"),
+                3: ("NDHWC", "ODHWI", "NDHWC")}[kernel_ndim]
+    else:
+        raise MXNetError("unsupported conv layout %r" % layout)
     return lax.conv_dimension_numbers((0,) * (kernel_ndim + 2),
                                       (0,) * (kernel_ndim + 2), spec)
+
+
+def _channels_last(layout):
+    return layout in ("NWC", "NHWC", "NDHWC")
 
 
 @register("Convolution", aliases=("conv", "Convolution_v1"))
 def _convolution(attrs, data, weight, *bias):
     """Reference ``src/operator/convolution-inl.h``: grouped ND convolution,
-    NC+spatial layout, weight (O, I/g, *kernel)."""
+    weight (O, I/g, *kernel) for NC-first layouts, (O, *kernel, I/g) for
+    channels-last."""
     kernel = _pair(attrs["kernel"], len(attrs["kernel"]))
     nd = len(kernel)
+    layout = attrs.get("layout")
     stride = _pair(attrs.get("stride"), nd)
     pad = _pair(attrs.get("pad", (0,) * nd), nd)
     dilate = _pair(attrs.get("dilate"), nd)
@@ -84,11 +104,12 @@ def _convolution(attrs, data, weight, *bias):
         window_strides=stride,
         padding=tuple((p, p) for p in pad),
         rhs_dilation=dilate,
-        dimension_numbers=_conv_dims(nd),
+        dimension_numbers=_conv_dims(nd, layout),
         feature_group_count=groups,
     )
     if bias:
-        b = bias[0].reshape((1, -1) + (1,) * nd)
+        b = bias[0] if _channels_last(layout) else \
+            bias[0].reshape((1, -1) + (1,) * nd)
         out = out + b
     return out
 
@@ -132,9 +153,12 @@ def _pooling(attrs, data):
     """Reference ``src/operator/pooling-inl.h``: max/avg/sum pooling with
     global_pool and 'valid'/'full' conventions."""
     pool_type = attrs.get("pool_type", "max")
+    layout = attrs.get("layout")
+    ch_last = _channels_last(layout)
     nd = data.ndim - 2
+    sp0 = 1 if ch_last else 2  # first spatial axis
     if bool(attrs.get("global_pool", False)):
-        axes = tuple(range(2, 2 + nd))
+        axes = tuple(range(sp0, sp0 + nd))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type == "sum":
@@ -144,19 +168,20 @@ def _pooling(attrs, data):
     nd = len(kernel)
     stride = _pair(attrs.get("stride"), nd)
     pad = _pair(attrs.get("pad", (0,) * nd), nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+    window = (1,) + kernel + (1,) if ch_last else (1, 1) + kernel
+    strides = (1,) + stride + (1,) if ch_last else (1, 1) + stride
     # 'full' (ceil) convention pads the high edge so partial windows count
     # (reference pooling-inl.h pooling_convention)
     extra = [0] * nd
     if attrs.get("pooling_convention", "valid") == "full":
         for d in range(nd):
-            size = data.shape[2 + d] + 2 * pad[d] - kernel[d]
+            size = data.shape[sp0 + d] + 2 * pad[d] - kernel[d]
             rem = size % stride[d]
             if rem:
                 extra[d] = stride[d] - rem
-    padding = ((0, 0), (0, 0)) + tuple(
-        (p, p + e) for p, e in zip(pad, extra))
+    sp_padding = tuple((p, p + e) for p, e in zip(pad, extra))
+    padding = ((0, 0),) + sp_padding + ((0, 0),) if ch_last \
+        else ((0, 0), (0, 0)) + sp_padding
     # init values must be CONCRETE (numpy) scalars: a jnp array created
     # under a jit trace is a tracer constant, which breaks reduce_window's
     # linearization rule (jit(grad(maxpool)) fails with "Linearization
@@ -400,18 +425,87 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
 
     reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
 
-    # statistics in fp32 (bf16 inputs would lose precision in the mean/var
-    # reduction); normalization math back in the data dtype so bf16
-    # activations stay bf16 into the next conv
     if is_train:
+        from .pallas_bn import pallas_bn_enabled
+
+        if axis == 1 and pallas_bn_enabled(data):
+            # opt-in custom-kernel path (hand-written vjp + pallas sums)
+            out, mean, var = _bn_train(eps, axis, fix_gamma)(
+                data, gamma, beta)
+        else:
+            # default: jnp formulation, gradients via autodiff — measured
+            # FASTER end-to-end than the hand-written vjp on ResNet-50/
+            # v5e (XLA fuses the stat reductions with their consumers
+            # better than the custom bwd's explicit passes)
+            g = jnp.ones_like(gamma) if fix_gamma else gamma
+            if data.dtype == jnp.bfloat16:
+                mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
+                mean_sq = jnp.mean(jnp.square(data.astype(jnp.float32)),
+                                   axis=reduce_axes)
+                var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+            else:
+                data32 = data.astype(jnp.float32)
+                mean = jnp.mean(data32, axis=reduce_axes)
+                var = jnp.var(data32, axis=reduce_axes)
+            g32 = g.astype(jnp.float32).reshape(bshape)
+            inv = lax.rsqrt(var + eps).reshape(bshape)
+            scale = (inv * g32).astype(data.dtype)
+            shift = (beta.astype(jnp.float32).reshape(bshape) -
+                     mean.reshape(bshape) * inv * g32).astype(data.dtype)
+            out = data * scale + shift
+        # keep the aux-state dtype stable: cast the fp32 batch stats to the
+        # moving buffers' dtype before blending, else bf16 aux would drift
+        # to fp32 after one step (retraces + checkpoint dtype mismatch)
+        new_mean = momentum * moving_mean + (1 - momentum) * \
+            lax.stop_gradient(mean).astype(moving_mean.dtype)
+        new_var = momentum * moving_var + (1 - momentum) * \
+            lax.stop_gradient(var).astype(moving_var.dtype)
+        return out, new_mean, new_var
+
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    mean32 = moving_mean.astype(jnp.float32)
+    var32 = moving_var.astype(jnp.float32)
+    g32 = g.astype(jnp.float32).reshape(bshape)
+    inv = lax.rsqrt(var32 + eps).reshape(bshape)
+    scale = (inv * g32).astype(data.dtype)
+    shift = (beta.astype(jnp.float32).reshape(bshape) -
+             mean32.reshape(bshape) * inv * g32).astype(data.dtype)
+    out = data * scale + shift
+    return out, moving_mean, moving_var
+
+
+@functools.lru_cache(maxsize=None)
+def _bn_train(eps, axis, fix_gamma):
+    """Training-mode BN with a hand-written backward.
+
+    Autodiff through the fp32-accumulated statistics path materializes
+    fp32 activation-sized cotangents (double-width HBM traffic on the
+    bf16 bench path — measured ~20% of step bytes on ResNet-50).  The
+    closed-form BN gradient keeps every activation-sized tensor in the
+    data dtype and accumulates the two reductions in fp32:
+
+        dx = (g·inv) · (dxhat − E[dxhat] − xhat·E[dxhat·xhat])
+
+    (biased-variance form, matching the forward's jnp.var).  Cotangents
+    for the mean/var outputs are ignored: the only consumer is the
+    moving-stat blend behind ``lax.stop_gradient``.
+    """
+    import jax
+
+    def stats(data, reduce_axes):
+        from . import pallas_bn
+
+        if axis == 1 and pallas_bn.pallas_bn_enabled(data):
+            s1, s2 = pallas_bn.bn_stats(data)
+            count = data.size // data.shape[axis]
+            mean = s1 / count
+            var = jnp.maximum(s2 / count - jnp.square(mean), 0.0)
+            return mean, var
+        # fp32-accumulated moments without materializing an fp32 copy of
+        # the activations; E[x^2]-E[x]^2 cancellation is bounded by input
+        # precision for bf16, and the fp32 path keeps the two-pass form
         if data.dtype == jnp.bfloat16:
-            # fp32-accumulated moments without materializing an fp32 copy
-            # of the activations (keeps the reductions fused over the
-            # bf16 input — HBM traffic stays half-width).  E[x^2]-E[x]^2
-            # cancellation is bounded by bf16 input precision here; the
-            # fp32 path below keeps the stable two-pass form.
             mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
             mean_sq = jnp.mean(jnp.square(data.astype(jnp.float32)),
                                axis=reduce_axes)
@@ -420,26 +514,77 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
             data32 = data.astype(jnp.float32)
             mean = jnp.mean(data32, axis=reduce_axes)
             var = jnp.var(data32, axis=reduce_axes)
-        # keep the aux-state dtype stable: cast the fp32 batch stats to the
-        # moving buffers' dtype before blending, else bf16 aux would drift
-        # to fp32 after one step (retraces + checkpoint dtype mismatch)
-        new_mean = momentum * moving_mean + (1 - momentum) * \
-            lax.stop_gradient(mean).astype(moving_mean.dtype)
-        new_var = momentum * moving_var + (1 - momentum) * \
-            lax.stop_gradient(var).astype(moving_var.dtype)
-    else:
-        mean, var = moving_mean, moving_var
-        new_mean, new_var = moving_mean, moving_var
+        return mean, var
 
-    mean32 = mean.astype(jnp.float32)
-    var32 = var.astype(jnp.float32)
-    g32 = g.astype(jnp.float32).reshape(bshape)
-    inv = lax.rsqrt(var32 + eps).reshape(bshape)
-    scale = (inv * g32).astype(data.dtype)
-    shift = (beta.astype(jnp.float32).reshape(bshape) -
-             mean32.reshape(bshape) * inv * g32).astype(data.dtype)
-    out = data * scale + shift
-    return out, new_mean, new_var
+    @jax.custom_vjp
+    def bn(data, gamma, beta):
+        return bn_fwd(data, gamma, beta)[0]
+
+    def bn_fwd(data, gamma, beta):
+        reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
+        bshape = tuple(data.shape[axis] if i == axis else 1
+                       for i in range(data.ndim))
+        mean, var = stats(data, reduce_axes)
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        g32 = g.astype(jnp.float32)
+        inv = lax.rsqrt(var + eps)
+        scale = (inv * g32).reshape(bshape).astype(data.dtype)
+        shift = ((beta.astype(jnp.float32) - mean * inv * g32)
+                 .reshape(bshape)).astype(data.dtype)
+        out = data * scale + shift
+        return (out, mean, var), (data, gamma, mean, inv)
+
+    def bn_bwd(res, cts):
+        from . import pallas_bn
+
+        data, gamma, mean, inv = res
+        dy = cts[0]  # d(mean)/d(var) cotangents are zero (stop_gradient)
+        dt = data.dtype
+        reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
+        bshape = tuple(data.shape[axis] if i == axis else 1
+                       for i in range(data.ndim))
+        n = 1
+        for i in reduce_axes:
+            n *= data.shape[i]
+        g32 = jnp.ones_like(gamma).astype(jnp.float32) if fix_gamma \
+            else gamma.astype(jnp.float32)
+        inv_b = inv.reshape(bshape).astype(dt)
+        mean_b = mean.reshape(bshape).astype(dt)
+        xhat = (data - mean_b) * inv_b
+        if axis == 1 and pallas_bn.pallas_bn_enabled(data, streams=2):
+            # one streamed pass over (dy, x) for both channel sums; dx is
+            # a single fused elementwise pass (dxhat = g*dy folds into
+            # per-channel constants)
+            s_dy, s_dyxhat = pallas_bn.bn_grad_sums(dy, data, mean, inv)
+            gi_b = (g32 * inv).reshape(bshape).astype(dt)
+            e_dy = (s_dy / n).reshape(bshape).astype(dt)
+            e_dyxhat = (s_dyxhat / n).reshape(bshape).astype(dt)
+            dx = gi_b * (dy - e_dy - xhat * e_dyxhat)
+            dbeta = s_dy.astype(gamma.dtype)
+            dgamma = jnp.zeros_like(gamma) if fix_gamma \
+                else s_dyxhat.astype(gamma.dtype)
+            return dx, dgamma, dbeta
+        dxhat = dy * g32.reshape(bshape).astype(dt)
+        e_dxhat = (jnp.sum(dxhat, axis=reduce_axes, dtype=jnp.float32)
+                   / n).reshape(bshape)
+        e_dxhat_xhat = (jnp.sum(dxhat * xhat, axis=reduce_axes,
+                                dtype=jnp.float32) / n).reshape(bshape)
+        dx = inv_b * (dxhat - e_dxhat.astype(dt)
+                      - xhat * e_dxhat_xhat.astype(dt))
+        dbeta = jnp.sum(dy, axis=reduce_axes,
+                        dtype=jnp.float32).astype(gamma.dtype)
+        if fix_gamma:
+            dgamma = jnp.zeros_like(gamma)
+        else:
+            dgamma = jnp.sum(dy * xhat, axis=reduce_axes,
+                             dtype=jnp.float32).astype(gamma.dtype)
+        return dx, dgamma, dbeta
+
+    def bn_fwd_full(data, gamma, beta):
+        return bn_fwd(data, gamma, beta)
+
+    bn.defvjp(bn_fwd_full, bn_bwd)
+    return bn
 
 
 @register("InstanceNorm")

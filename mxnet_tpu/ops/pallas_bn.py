@@ -1,0 +1,169 @@
+"""Pallas TPU kernels for BatchNorm's channel reductions.
+
+Profiling the ResNet-50 fused step (bench.py, TPU v5e) shows the BN stat
+and BN-backward reductions — `convert_reduce`/`multiply_reduce` fusions —
+eating ~45% of device step time at ~175-260 GB/s, far under HBM peak,
+while the convs themselves run at ~75% MFU.  These kernels stream the
+activation once per pass and accumulate per-channel sums in fp32.
+
+Forward needs (Σx, Σx²); backward needs (Σdy, Σdy·x̂) — both are one
+read-only pass over activation-sized data with a (C,) result, the
+memory-streaming shape Pallas is for (reference for the BN gradient
+algebra: ``src/operator/batch_norm-inl.h`` in the reference repo).
+
+Blocks are (NB, C, HW) slices of the NCHW tensor viewed as (N, C, H·W):
+HW rides the 128-lane dimension, so the path is gated to HW ≥ 128 (late
+ResNet stages with 7×7 maps would pad lanes 2.6× and are cheap to reduce
+anyway) and C a multiple of the bf16 sublane tile.  Used by
+``nn_ops._bn_train``; everything else falls back to the jnp formulation.
+Set ``MXNET_BN_PALLAS=0`` to disable.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bn_stats", "bn_grad_sums", "pallas_bn_enabled"]
+
+_LANE = 128
+# bytes/element resident in VMEM per input stream: bf16 block + one fp32
+# temp + headroom for the compiler's double buffering
+_VMEM_BYTES_PER_ELEM = 10
+_VMEM_BUDGET = 8 << 20
+
+
+def _hw_pad(hw):
+    return -(-hw // _LANE) * _LANE
+
+
+def pallas_bn_enabled(data, streams=1):
+    from ..base import get_env
+
+    # Off by default: measured end-to-end on ResNet-50/v5e these kernels
+    # LOSE to XLA's reduce fusions (~140 vs ~260 GB/s) — with HW on the
+    # lane dimension the cross-lane reduction is VPU-compute-bound, and
+    # the pallas_call boundary also blocks producer fusion.  Kept as the
+    # custom-kernel facility + a working example; the NHWC layout path
+    # (C on lanes) is the layout under which streaming BN kernels win.
+    if not get_env("MXNET_BN_PALLAS", False, bool):
+        return False
+    if data.ndim != 4:
+        return False
+    n, c, h, w = data.shape
+    hw = h * w
+    if hw < _LANE or c < 32 or c % 16 != 0:
+        return False
+    # one batch row must fit the budget even at NB=1
+    if streams * c * _hw_pad(hw) * _VMEM_BYTES_PER_ELEM > _VMEM_BUDGET:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _pick_nb(n, c, hw, streams=1):
+    """Rows per grid step: biggest power-of-two divisor of n keeping the
+    (padded) resident block set under the VMEM budget."""
+    per_row = streams * c * _hw_pad(hw) * _VMEM_BYTES_PER_ELEM
+    nb = 1
+    while nb * 2 <= n and n % (nb * 2) == 0 and \
+            nb * 2 * per_row <= _VMEM_BUDGET:
+        nb *= 2
+    return nb
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bn_stats(x4d, interpret=False):
+    """Per-channel (Σx, Σx²) over (N, H, W) of an NCHW tensor, fp32
+    accumulation.  Returns two (C,) fp32 arrays."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, c, h, w = x4d.shape
+    hw = h * w
+    x = x4d.reshape(n, c, hw)
+    nb = _pick_nb(n, c, hw)
+
+    def kernel(x_ref, s1_ref, s2_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            s1_ref[...] = jnp.zeros_like(s1_ref)
+            s2_ref[...] = jnp.zeros_like(s2_ref)
+
+        xf = x_ref[...].astype(jnp.float32)  # (NB, C, HW)
+        s1_ref[0, :] += jnp.sum(xf, axis=(0, 2))
+        s2_ref[0, :] += jnp.sum(xf * xf, axis=(0, 2))
+
+    s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(n // nb,),
+        in_specs=[pl.BlockSpec((nb, c, hw), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((1, c), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, c), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        interpret=interpret,
+    )(x)
+    return s1[0], s2[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def bn_grad_sums(dy4d, x4d, mean, inv, interpret=False):
+    """Per-channel (Σdy, Σdy·x̂) with x̂ = (x−mean)·inv computed inline,
+    fp32 accumulation.  Returns two (C,) fp32 arrays.
+
+    These two sums are sufficient for the whole BN backward:
+    dβ = Σdy, dγ = Σdy·x̂, and dx = γ·inv·(dy − E[dy] − x̂·E[dy·x̂])
+    (the γ factor folds in per-channel outside the kernel).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, c, h, w = x4d.shape
+    hw = h * w
+    x = x4d.reshape(n, c, hw)
+    dy = dy4d.reshape(n, c, hw)
+    nb = _pick_nb(n, c, hw, streams=2)
+    mean2d = mean.reshape(1, c).astype(jnp.float32)
+    inv2d = inv.reshape(1, c).astype(jnp.float32)
+
+    def kernel(dy_ref, x_ref, m_ref, i_ref, s1_ref, s2_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            s1_ref[...] = jnp.zeros_like(s1_ref)
+            s2_ref[...] = jnp.zeros_like(s2_ref)
+
+        m = m_ref[0, :].reshape(1, c, 1)
+        iv = i_ref[0, :].reshape(1, c, 1)
+        dyf = dy_ref[...].astype(jnp.float32)   # (NB, C, HW)
+        xhat = (x_ref[...].astype(jnp.float32) - m) * iv
+        s1_ref[0, :] += jnp.sum(dyf, axis=(0, 2))
+        s2_ref[0, :] += jnp.sum(dyf * xhat, axis=(0, 2))
+
+    s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(n // nb,),
+        in_specs=[pl.BlockSpec((nb, c, hw), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((nb, c, hw), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, c), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((1, c), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((1, c), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, c), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        interpret=interpret,
+    )(dy, x, mean2d, inv2d)
+    return s1[0], s2[0]

@@ -367,7 +367,11 @@ def _fill_param_shapes(node, env, shapes):
         nf = int(a["num_filter"])
         ng = int(a.get("num_group", 1))
         kernel = tuple(int(k) for k in a["kernel"])
-        set_var(1, (nf, data[1] // ng) + kernel)
+        if a.get("layout") in ("NWC", "NHWC", "NDHWC"):
+            # channels-last: weight (O, *kernel, I/g) — cuDNN-NHWC form
+            set_var(1, (nf,) + kernel + (data[-1] // ng,))
+        else:
+            set_var(1, (nf, data[1] // ng) + kernel)
         if len(node.inputs) > 2:
             set_var(2, (nf,))
     elif op == "Deconvolution":

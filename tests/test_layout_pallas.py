@@ -1,0 +1,113 @@
+"""NHWC layout equivalence + pallas BN kernels (interpret mode).
+
+NHWC is the TPU-native layout option (channels on the 128-lane dim);
+numerics must match the NCHW reference path exactly.  The pallas kernels
+are gated off by default (XLA wins on NCHW — see ops/pallas_bn.py) but
+must stay correct; interpret mode runs them on CPU.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import imperative_invoke
+
+
+def _rand(*shape):
+    return np.random.RandomState(3).randn(*shape).astype("float32")
+
+
+def test_conv_nhwc_matches_nchw():
+    x = _rand(2, 5, 10, 10)       # NCHW
+    w = _rand(7, 5, 3, 3)         # OIHW
+    b = _rand(7)
+    out_nchw = imperative_invoke(
+        "Convolution", [mx.nd.array(x), mx.nd.array(w), mx.nd.array(b)],
+        {"kernel": (3, 3), "num_filter": 7, "stride": (2, 2),
+         "pad": (1, 1)})[0].asnumpy()
+    x_l = np.transpose(x, (0, 2, 3, 1))          # NHWC
+    w_l = np.transpose(w, (0, 2, 3, 1))          # OHWI
+    out_nhwc = imperative_invoke(
+        "Convolution", [mx.nd.array(x_l), mx.nd.array(w_l), mx.nd.array(b)],
+        {"kernel": (3, 3), "num_filter": 7, "stride": (2, 2),
+         "pad": (1, 1), "layout": "NHWC"})[0].asnumpy()
+    np.testing.assert_allclose(np.transpose(out_nhwc, (0, 3, 1, 2)),
+                               out_nchw, rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_conv_nhwc_matches_nchw():
+    x = _rand(2, 6, 8, 8)
+    w = _rand(6, 3, 3, 3)   # groups=2: (O, I/g, kh, kw)
+    a = {"kernel": (3, 3), "num_filter": 6, "pad": (1, 1), "num_group": 2}
+    out_nchw = imperative_invoke(
+        "Convolution", [mx.nd.array(x), mx.nd.array(w)],
+        dict(a, no_bias=True))[0].asnumpy()
+    out_nhwc = imperative_invoke(
+        "Convolution",
+        [mx.nd.array(np.transpose(x, (0, 2, 3, 1))),
+         mx.nd.array(np.transpose(w, (0, 2, 3, 1)))],
+        dict(a, no_bias=True, layout="NHWC"))[0].asnumpy()
+    np.testing.assert_allclose(np.transpose(out_nhwc, (0, 3, 1, 2)),
+                               out_nchw, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+@pytest.mark.parametrize("global_pool", [False, True])
+def test_pooling_nhwc_matches_nchw(pool_type, global_pool):
+    x = _rand(2, 4, 9, 9)
+    attrs = {"kernel": (3, 3), "stride": (2, 2), "pad": (1, 1),
+             "pool_type": pool_type, "global_pool": global_pool,
+             "pooling_convention": "full"}
+    out_nchw = imperative_invoke("Pooling", [mx.nd.array(x)],
+                                 dict(attrs))[0].asnumpy()
+    out_nhwc = imperative_invoke(
+        "Pooling", [mx.nd.array(np.transpose(x, (0, 2, 3, 1)))],
+        dict(attrs, layout="NHWC"))[0].asnumpy()
+    np.testing.assert_allclose(np.transpose(out_nhwc, (0, 3, 1, 2)),
+                               out_nchw, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_nhwc_symbol_binds_and_trains():
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.fused import TrainStep
+    import jax
+    import jax.numpy as jnp
+
+    sym = resnet.get_symbol(num_classes=4, num_layers=20,
+                            image_shape=(3, 32, 32), layout="NHWC")
+    step = TrainStep(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    shapes = {"data": (4, 32, 32, 3), "softmax_label": (4,)}
+    p, a, s = step.init_state(shapes)
+    rng = jax.random.PRNGKey(0)
+    bd = {"data": jax.random.normal(rng, shapes["data"], "float32"),
+          "softmax_label": jnp.zeros((4,), "float32")}
+    p2, a2, s2, out = step(p, a, s, bd, rng)
+    assert out.shape == (4, 4)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_pallas_bn_stats_interpret():
+    from mxnet_tpu.ops.pallas_bn import bn_stats
+
+    x = _rand(4, 32, 16, 8)
+    s1, s2 = bn_stats(x, interpret=True)
+    ref1 = x.astype("float64").sum(axis=(0, 2, 3))
+    ref2 = (x.astype("float64") ** 2).sum(axis=(0, 2, 3))
+    np.testing.assert_allclose(np.asarray(s1), ref1, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2), ref2, rtol=1e-4)
+
+
+def test_pallas_bn_grad_sums_interpret():
+    from mxnet_tpu.ops.pallas_bn import bn_grad_sums
+
+    x = _rand(4, 32, 16, 8)
+    dy = np.random.RandomState(5).randn(*x.shape).astype("float32")
+    mean = x.mean(axis=(0, 2, 3))
+    inv = 1.0 / np.sqrt(x.var(axis=(0, 2, 3)) + 1e-3)
+    s1, s2 = bn_grad_sums(dy, x, mean, inv, interpret=True)
+    xhat = (x - mean.reshape(1, -1, 1, 1)) * inv.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(np.asarray(s1), dy.sum(axis=(0, 2, 3)),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2),
+                               (dy * xhat).sum(axis=(0, 2, 3)),
+                               rtol=1e-4, atol=1e-4)

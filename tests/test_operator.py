@@ -321,3 +321,49 @@ def test_pooling_full_convention():
                    pooling_convention="full")
     assert v.shape == (1, 1, 2, 2)
     assert f.shape == (1, 1, 3, 3)
+
+
+def test_batchnorm_custom_backward_matches_autodiff():
+    """The hand-written BN train backward (nn_ops._bn_train) must match
+    autodiff through a straightforward fp32 reference, for both
+    fix_gamma settings and both 2D/4D data."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn_ops import _bn_train
+
+    eps = 1e-3
+    rs = np.random.RandomState(5)
+    for shape, axis in [((6, 4), 1), ((4, 3, 5, 5), 1)]:
+        data = jnp.asarray(rs.randn(*shape).astype("float32"))
+        gamma = jnp.asarray((rs.rand(shape[axis]) + 0.5).astype("float32"))
+        beta = jnp.asarray(rs.randn(shape[axis]).astype("float32"))
+        dy = jnp.asarray(rs.randn(*shape).astype("float32"))
+        reduce_axes = tuple(i for i in range(len(shape)) if i != axis)
+        bshape = tuple(shape[axis] if i == axis else 1
+                       for i in range(len(shape)))
+
+        for fix_gamma in (False, True):
+            def ref(d, g, b):
+                mean = jnp.mean(d, axis=reduce_axes)
+                var = jnp.var(d, axis=reduce_axes)
+                gg = jnp.ones_like(g) if fix_gamma else g
+                xhat = (d - mean.reshape(bshape)) * jax.lax.rsqrt(
+                    var.reshape(bshape) + eps)
+                return xhat * gg.reshape(bshape) + b.reshape(bshape)
+
+            out_ref, ref_vjp = jax.vjp(ref, data, gamma, beta)
+            dx_r, dg_r, db_r = ref_vjp(dy)
+
+            bn = _bn_train(eps, axis, fix_gamma)
+            (out, mean, var), vjp = jax.vjp(bn, data, gamma, beta)
+            dx, dg, db = vjp((dy, jnp.zeros_like(mean),
+                              jnp.zeros_like(var)))
+
+            np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                                       rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_r),
+                                       rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(np.asarray(dg), np.asarray(dg_r),
+                                       rtol=1e-3, atol=1e-4)
+            np.testing.assert_allclose(np.asarray(db), np.asarray(db_r),
+                                       rtol=1e-3, atol=1e-4)
