@@ -56,14 +56,16 @@ def _measure(step, shapes, batch, iters=20):
         "data": jax.random.normal(rng, shapes["data"], "float32"),
         "softmax_label": jnp.zeros(shapes["softmax_label"], "float32"),
     }
-    # XLA's own FLOP count of the compiled step (MAC=2 convention,
-    # includes fwd+bwd+optimizer) — the honest numerator for MFU
+    # XLA's own FLOP count of the step (MAC=2 convention, includes
+    # fwd+bwd+optimizer) — the honest numerator for MFU.  Taken from the
+    # Lowered object so no second backend compile happens (lower() is
+    # host-side tracing; the jit dispatch below compiles once).
     xla_flops = None
     try:
-        comp = step._jit_step.lower(
+        lowered = step._jit_step.lower(
             params, aux, states, batch_dict, rng, step.lr,
-            jnp.asarray(1, "int32")).compile()
-        ca = comp.cost_analysis()
+            jnp.asarray(1, "int32"))
+        ca = lowered.cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         xla_flops = float(ca.get("flops", 0.0)) or None
     except Exception:

@@ -27,6 +27,8 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import io
+from . import recordio
+from . import image
 from . import kvstore
 from . import kvstore as kv
 from . import callback

@@ -1,0 +1,516 @@
+"""Image IO + augmentation + record-backed iterators.
+
+Reference surface: ``python/mxnet/image/image.py`` (pure-Python ImageIter
++ augmenter chain) and the C++ ``ImageRecordIter``
+(``src/io/iter_image_recordio_2.cc:513`` — sharded multithreaded decode,
+``src/io/image_aug_default.cc`` — the default augmenter chain).
+
+TPU-native re-design: decode and augmentation are host-side work whose
+only job is to keep the device fed, so the pipeline is numpy/PIL with a
+thread pool for decode (PIL JPEG decode releases the GIL) feeding the
+existing ``PrefetchingIter`` double-buffer — the role of the reference's
+``dmlc::ThreadedIter``.  Arrays are RGB (the reference's cv2 path is BGR;
+consistent within this library).  Sharded reading for multi-host uses the
+same ``part_index``/``num_parts`` contract as the reference C iter.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import os
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from . import recordio
+
+__all__ = ["imdecode", "imread", "imresize", "copyMakeBorder",
+           "scale_down", "resize_short", "fixed_crop", "random_crop",
+           "center_crop", "color_normalize", "random_size_crop",
+           "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+           "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "LightingAug", "ColorNormalizeAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+_PIL_INTERP = None
+
+
+def _interp(method):
+    """Map the reference's cv2 interpolation codes onto PIL resamplers."""
+    global _PIL_INTERP
+    if _PIL_INTERP is None:
+        from PIL import Image
+
+        _PIL_INTERP = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BOX,
+                       3: Image.BICUBIC, 4: Image.LANCZOS}
+    if method == 10:
+        method = random.choice((0, 1, 2, 3, 4))
+    if method == 9:
+        method = 2
+    return _PIL_INTERP.get(method, _PIL_INTERP[1])
+
+
+# -- host image ops (reference src/io/image_io.cc registers these as ops) ---
+
+def imdecode(buf, to_rgb=1, flag=1):
+    """Decode an encoded image buffer to an HWC uint8 array (reference
+    ``mx.image.imdecode`` / the ``_cvimdecode`` op)."""
+    from PIL import Image
+
+    img = Image.open(_pyio.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def imread(filename, flag=1):
+    """Read an image file (reference ``_cvimread``)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (h, w) (reference ``_cvimresize``)."""
+    from PIL import Image
+
+    img = Image.fromarray(np.asarray(src, dtype=np.uint8).squeeze())
+    return np.asarray(img.resize((w, h), _interp(interp))).reshape(
+        (h, w) + ((src.shape[2],) if src.ndim == 3 else ()))
+
+
+def copyMakeBorder(src, top, bot, left, right, fill_value=0):
+    """Pad with a constant border (reference ``_cvcopyMakeBorder``)."""
+    pads = [(top, bot), (left, right)] + [(0, 0)] * (src.ndim - 2)
+    return np.pad(src, pads, constant_values=fill_value)
+
+
+# -- functional augment helpers (reference image.py:139-480) ----------------
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32)
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = random.uniform(min_area, 1.0) * area
+        ar = random.uniform(*ratio)
+        new_w = int(round((target_area * ar) ** 0.5))
+        new_h = int(round((target_area / ar) ** 0.5))
+        if random.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return random_crop(src, size, interp)
+
+
+# -- augmenter classes (reference image.py:482-860) -------------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size, self.min_area, self.ratio, self.interp = \
+            size, min_area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return (src.astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        src = src.astype(np.float32)
+        gray = (src * self._coef).sum() * (3.0 / src.size)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        src = src.astype(np.float32)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return src.astype(np.float32) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return src.astype(np.float32)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Build the default augmenter chain (reference ``CreateAugmenter``,
+    matching ``src/io/image_aug_default.cc`` order: resize → crop →
+    mirror → color jitter → pca noise → cast → normalize)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    jitters = []
+    if brightness:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if jitters:
+        auglist.append(RandomOrderAug(jitters))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over RecordIO (or an image list) with augmenters —
+    the reference's Python ``ImageIter``, doubling as the backing for
+    ``io.ImageRecordIter`` (C iter ``iter_image_recordio_2.cc:513``).
+
+    Supports ``part_index``/``num_parts`` sharding (each worker reads a
+    contiguous slice of the key space, like ``dmlc::InputSplit``),
+    shuffling, and a thread pool for decode+augment.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imgidx=None, path_imglist=None,
+                 path_root="", shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", last_batch_handle="pad",
+                 num_threads=4, **kwargs):
+        super().__init__(batch_size)
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise MXNetError("invalid part_index %d / num_parts %d"
+                             % (part_index, num_parts))
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.record = None
+        self.imglist = None
+        if path_imgrec:
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+            if not os.path.exists(idx_path):
+                raise MXNetError(
+                    "ImageIter needs the .idx sidecar for %s (pack with "
+                    "tools/im2rec.py or MXIndexedRecordIO)" % path_imgrec)
+            self.record = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                    "r")
+            keys = list(self.record.keys)
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                imglist = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        imglist.append((
+                            [float(x) for x in parts[1:-1]], parts[-1]))
+            self.imglist = [(np.asarray(lbl, np.float32),
+                             os.path.join(path_root, fname))
+                            for lbl, fname in imglist]
+            keys = list(range(len(self.imglist)))
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist "
+                             "or imglist")
+        # dmlc::InputSplit-style contiguous sharding
+        total = len(keys)
+        begin = total * part_index // num_parts
+        end = total * (part_index + 1) // num_parts
+        self.keys = keys[begin:end]
+        if not self.keys:
+            raise MXNetError("empty shard %d/%d (%d records)"
+                             % (part_index, num_parts, total))
+        self.aug_list = CreateAugmenter(data_shape) if aug_list is None \
+            else aug_list
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        # record seek+read must be atomic (one shared file handle across
+        # the decode pool); decode/augment run outside the lock
+        self._rec_lock = threading.Lock()
+        self.cur = 0
+        self._order = list(self.keys)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape,
+                         np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self._order)
+        if self.record is not None:
+            self.record.reset()
+        self.cur = 0
+
+    def _load_one(self, key):
+        if self.record is not None:
+            with self._rec_lock:
+                raw = self.record.read_idx(key)
+            header, img = recordio.unpack_img(raw)
+            label = header.label
+        else:
+            label, fname = self.imglist[key]
+            img = imread(fname)
+        for aug in self.aug_list:
+            img = aug(img)
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.data_shape
+        if img.shape[:2] != (h, w):
+            img = imresize(img.astype(np.uint8), w, h)
+            img = np.asarray(img, np.float32).reshape(h, w, c)
+        return img.transpose(2, 0, 1), np.asarray(label, np.float32)
+
+    def next(self):
+        if self.cur >= len(self._order):
+            raise StopIteration
+        want = self._order[self.cur:self.cur + self.batch_size]
+        pad = self.batch_size - len(want)
+        if pad:
+            if self.last_batch_handle == "discard":
+                self.cur = len(self._order)
+                raise StopIteration
+            want = want + self._order[:pad]
+        self.cur += self.batch_size
+        loaded = list(self._pool.map(self._load_one, want))
+        data = np.stack([x[0] for x in loaded])
+        labels = np.stack([x[1] for x in loaded])
+        if self.label_width == 1:
+            labels = labels.reshape(self.batch_size, -1)[:, 0]
+        from .ndarray import array
+
+        return DataBatch(data=[array(data)], label=[array(labels)],
+                         pad=pad, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            self._next_batch = None
+            return False
+
+    def getdata(self):
+        return self._next_batch.data
+
+    def getlabel(self):
+        return self._next_batch.label
+
+    def getindex(self):
+        return self._next_batch.index
+
+    def getpad(self):
+        return self._next_batch.pad

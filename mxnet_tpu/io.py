@@ -19,7 +19,7 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -235,6 +235,7 @@ class PrefetchingIter(DataIter):
         self._stop = threading.Event()
         self._thread = None
         self.current_batch = None
+        self._worker_error = None
         self._start()
 
     @property
@@ -262,6 +263,10 @@ class PrefetchingIter(DataIter):
             except StopIteration:
                 self._queue.put(None)
                 return
+            except Exception as exc:  # surface at next() like ThreadedIter
+                if not self._stop.is_set():
+                    self._queue.put(exc)
+                return
             self._queue.put(batches)
 
     def _start(self):
@@ -279,6 +284,7 @@ class PrefetchingIter(DataIter):
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._drain()
+        self._worker_error = None
         for i in self.iters:
             i.reset()
         self._start()
@@ -291,9 +297,17 @@ class PrefetchingIter(DataIter):
             pass
 
     def iter_next(self):
+        if self._worker_error is not None:
+            # the worker died on this error; keep surfacing it (a fresh
+            # reset() restarts the stream) instead of hanging on the
+            # empty queue
+            raise self._worker_error
         batches = self._queue.get()
         if batches is None:
             return False
+        if isinstance(batches, Exception):
+            self._worker_error = batches
+            raise batches
         self.current_batch = DataBatch(
             data=sum([b.data for b in batches], []),
             label=sum([(b.label or []) for b in batches], []),
@@ -369,3 +383,43 @@ class MNISTIter(NDArrayIter):
                                     images.shape[1], images.shape[2])
         super().__init__(images, labels, batch_size=batch_size,
                          shuffle=shuffle, **kwargs)
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
+                    label_width=1, shuffle=False, part_index=0, num_parts=1,
+                    resize=0, rand_crop=False, rand_mirror=False,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=0.0, std_g=0.0, std_b=0.0,
+                    max_random_contrast=0, max_random_illumination=0,
+                    preprocess_threads=4, prefetch_buffer=2,
+                    data_name="data", label_name="softmax_label", **kwargs):
+    """RecordIO-backed image iterator (reference C iterator
+    ``ImageRecordIter``, ``src/io/iter_image_recordio_2.cc:513`` + the
+    default augmenter chain ``src/io/image_aug_default.cc``).
+
+    Factory with the C iterator's parameter surface: builds an
+    :class:`~mxnet_tpu.image.ImageIter` with the matching augmenter list
+    (resize -> crop -> mirror -> jitter -> normalize), threaded decode,
+    ``part_index``/``num_parts`` sharding, and wraps it in
+    :class:`PrefetchingIter` so host decode overlaps device steps.
+    """
+    from . import image as img_mod
+
+    mean = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    std = None
+    if std_r or std_g or std_b:
+        std = np.array([std_r or 1.0, std_g or 1.0, std_b or 1.0],
+                       np.float32)
+    aug_list = img_mod.CreateAugmenter(
+        data_shape, resize=resize, rand_crop=rand_crop,
+        rand_mirror=rand_mirror, mean=mean, std=std,
+        contrast=max_random_contrast, brightness=max_random_illumination)
+    inner = img_mod.ImageIter(
+        batch_size, data_shape, label_width=label_width,
+        path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
+        part_index=part_index, num_parts=num_parts, aug_list=aug_list,
+        data_name=data_name, label_name=label_name,
+        num_threads=preprocess_threads, **kwargs)
+    return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)

@@ -49,7 +49,10 @@ def pallas_bn_enabled(data, streams=1):
     # (C on lanes) is the layout under which streaming BN kernels win.
     if not get_env("MXNET_BN_PALLAS", False, bool):
         return False
-    if data.ndim != 4:
+    if data.ndim != 4 or data.dtype != jnp.bfloat16:
+        # bf16 only: it is the case the kernel was justified for, and the
+        # fp32 jnp path keeps the stable two-pass variance these kernels'
+        # E[x^2]-E[x]^2 form would lose
         return False
     n, c, h, w = data.shape
     hw = h * w

@@ -1,0 +1,262 @@
+"""RecordIO — binary record pack format + sequential/indexed readers.
+
+Reference: ``python/mxnet/recordio.py`` over dmlc-core's recordio
+(``dmlc::RecordIOWriter/Reader``; SURVEY.md §2.1 Data IO).  The wire
+format is dmlc's: each record is framed as
+
+    uint32 magic = 0xced7230a
+    uint32 lrec  = (cflag << 29) | payload_length
+    payload bytes, zero-padded to a 4-byte boundary
+
+``cflag`` marks continuation pieces of records that contain the magic in
+their payload (dmlc splits those); this implementation writes complete
+records (cflag 0) and reassembles split records (1=start/2=middle/3=end)
+on read, so files produced by the reference C++ writer load correctly.
+
+``MXIndexedRecordIO`` adds the reference's ``.idx`` sidecar ("key\\toffset"
+per line) for O(1) ``read_idx`` — the random-access substrate for
+shuffled/sharded ``ImageRecordIter`` epochs.
+
+The image payload convention (``IRHeader`` + ``pack``/``unpack``) matches
+the reference exactly: a little-endian ``IfQQ`` header (flag, label, id,
+id2); when ``flag > 0`` the label is a float vector of that length stored
+after the header.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_KMAGIC = 0xced7230a
+_STRUCT_U32 = struct.Struct("<I")
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential reader/writer (reference ``MXRecordIO``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self._fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self._fp.close()
+            self.is_open = False
+
+    def reset(self):
+        """Reset the read head to the start (reference semantics: close +
+        reopen)."""
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        lrec = len(buf)  # cflag 0: complete record
+        self._fp.write(_STRUCT_U32.pack(_KMAGIC))
+        self._fp.write(_STRUCT_U32.pack(lrec))
+        self._fp.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def _read_one(self):
+        head = self._fp.read(4)
+        if len(head) < 4:
+            return None, None
+        magic = _STRUCT_U32.unpack(head)[0]
+        if magic != _KMAGIC:
+            raise MXNetError("invalid RecordIO magic 0x%08x in %s"
+                             % (magic, self.uri))
+        lrec = _STRUCT_U32.unpack(self._fp.read(4))[0]
+        cflag, length = lrec >> 29, lrec & ((1 << 29) - 1)
+        data = self._fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self._fp.read(pad)
+        return cflag, data
+
+    def read(self):
+        """Read one logical record; returns bytes or None at EOF."""
+        assert not self.writable
+        cflag, data = self._read_one()
+        if cflag is None:
+            return None
+        if cflag == 0:
+            return data
+        if cflag != 1:
+            raise MXNetError("corrupt RecordIO: unexpected cflag %d" % cflag)
+        parts = [data]
+        while True:
+            cflag, data = self._read_one()
+            if cflag is None:
+                raise MXNetError("corrupt RecordIO: truncated split record")
+            parts.append(data)
+            if cflag == 3:
+                return b"".join(parts)
+            if cflag != 2:
+                raise MXNetError("corrupt RecordIO: unexpected cflag %d"
+                                 % cflag)
+
+    def tell(self):
+        assert self.writable
+        return self._fp.tell()
+
+    def __del__(self):
+        self.close()
+
+    # pickling support mirrors the reference (reopen on restore)
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["is_open"] = False
+        del d["_fp"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if d.get("flag") is not None:
+            self.open()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with an index sidecar (reference
+    ``MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = None
+            if os.path.exists(self.idx_path):
+                with open(self.idx_path) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) != 2:
+                            continue
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.is_open:
+            super().close()
+            if getattr(self, "fidx", None) is not None:
+                self.fidx.close()
+                self.fidx = None
+
+    def seek(self, idx):
+        assert not self.writable
+        self._fp.seek(self.idx[idx])
+
+    def tell(self):
+        return self._fp.tell()
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# -- image-record payloads (reference pack/unpack) --------------------------
+
+def pack(header, s):
+    """Prepend an IRHeader to raw bytes (reference ``recordio.pack``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        out = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                          header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        out = struct.pack(_IR_FORMAT, label.size, 0.0,
+                          header.id, header.id2) + label.tobytes()
+    if isinstance(s, str):
+        s = s.encode("utf-8")
+    return out + s
+
+
+def unpack(s):
+    """Split an image record into (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 RGB array and pack it (reference ``pack_img``;
+    divergence: encoding uses PIL, arrays are RGB — the reference's cv2
+    path stores BGR.  Files written and read by THIS library round-trip;
+    reading reference-written records through ``unpack_img`` yields
+    channel-swapped data unless the caller flips)."""
+    import io as _io
+
+    from PIL import Image
+
+    img = np.asarray(img, dtype=np.uint8)
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[:, :, 0]  # PIL cannot handle (H, W, 1)
+    fmt = img_fmt.lower().lstrip(".")
+    fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG"}.get(fmt)
+    if fmt is None:
+        raise MXNetError("unsupported image format %r" % img_fmt)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack to (IRHeader, HWC uint8 array) (reference ``unpack_img``)."""
+    import io as _io
+
+    from PIL import Image
+
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    img = img.convert("RGB" if iscolor else "L")
+    return header, np.asarray(img)

@@ -1,0 +1,177 @@
+"""RecordIO + image pipeline (reference tests: test_recordio.py,
+test_image.py; the end-to-end criterion is the reference's
+train_cifar10.py path: pack images → ImageRecordIter → Module.fit)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(7):
+        rec.write(b"record_%d" % i + b"x" * i)  # varied pad lengths
+    rec.close()
+    rec = recordio.MXRecordIO(path, "r")
+    for i in range(7):
+        assert rec.read() == b"record_%d" % i + b"x" * i
+    assert rec.read() is None
+    rec.reset()
+    assert rec.read() == b"record_0"
+    rec.close()
+
+
+def test_indexed_recordio_seek(tmp_path):
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "t.idx"),
+                                     str(tmp_path / "t.rec"), "w")
+    for i in range(10):
+        rec.write_idx(i, ("payload-%d" % i) * (i + 1))
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "t.idx"),
+                                     str(tmp_path / "t.rec"), "r")
+    assert rec.keys == list(range(10))
+    for i in (3, 0, 9, 5):
+        assert rec.read_idx(i) == (("payload-%d" % i) * (i + 1)).encode()
+    rec.close()
+
+
+def test_pack_unpack_scalar_and_vector_label():
+    h = recordio.IRHeader(0, 4.0, 42, 0)
+    s = recordio.pack(h, b"blob")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"blob" and h2.label == 4.0 and h2.id == 42
+
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    h2, payload = recordio.unpack(recordio.pack(h, b"img"))
+    np.testing.assert_array_equal(h2.label, [1, 2, 3])
+    assert h2.flag == 3
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.RandomState(0).rand(17, 13, 3) * 255).astype("uint8")
+    h = recordio.IRHeader(0, 1.0, 0, 0)
+    # PNG is lossless: exact round-trip
+    h2, out = recordio.unpack_img(recordio.pack_img(h, img, img_fmt=".png"))
+    np.testing.assert_array_equal(out, img)
+    # JPEG: lossy, just close
+    h2, out = recordio.unpack_img(recordio.pack_img(h, img, quality=95))
+    assert out.shape == img.shape
+
+
+def _make_rec(tmp_path, n=40, hw=12, classes=4):
+    """Pack synthetic class-colored images (class k = distinct base color,
+    so a tiny convnet can learn them)."""
+    rs = np.random.RandomState(0)
+    prefix = str(tmp_path / "synth")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    colors = (rs.rand(classes, 3) * 200 + 30).astype("uint8")
+    for i in range(n):
+        label = i % classes
+        img = np.clip(colors[label][None, None, :].astype("int32") +
+                      rs.randint(-20, 20, (hw, hw, 3)), 0, 255
+                      ).astype("uint8")
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(label), i, 0), img, img_fmt=".png"))
+    rec.close()
+    return prefix
+
+
+def test_image_iter_shapes_and_shard_disjoint(tmp_path):
+    from mxnet_tpu.image import ImageIter
+
+    prefix = _make_rec(tmp_path)
+    it = ImageIter(8, (3, 12, 12), path_imgrec=prefix + ".rec")
+    b = it.next()
+    assert b.data[0].shape == (8, 3, 12, 12)
+    assert b.label[0].shape == (8,)
+
+    seen = []
+    for part in range(3):
+        shard = ImageIter(4, (3, 12, 12), path_imgrec=prefix + ".rec",
+                          part_index=part, num_parts=3)
+        seen.append(set(shard.keys))
+    assert not (seen[0] & seen[1]) and not (seen[1] & seen[2])
+    assert seen[0] | seen[1] | seen[2] == set(range(40))
+
+
+def test_image_record_iter_epoch_and_reset(tmp_path):
+    prefix = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 12, 12), batch_size=10)
+    n1 = sum(1 for _ in it)
+    it.reset()
+    n2 = sum(1 for _ in it)
+    assert n1 == n2 == 4
+
+
+def test_augmenter_chain():
+    from mxnet_tpu.image import CreateAugmenter
+
+    img = (np.random.RandomState(1).rand(40, 30, 3) * 255).astype("uint8")
+    augs = CreateAugmenter((3, 16, 16), resize=20, rand_crop=True,
+                           rand_mirror=True, mean=True, std=True,
+                           brightness=0.1, contrast=0.1, saturation=0.1,
+                           pca_noise=0.05)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (16, 16, 3)
+    assert out.dtype == np.float32
+
+
+def test_train_resnet_through_record_pipeline(tmp_path):
+    """VERDICT r2 'done' criterion: pack images to .rec, train a small
+    ResNet end-to-end through ImageRecordIter with the prefetcher."""
+    prefix = _make_rec(tmp_path, n=64, hw=8, classes=2)
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 8, 8), batch_size=16,
+                               shuffle=True,
+                               mean_r=128, mean_g=128, mean_b=128,
+                               std_r=64, std_g=64, std_b=64)
+    from mxnet_tpu.models import resnet
+
+    sym = resnet.get_symbol(num_classes=2, num_layers=8,
+                            image_shape=(3, 8, 8))
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer="adam", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.01})
+    score = dict(mod.score(it, mx.metric.Accuracy()))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_im2rec_tool(tmp_path):
+    """The im2rec CLI packs a directory and ImageIter reads it back."""
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = (np.random.RandomState(i).rand(10, 10, 3) * 255
+                   ).astype("uint8")
+            Image.fromarray(arr).save(root / cls / ("%d.png" % i))
+    prefix = str(tmp_path / "packed")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "im2rec.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, tool, "--list", prefix, str(root)],
+                   check=True, env=env)
+    assert os.path.exists(prefix + ".lst")
+    subprocess.run([sys.executable, tool, prefix, str(root),
+                    "--encoding", ".png"], check=True, env=env)
+    from mxnet_tpu.image import ImageIter
+
+    it = ImageIter(2, (3, 10, 10), path_imgrec=prefix + ".rec")
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 10, 10)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(b.label[0].asnumpy().tolist())
+    assert labels == {0.0, 1.0}

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""im2rec — pack an image list into RecordIO (reference ``tools/im2rec.py``
+/ ``tools/im2rec.cc``: parallel image → RecordIO packer).
+
+Two subcommands, like the reference:
+
+* ``--list``: walk an image directory and write ``prefix.lst``
+  (``index\\tlabel\\trelpath`` per line, labels from per-directory class
+  indices, with ``--train-ratio``/``--test-ratio`` splits).
+* default: read ``prefix.lst`` and pack ``prefix.rec`` + ``prefix.idx``
+  via ``MXIndexedRecordIO``, re-encoding each image (``--resize`` short
+  side, ``--quality``, ``--color``) with a worker pool.
+
+Usage::
+
+    python tools/im2rec.py --list prefix image_root
+    python tools/im2rec.py prefix image_root [--resize 256] [--quality 95]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+_EXTS = (".jpg", ".jpeg", ".png")
+
+
+def list_images(root):
+    cat = {}
+    items = []
+    for path, _, files in sorted(os.walk(root, followlinks=True)):
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() not in _EXTS:
+                continue
+            rel = os.path.relpath(os.path.join(path, fname), root)
+            label_dir = os.path.dirname(rel)
+            if label_dir not in cat:
+                cat[label_dir] = len(cat)
+            items.append((len(items), cat[label_dir], rel))
+    return items
+
+
+def write_list(prefix, items, args):
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(items)
+    n_train = int(len(items) * args.train_ratio)
+    chunks = {"": items}
+    if args.train_ratio < 1.0:
+        chunks = {"_train": items[:n_train], "_val": items[n_train:]}
+    for suffix, chunk in chunks.items():
+        with open(prefix + suffix + ".lst", "w") as f:
+            for i, (idx, label, rel) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, label, rel))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, args):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imread, resize_short
+
+    import numpy as np
+
+    lst = list(read_list(prefix + ".lst"))
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+
+    def encode(item):
+        idx, label, rel = item
+        img = imread(os.path.join(root, rel), flag=args.color)
+        if args.resize:
+            img = resize_short(img, args.resize)
+        label = label[0] if len(label) == 1 else np.asarray(label)
+        header = recordio.IRHeader(0, label, idx, 0)
+        return idx, recordio.pack_img(header, img, quality=args.quality,
+                                      img_fmt=args.encoding)
+
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        for count, (idx, payload) in enumerate(pool.map(encode, lst)):
+            rec.write_idx(idx, payload)
+            if count % 1000 == 0 and count:
+                print("packed %d images" % count)
+    rec.close()
+    print("wrote %s.rec (%d records)" % (prefix, len(lst)))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="make an image list instead of a rec file")
+    ap.add_argument("--shuffle", type=int, default=1)
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1)
+    ap.add_argument("--encoding", default=".jpg")
+    ap.add_argument("--num-thread", type=int, default=4)
+    args = ap.parse_args()
+    if args.list:
+        write_list(args.prefix, list_images(args.root), args)
+    else:
+        pack(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    main()
