@@ -35,7 +35,8 @@ from . import callback
 from . import model
 from . import module
 from . import module as mod
-from .module import Module
+from .module import Module, BucketingModule
+from . import rnn
 from . import parallel
 from . import test_utils
 from .model import save_checkpoint, load_checkpoint

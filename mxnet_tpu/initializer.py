@@ -29,7 +29,19 @@ def register(klass):
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
-    return _registry.get(str(name).lower())(**kwargs)
+    if isinstance(name, (list, tuple)):
+        # already-decoded dumps() form (Symbol.tojson round-trips the
+        # attr through json, so it arrives as ['Name', {kwargs}])
+        return _registry.get(str(name[0]).lower())(**(name[1] or {}))
+    name = str(name)
+    if name.startswith("["):
+        # serialized form from Initializer.dumps(): '["name", {kwargs}]'
+        # (the reference stores this json in the variable's __init__ attr)
+        import json
+
+        decoded = json.loads(name)
+        return _registry.get(decoded[0].lower())(**decoded[1])
+    return _registry.get(name.lower())(**kwargs)
 
 
 class InitDesc(str):
@@ -48,6 +60,13 @@ class Initializer:
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
+
+    def dumps(self):
+        """Serialized '["name", {kwargs}]' form (reference
+        ``Initializer.dumps``); round-trips through :func:`create`."""
+        import json
+
+        return json.dumps([self.__class__.__name__, self._kwargs])
 
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):

@@ -1,5 +1,6 @@
 """Module API (reference ``python/mxnet/module/``)."""
 from .base_module import BaseModule, BatchEndParam
 from .module import Module
+from .bucketing_module import BucketingModule
 
-__all__ = ["BaseModule", "Module", "BatchEndParam"]
+__all__ = ["BaseModule", "Module", "BucketingModule", "BatchEndParam"]

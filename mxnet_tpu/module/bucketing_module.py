@@ -1,0 +1,258 @@
+"""BucketingModule (reference ``python/mxnet/module/bucketing_module.py``).
+
+Variable-length training without padding waste: ``sym_gen(bucket_key)``
+produces a symbol per sequence length, and one Module per bucket is
+created lazily, all sharing the default bucket's parameter arrays via the
+``shared_module`` bind path (reference: per-bucket executors over one
+memory pool, ``bucketing_module.py:35``).
+
+TPU note: each bucket compiles its own XLA program, cached per shape —
+exactly the per-bucket-graph recompile the reference's executor cache
+amortizes (SURVEY.md §7 "hard parts (b)").  The fused train step is
+bypassed (grad arrays must be shared across buckets), so buckets run the
+split forward/backward/update path with a shared kvstore/updater.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise MXNetError("please specify default_bucket_key")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._work_load_list = work_load_list
+        self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._params_dirty = False
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+        self.inputs_need_grad = False
+        self._grad_req = None
+        self._monitor = None
+
+    # -- properties ------------------------------------------------------
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    def _call_sym_gen(self, bucket_key):
+        res = self._sym_gen(bucket_key)
+        if not (isinstance(res, tuple) and len(res) == 3):
+            raise MXNetError("sym_gen must return "
+                             "(symbol, data_names, label_names)")
+        return res
+
+    # -- params ----------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init,
+                         allow_extra=allow_extra)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing parameters"
+        self._curr_module.init_params(
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        self._params_dirty = False
+        self.params_initialized = True
+
+    # -- bind / bucket switching ----------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded:
+            if not force_rebind:
+                self.logger.warning("Already bound, ignoring bind()")
+                return
+            # reference _reset_bind: drop every per-bucket executor —
+            # stale modules would keep sharing the OLD default module's
+            # parameter arrays
+            self._buckets = {}
+            self._curr_module = None
+            self._curr_bucket_key = None
+            self.binded = False
+            self.params_initialized = False
+            self.optimizer_initialized = False
+        assert shared_module is None, \
+            "shared_module for BucketingModule is not supported"
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        self.binded = True
+
+        symbol, data_names, label_names = self._call_sym_gen(
+            self._default_bucket_key)
+        module = Module(symbol, data_names=data_names,
+                        label_names=label_names, logger=self.logger,
+                        context=self._context,
+                        work_load_list=self._work_load_list,
+                        fixed_param_names=self._fixed_param_names)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind=False,
+                    shared_module=None, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = module
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Switch to a bucket, binding a new per-length executor sharing
+        the default module's parameters if unseen."""
+        assert self.binded, "call bind before switching bucket"
+        if bucket_key not in self._buckets:
+            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+            module = Module(symbol, data_names=data_names,
+                            label_names=label_names, logger=self.logger,
+                            context=self._context,
+                            work_load_list=self._work_load_list,
+                            fixed_param_names=self._fixed_param_names)
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self.inputs_need_grad, force_rebind=False,
+                        shared_module=self._buckets[
+                            self._default_bucket_key],
+                        grad_req=self._grad_req)
+            if self.params_initialized:
+                module.params_initialized = True
+            if self._monitor is not None:
+                module.install_monitor(self._monitor)
+            # share the optimizer/updater machinery so updates keep state
+            src = self._buckets[self._default_bucket_key]
+            if src.optimizer_initialized:
+                self._share_optimizer(src, module)
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    @staticmethod
+    def _share_optimizer(src, dst):
+        dst._optimizer = src._optimizer
+        dst._updater = src._updater
+        dst._kvstore = src._kvstore
+        dst._update_on_kvstore = src._update_on_kvstore
+        dst._mesh = src._mesh
+        # buckets share parameter ARRAYS; the fused path would need
+        # per-bucket donated-state plumbing, so buckets use the split path
+        dst._fused = None
+        dst._fused_states = None
+        dst.optimizer_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, "
+                                "ignoring...")
+            return
+        default = self._buckets[self._default_bucket_key]
+        default.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        # the default module may have compiled a fused step; buckets need
+        # shared grad arrays, so disable it there too
+        default._fused = None
+        default._fused_states = None
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                self._share_optimizer(default, mod)
+        self.optimizer_initialized = True
+
+    # -- compute ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def update(self):
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        assert self.binded and self.params_initialized
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, monitor):
+        self._monitor = monitor
+        for mod in self._buckets.values():
+            mod.install_monitor(monitor)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._curr_module.save_checkpoint(prefix, epoch,
+                                          save_optimizer_states)

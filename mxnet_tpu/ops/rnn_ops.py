@@ -196,3 +196,21 @@ def _rnn(attrs, rng, data, parameters, *states):
     if mode == "lstm":
         return x, hT, jnp.stack(c_finals, axis=0)
     return x, hT
+
+
+@register("_state_zeros")
+def _state_zeros(attrs, data):
+    """Zero initial RNN state shaped from a reference input's batch dim.
+
+    Replaces the reference's ``sym.zeros(shape=(0, h))`` deferred-shape
+    idiom (nnvm infers the 0): XLA needs static shapes, so the state is
+    built from the symbol it will run with.  ``data`` is (N, ...) —
+    output is (N, num_hidden), or (leading, N, num_hidden) when the
+    ``leading`` attr is set (FusedRNNCell's stacked (L*D, N, H) states).
+    """
+    h = int(attrs["num_hidden"])
+    lead = int(attrs.get("leading", 0))
+    n = data.shape[0] if not bool(attrs.get("batch_axis1", False)) \
+        else data.shape[1]
+    shape = (lead, n, h) if lead > 0 else (n, h)
+    return jnp.zeros(shape, data.dtype)

@@ -1,0 +1,7 @@
+"""Symbolic RNN toolkit (reference ``python/mxnet/rnn/``)."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell)
+from .io import encode_sentences, BucketSentenceIter
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)

@@ -1,0 +1,137 @@
+"""Bucketed sequence iterators (reference ``python/mxnet/rnn/io.py``)."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["encode_sentences", "BucketSentenceIter"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0):
+    """Map token sequences to integer ids, building a vocab (reference
+    ``encode_sentences``)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                if not new_vocab:
+                    coded.append(invalid_label)
+                    continue
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            if word in vocab:
+                coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over variable-length sequences (reference
+    ``BucketSentenceIter``): each batch comes from one bucket, padded to
+    the bucket length, with ``batch.bucket_key`` driving
+    ``BucketingModule``'s per-length executor selection."""
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            counts = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(counts)
+                       if n >= batch_size]
+        buckets.sort()
+        if not buckets:
+            raise MXNetError("no bucket holds >= batch_size sentences; "
+                             "pass buckets explicitly")
+        ndiscard = 0
+        self.data = [[] for _ in buckets]
+        for sent in sentences:
+            buck = np.searchsorted(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[:len(sent)] = sent
+            self.data[buck].append(buff)
+        self.data = [np.asarray(x, dtype=dtype).reshape(-1, buckets[i])
+                     for i, x in enumerate(self.data)]
+        if ndiscard:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "discarded %d sentences longer than the largest bucket",
+                ndiscard)
+
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        shape = (batch_size, self.default_bucket_key) if layout == "NT" \
+            else (self.default_bucket_key, batch_size)
+        self.provide_data = [DataDesc(data_name, shape, dtype,
+                                      layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, dtype,
+                                       layout=layout)]
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend([(i, j) for j in
+                             range(0, len(buck) - batch_size + 1,
+                                   batch_size)])
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        random.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        # label = data shifted left by one (next-token prediction)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            label = np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(buck)
+            self.ndlabel.append(label)
+
+    def next(self):
+        from ..ndarray import array
+
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.nddata[i][j:j + self.batch_size]
+        label = self.ndlabel[i][j:j + self.batch_size]
+        if self.layout == "TN":
+            data, label = data.T, label.T
+        shape = data.shape
+        return DataBatch([array(data)], [array(label)], pad=0,
+                         bucket_key=self.buckets[i],
+                         provide_data=[DataDesc(self.data_name, shape,
+                                                self.dtype,
+                                                layout=self.layout)],
+                         provide_label=[DataDesc(self.label_name, shape,
+                                                 self.dtype,
+                                                 layout=self.layout)])

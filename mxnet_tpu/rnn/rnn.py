@@ -1,0 +1,42 @@
+"""RNN checkpoint helpers (reference ``python/mxnet/rnn/rnn.py``):
+save/load fused-cell checkpoints with weights unpacked to per-gate
+matrices so unfused and fused models interoperate."""
+from __future__ import annotations
+
+from .. import model
+from ..base import MXNetError
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def _as_cells(cells):
+    return cells if isinstance(cells, (list, tuple)) else [cells]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Save a checkpoint with RNN weights unpacked (reference
+    ``save_rnn_checkpoint``)."""
+    for cell in _as_cells(cells):
+        arg_params = cell.unpack_weights(arg_params)
+    model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load a checkpoint and re-pack RNN weights for the given cells."""
+    sym, arg, aux = model.load_checkpoint(prefix, epoch)
+    for cell in _as_cells(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback variant (reference ``do_rnn_checkpoint``)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -1,0 +1,761 @@
+"""Symbolic RNN cells (reference ``python/mxnet/rnn/rnn_cell.py``).
+
+The toolkit the reference's LSTM-PTB / bucketing examples are written
+against: composable cells with shared :class:`RNNParams`, ``unroll`` into
+a symbol graph, ``FusedRNNCell`` over the fused ``RNN`` op (one
+``lax.scan`` per layer/direction on TPU — ``ops/rnn_ops.py``), and
+``unfuse()``/``unpack_weights``/``pack_weights`` for moving parameters
+between the fused blob and per-cell matrices.
+
+Divergence from the reference: ``begin_state``'s deferred-shape
+``sym.zeros(shape=(0, h))`` idiom needs dynamic shape inference that XLA
+does not do; instead, default initial states are built with the
+``_state_zeros`` op, which takes its batch dimension from the input
+symbol at bind time.  ``begin_state()`` therefore needs an input symbol
+(``unroll`` passes one automatically) or an explicit ``batch_size``.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import symbol as sym
+from ..ops.rnn_ops import rnn_gates, rnn_param_size
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container for cell parameter symbols, shared by name (reference
+    ``RNNParams``)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell (reference ``BaseRNNCell``)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        """List of dicts: [{'shape': (0, h), '__layout__': 'NC'}, ...]
+        (0 = batch, filled at bind)."""
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=None, batch_ref=None, batch_size=0,
+                    **kwargs):
+        """Initial states.  ``batch_ref`` (a symbol whose dim 0 is the
+        batch) or ``batch_size`` supplies the batch dimension; ``func``
+        overrides the zero-fill (signature ``func(name=..., shape=...)``,
+        requires batch_size)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called "\
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            shape = info["shape"]
+            if func is not None:
+                if batch_size <= 0:
+                    raise MXNetError(
+                        "begin_state with a custom func needs batch_size")
+                states.append(func(
+                    name="%sbegin_state_%d" % (self._prefix,
+                                               self._init_counter),
+                    shape=(batch_size,) + tuple(shape[1:])))
+                continue
+            if batch_ref is None:
+                if batch_size <= 0:
+                    raise MXNetError(
+                        "begin_state needs batch_ref or batch_size (the "
+                        "reference's shape=(0,...) deferred inference is "
+                        "not available under static shapes)")
+                states.append(sym.zeros(
+                    shape=(batch_size,) + tuple(shape[1:]),
+                    name="%sbegin_state_%d" % (self._prefix,
+                                               self._init_counter)))
+                continue
+            if len(shape) == 3:  # fused stacked state (L*D, N, H)
+                states.append(sym._state_zeros(
+                    batch_ref, num_hidden=shape[2], leading=shape[0],
+                    batch_axis1=kwargs.get("batch_axis1", False)))
+            else:
+                states.append(sym._state_zeros(
+                    batch_ref, num_hidden=shape[-1],
+                    batch_axis1=kwargs.get("batch_axis1", False)))
+        return states
+
+    def unpack_weights(self, args):
+        """Split concatenated gate weights into per-gate entries
+        (reference contract: '{prefix}{i2h|h2h}_{gate}_{weight|bias}')."""
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group in ("i2h", "h2h"):
+            weight = args.pop("%s%s_weight" % (self._prefix, group))
+            bias = args.pop("%s%s_bias" % (self._prefix, group))
+            for j, gate in enumerate(self._gate_names):
+                wname = "%s%s%s_weight" % (self._prefix, group, gate)
+                args[wname] = weight[j * h:(j + 1) * h].copy()
+                bname = "%s%s%s_bias" % (self._prefix, group, gate)
+                args[bname] = bias[j * h:(j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        from ..ndarray import concat
+
+        for group in ("i2h", "h2h"):
+            weight = []
+            bias = []
+            for gate in self._gate_names:
+                weight.append(args.pop("%s%s%s_weight"
+                                       % (self._prefix, group, gate)))
+                bias.append(args.pop("%s%s%s_bias"
+                                     % (self._prefix, group, gate)))
+            args["%s%s_weight" % (self._prefix, group)] = concat(
+                *weight, dim=0)
+            args["%s%s_bias" % (self._prefix, group)] = concat(
+                *bias, dim=0)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell for ``length`` steps (reference
+        ``BaseRNNCell.unroll``).
+
+        ``inputs``: one (N,T,C)/(T,N,C) symbol or a list of ``length``
+        (N,C) symbols.  Returns (outputs, states) with outputs merged to
+        one symbol when ``merge_outputs`` is True.
+        """
+        self.reset()
+        inputs, batch_ref, batch_axis1 = _normalize_sequence(
+            length, inputs, layout, merge=False)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_ref=batch_ref,
+                                           batch_axis1=batch_axis1)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = _merge_sequence(outputs, layout)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return sym.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """Split a merged sequence symbol into per-step symbols (or keep a
+    list).  Returns (step_symbols, batch_ref_symbol, batch_axis1) where
+    ``batch_axis1`` says the batch rides axis 1 of ``batch_ref`` (TNC
+    merged inputs)."""
+    axis = layout.find("T")
+    if isinstance(inputs, sym.Symbol):
+        steps = sym.SliceChannel(inputs, num_outputs=length, axis=axis,
+                                 squeeze_axis=1)
+        return [steps[i] for i in range(length)], inputs, \
+            layout.find("N") == 1
+    if len(inputs) != length:
+        raise MXNetError("unroll doesn't support dynamic lengths: got %d "
+                         "inputs for length %d" % (len(inputs), length))
+    return list(inputs), inputs[0], False
+
+
+def _merge_sequence(outputs, layout):
+    axis = layout.find("T")
+    expanded = [sym.expand_dims(o, axis=axis) for o in outputs]
+    return sym.Concat(*expanded, dim=axis)
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (reference ``RNNCell``): h' = act(W x + U h + b)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name="%sh2h" % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference ``LSTMCell``), gate order i, f, c, o."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get(
+            "i2h_bias",
+            init=LSTMBias(forget_bias=forget_bias) if forget_bias
+            else None)
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(states[0], weight=self._hW, bias=self._hB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name="%sh2h" % name)
+        gates = i2h + h2h
+        split = sym.SliceChannel(gates, num_outputs=4, axis=1,
+                                 name="%sslice" % name)
+        in_gate = sym.Activation(split[0], act_type="sigmoid")
+        forget_gate = sym.Activation(split[1], act_type="sigmoid")
+        in_transform = sym.Activation(split[2], act_type="tanh")
+        out_gate = sym.Activation(split[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference ``GRUCell``), gate order r, z, n."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_h = states[0]
+        i2h = sym.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(prev_h, weight=self._hW, bias=self._hB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name="%sh2h" % name)
+        i2h_s = sym.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = sym.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset = sym.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update = sym.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        next_h_tmp = sym.Activation(i2h_s[2] + reset * h2h_s[2],
+                                    act_type="tanh")
+        next_h = (1.0 - update) * next_h_tmp + update * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-network fused cell over the ``RNN`` op (reference
+    ``FusedRNNCell`` / cuDNN; here one ``lax.scan`` per layer/direction)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        # the packed blob is 1-D, which shape-based initializers (Xavier
+        # etc.) cannot dispatch on; default it to small-uniform via the
+        # attr-driven path (the reference ships an init.FusedRNN that
+        # unpacks and applies a sub-initializer per matrix — divergence:
+        # here all slices draw from one uniform)
+        self._parameter = self.params.get("parameters", init="uniform")
+        rnn_gates(mode)  # validate
+
+    @property
+    def _num_gates(self):
+        return rnn_gates(self._mode)
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": ("",), "rnn_tanh": ("",),
+                "lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o")}[self._mode]
+
+    @property
+    def _directions(self):
+        return ["l", "r"] if self._bidirectional else ["l"]
+
+    @property
+    def state_info(self):
+        b = self._num_layers * (2 if self._bidirectional else 1)
+        n = 2 if self._mode == "lstm" else 1
+        return [{"shape": (b, 0, self._num_hidden), "__layout__": "LNC"}
+                for _ in range(n)]
+
+    def param_size(self, input_size):
+        return rnn_param_size(input_size, self._num_hidden,
+                              self._num_layers, self._mode,
+                              self._bidirectional)
+
+    def _slice_bounds(self, input_size):
+        """[(name, start, shape)] for every logical weight/bias in the
+        packed blob, using the unfused cells' naming scheme."""
+        g = self._num_gates
+        h = self._num_hidden
+        d = len(self._directions)
+        out = []
+        off = 0
+        for layer in range(self._num_layers):
+            in_sz = input_size if layer == 0 else h * d
+            for direction in self._directions:
+                for part, cols in (("i2h", in_sz), ("h2h", h)):
+                    name = "%s%s%d_%s_weight" % (self._prefix, direction,
+                                                 layer, part)
+                    out.append((name, off, (g * h, cols)))
+                    off += g * h * cols
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                for part in ("i2h", "h2h"):
+                    name = "%s%s%d_%s_bias" % (self._prefix, direction,
+                                               layer, part)
+                    out.append((name, off, (g * h,)))
+                    off += g * h
+        return out
+
+    def unpack_weights(self, args):
+        import numpy as np
+
+        from ..ndarray import array
+
+        args = dict(args)
+        arr = args.pop(self._parameter.name).asnumpy()
+        # infer input size from blob length
+        input_size = self._infer_input_size(arr.size)
+        for name, off, shape in self._slice_bounds(input_size):
+            size = int(np.prod(shape))
+            args[name] = array(arr[off:off + size].reshape(shape))
+        return args
+
+    def pack_weights(self, args):
+        import numpy as np
+
+        from ..ndarray import array
+
+        args = dict(args)
+        first = "%s%s0_i2h_weight" % (self._prefix, self._directions[0])
+        input_size = args[first].shape[1]
+        total = self.param_size(input_size)
+        blob = np.zeros(total, "float32")
+        for name, off, shape in self._slice_bounds(input_size):
+            val = args.pop(name).asnumpy().reshape(-1)
+            blob[off:off + val.size] = val
+        args[self._parameter.name] = array(blob)
+        return args
+
+    def _infer_input_size(self, blob_size):
+        g, h = self._num_gates, self._num_hidden
+        d = len(self._directions)
+        rest = blob_size
+        # solve blob_size = d*(g*h*(in+h) + 2*g*h) + (L-1)*d*(g*h*(h*d+h)+2*g*h)
+        deeper = (self._num_layers - 1) * d * (g * h * (h * d + h)
+                                               + 2 * g * h)
+        first = rest - deeper
+        return first // (d * g * h) - h - 2
+
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell cannot be stepped one timestep at a "
+                         "time; use unroll() (reference behavior)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        # fused op wants TNC
+        if isinstance(inputs, (list, tuple)):
+            steps = [sym.expand_dims(x, axis=0) for x in inputs]
+            data = sym.Concat(*steps, dim=0)
+            batch_ref = inputs[0]   # (N, C): batch on axis 0
+            batch_axis1 = False
+        else:
+            data = inputs
+            batch_ref = inputs      # the UN-swapped merged input
+            if layout == "NTC":
+                data = sym.SwapAxis(data, dim1=0, dim2=1)
+                batch_axis1 = False  # NTC: batch on axis 0 of batch_ref
+            else:
+                batch_axis1 = True   # TNC: batch on axis 1
+        if begin_state is None:
+            begin_state = self.begin_state(batch_ref=batch_ref,
+                                           batch_axis1=batch_axis1)
+        rnn_args = dict(state_size=self._num_hidden,
+                        num_layers=self._num_layers, mode=self._mode,
+                        bidirectional=self._bidirectional,
+                        p=self._dropout,
+                        state_outputs=self._get_next_state,
+                        name="%srnn" % self._prefix)
+        if self._mode == "lstm":
+            rnn = sym.RNN(data=data, parameters=self._parameter,
+                          state=begin_state[0], state_cell=begin_state[1],
+                          **rnn_args)
+        else:
+            rnn = sym.RNN(data=data, parameters=self._parameter,
+                          state=begin_state[0], **rnn_args)
+        if self._get_next_state:
+            outputs = rnn[0]
+            states = [rnn[1], rnn[2]] if self._mode == "lstm" else [rnn[1]]
+        else:
+            outputs, states = rnn, []
+        if layout == "NTC":
+            outputs = sym.SwapAxis(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            axis = layout.find("T")
+            steps = sym.SliceChannel(outputs, num_outputs=length,
+                                     axis=axis, squeeze_axis=1)
+            outputs = [steps[i] for i in range(length)]
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference ``unfuse``)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_" % (self._prefix, i)),
+                    get_cell("%sr%d_" % (self._prefix, i)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(
+                    self._dropout, prefix="%s_dropout%d_" % (self._prefix,
+                                                             i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells applied layer-wise (reference
+    ``SequentialRNNCell``)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+        self._override_cell_params = params is not None
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[pos:pos + n]
+            pos += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        # unroll layer by layer so FusedRNNCell members keep their fused
+        # whole-sequence form (reference does the same)
+        num_cells = len(self._cells)
+        if begin_state is None:
+            begin_states = None
+        else:
+            begin_states = []
+            pos = 0
+            for cell in self._cells:
+                n = len(cell.state_info)
+                begin_states.append(begin_state[pos:pos + n])
+                pos += n
+        states = []
+        for i, cell in enumerate(self._cells):
+            bs = None if begin_states is None else begin_states[i]
+            last = i == num_cells - 1
+            inputs, st = cell.unroll(
+                length, inputs=inputs, begin_state=bs, layout=layout,
+                merge_outputs=None if not last else merge_outputs)
+            states.extend(st)
+        return inputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Run two cells over the sequence in opposite directions (reference
+    ``BidirectionalCell``); only usable through ``unroll``."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._cells = [l_cell, r_cell]
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, batch_ref, batch_axis1 = _normalize_sequence(
+            length, inputs, layout, merge=False)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_ref=batch_ref,
+                                           batch_axis1=batch_axis1)
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=begin_state[n_l:], layout=layout,
+            merge_outputs=False)
+        outputs = [sym.Concat(l_o, r_o, dim=1,
+                              name="%st%d" % (self._output_prefix, i))
+                   for i, (l_o, r_o) in enumerate(
+                       zip(l_outputs, reversed(r_outputs)))]
+        if merge_outputs:
+            outputs = _merge_sequence(outputs, layout)
+        return outputs, l_states + r_states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell (reference ``ModifierCell``)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(**kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class DropoutCell(BaseRNNCell):
+    """Apply dropout on output (reference ``DropoutCell``)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = sym.Dropout(inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference ``ZoneoutCell``): with prob p,
+    keep the previous state instead of the new one."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        if isinstance(base_cell, (FusedRNNCell, BidirectionalCell)):
+            raise MXNetError("ZoneoutCell cannot wrap %s"
+                             % type(base_cell).__name__)
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+
+        def mask(p, like):
+            return sym.Dropout(sym.ones_like(like), p=p)
+
+        prev_output = self.prev_output if self.prev_output is not None \
+            else sym.zeros_like(next_output)
+        if self.zoneout_outputs > 0:
+            output = sym.where(mask(self.zoneout_outputs, next_output),
+                               next_output, prev_output)
+        else:
+            output = next_output
+        if self.zoneout_states > 0:
+            states = [sym.where(mask(self.zoneout_states, ns), ns, s)
+                      for ns, s in zip(next_states, states)]
+        else:
+            states = next_states
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the output (reference ``ResidualCell``)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs)
+        self.base_cell._modified = True
+        if isinstance(outputs, list):
+            ins, _, _ = _normalize_sequence(length, inputs, layout, False)
+            outputs = [o + i for o, i in zip(outputs, ins)]
+        else:
+            merged_in = inputs if isinstance(inputs, sym.Symbol) else \
+                _merge_sequence(list(inputs), layout)
+            outputs = outputs + merged_in
+        return outputs, states

@@ -381,6 +381,19 @@ def _fill_param_shapes(node, env, shapes):
         set_var(1, (data[1], nf // ng) + kernel)
         if len(node.inputs) > 2:
             set_var(2, (nf,))
+    elif op == "RNN":
+        from ..ops.rnn_ops import rnn_param_size
+
+        h = int(a["state_size"])
+        layers = int(a.get("num_layers", 1))
+        bidir = bool(a.get("bidirectional", False))
+        d = 2 if bidir else 1
+        # data is TNC: (T, N, input_size)
+        set_var(1, (rnn_param_size(data[2], h, layers,
+                                   a.get("mode", "lstm"), bidir),))
+        set_var(2, (layers * d, data[1], h))
+        if len(node.inputs) > 3:
+            set_var(3, (layers * d, data[1], h))
     elif op in ("BatchNorm", "BatchNorm_v1"):
         c = data[int(a.get("axis", 1))]
         for pos in (1, 2, 3, 4):
@@ -457,7 +470,8 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
         attrs["__dtype__"] = str(dtype)
     if init is not None:
         attrs["__init__"] = init if isinstance(init, str) else \
-            init.__class__.__name__
+            (init.dumps() if hasattr(init, "dumps")
+             else init.__class__.__name__)
     node = _Node(None, name, attrs, [])
     return Symbol([(node, 0)])
 
@@ -480,6 +494,12 @@ def load_json(json_str):
     for nj in data["nodes"]:
         attrs = {}
         for k, v in nj.get("attrs", {}).items():
+            if k == "__init__":
+                # keep the serialized initializer STRING: decoding it to
+                # a list would get re-str()'d by attr_dict() into
+                # single-quoted non-json that initializer.create rejects
+                attrs[k] = v
+                continue
             try:
                 attrs[k] = json.loads(v)
             except (ValueError, TypeError):

@@ -244,6 +244,7 @@ def _build_specs():
                             {"use_sequence_length": True})
 
     from mxnet_tpu.ops.rnn_ops import rnn_param_size
+    s["_state_zeros"] = ([_f(4, 3)], {"num_hidden": 5})
     s["RNN"] = (
         [_f(5, 2, 3), _f(rnn_param_size(3, 4, 1, "lstm")),
          _f(1, 2, 4), _f(1, 2, 4)],
