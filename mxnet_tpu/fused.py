@@ -75,7 +75,7 @@ class TrainStep:
                  mesh=None, data_names=("data",),
                  label_names=("softmax_label",), dtype="float32",
                  batch_sharding_axis="data", compute_dtype=None,
-                 remat=None, fixed_param_names=()):
+                 remat=None, fixed_param_names=(), param_sharding=None):
         import jax
         import jax.numpy as jnp
 
@@ -161,22 +161,87 @@ class TrainStep:
                     jax.random.fold_in(rng, i + 1))
             return new_params, new_aux, new_states, outs[0]
 
-        if mesh is not None:
-            from .parallel.sharding import named_sharding, replicated
+        self._step_fn = step
+        self._batch_sharding_axis = batch_sharding_axis
+        self._param_sharding = param_sharding
+        if param_sharding not in (None, "replicated"):
+            if mesh is None:
+                raise MXNetError(
+                    "param_sharding=%r needs a mesh (pass mesh=... or run "
+                    "under a dist kvstore)" % (param_sharding,))
+            if isinstance(param_sharding, str):
+                # validate the style NOW: a typo must fail at construction
+                # (inside Module's fused-fallback handling), not on the
+                # first training batch
+                from .parallel.sharding import param_sharding_rules
 
-            repl = replicated(mesh)
-            bshard = named_sharding(mesh, batch_sharding_axis)
-            self._jit_step = jax.jit(
-                step,
-                in_shardings=(repl, repl, repl,
-                              {n: bshard for n in
-                               self.data_names + self.label_names},
-                              repl, None, None),
-                out_shardings=(repl, repl, repl, bshard),
-                donate_argnums=(0, 1, 2))
+                param_sharding_rules(param_sharding)
+        if mesh is not None and param_sharding not in (None, "replicated"):
+            # FSDP's largest-dim rule needs concrete parameter SHAPES, so
+            # the jitted step is built lazily on the first call
+            self._jit_step = None
+        elif mesh is not None:
+            self._jit_step = self._build_jit()
         else:
             self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
         self._t = 0
+
+    def _build_jit(self, pshard=None, sshard=None):
+        """jit the step with parameter/state shardings resolved.
+
+        ``pshard``: {name: NamedSharding} (or None → replicate all);
+        ``sshard``: a pytree prefix for the optimizer states (or None).
+        Gradients need no annotation: GSPMD propagates shardings and
+        inserts the collectives (all-gather for fsdp params,
+        all-reduce/reduce-scatter for grads — the TPU form of the
+        reference's push/pull).
+        """
+        import jax
+
+        from .parallel.sharding import named_sharding, replicated
+
+        mesh = self.mesh
+        repl = replicated(mesh)
+        bshard = named_sharding(mesh, self._batch_sharding_axis)
+        if pshard is None:
+            pshard = repl
+        if sshard is None:
+            sshard = repl if not isinstance(pshard, dict) else pshard
+        bdict = {n: bshard for n in self.data_names + self.label_names}
+        return jax.jit(
+            self._step_fn,
+            in_shardings=(pshard, repl, sshard, bdict, repl, None, None),
+            out_shardings=(pshard, repl, sshard, bshard),
+            donate_argnums=(0, 1, 2))
+
+    def _build_sharded_jit(self, params, states):
+        """Resolve param_sharding rules against concrete shapes and jit.
+
+        Optimizer state leaves follow their parameter's sharding when
+        shaped like the weight (momentum/adam moments), else replicate
+        (scalars, schedules) — the ZeRO contract that sharded params
+        carry sharded optimizer states.
+        """
+        import jax
+
+        from .parallel.sharding import (apply_rules, param_sharding_rules,
+                                        replicated)
+
+        rules = self._param_sharding
+        if isinstance(rules, str):
+            rules = param_sharding_rules(rules)
+        pshard = apply_rules(self.mesh, params, rules)
+        repl = replicated(self.mesh)
+        sshard = {
+            n: jax.tree.map(
+                lambda leaf, _n=n: pshard[_n]
+                if tuple(leaf.shape) == tuple(params[_n].shape) else repl,
+                states[n])
+            for n in states
+        }
+        self._in_pshard = pshard
+        self._in_sshard = sshard
+        return self._build_jit(pshard, sshard)
 
     def __call__(self, params, aux, states, batch, rng, lr=None, t=None):
         import jax
@@ -206,6 +271,14 @@ class TrainStep:
 
         params, aux, states = jax.tree.map(
             dedupe, (params, aux, states))
+        if self._jit_step is None:
+            self._jit_step = self._build_sharded_jit(params, states)
+        if getattr(self, "_in_pshard", None) is not None:
+            # committed single-device arrays cannot be auto-resharded to
+            # a non-trivial layout by jit; place them explicitly (no-op
+            # once the donated outputs carry the sharding)
+            params = jax.device_put(params, self._in_pshard)
+            states = jax.device_put(states, self._in_sshard)
         return self._jit_step(params, aux, states, batch, rng,
                               self.lr if lr is None else lr,
                               jnp.asarray(t, "int32"))

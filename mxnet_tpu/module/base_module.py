@@ -141,7 +141,7 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
+            monitor=None, param_sharding=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``)."""
         from ..initializer import Uniform
@@ -158,8 +158,13 @@ class BaseModule:
         self.init_params(initializer=initializer, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init)
+        opt_kwargs = {}
+        if param_sharding is not None:
+            # only Module.init_optimizer knows this kwarg; BucketingModule
+            # and PythonModule keep the base signature
+            opt_kwargs["param_sharding"] = param_sharding
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
+                            optimizer_params=optimizer_params, **opt_kwargs)
 
         if validation_metric is None:
             validation_metric = eval_metric

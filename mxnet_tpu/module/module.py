@@ -169,10 +169,25 @@ class Module(BaseModule):
     # -- optimizer ------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       force_init=False, param_sharding=None):
+        """``param_sharding``: 'replicated' (default), 'fsdp', 'tp', or a
+        rule list (see ``parallel.sharding.param_sharding_rules``) —
+        applied to the fused step's parameter/optimizer-state layouts
+        over the active mesh.  This is the working equivalent of the
+        reference's ``group2ctx`` model parallelism
+        (``graph_executor.cc:395`` PlaceDevice) plus the ZeRO-style
+        sharded-optimizer layout the reference approximated with
+        parameter-server key sharding (``kvstore_dist.h:431``).  Also
+        settable via ``MXNET_PARAM_SHARDING``."""
+        from ..base import get_env
+
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        if param_sharding is None:
+            param_sharding = get_env("MXNET_PARAM_SHARDING", "", str) \
+                or None
+        self._param_sharding = param_sharding
         kvstore_inst, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._exec.arg_dict)
 
@@ -291,8 +306,17 @@ class Module(BaseModule):
             self._fused = TrainStep(
                 self._symbol, optimizer=o, mesh=self._mesh,
                 data_names=self._data_names, label_names=self._label_names,
-                fixed_param_names=self._fixed_param_names, remat=remat)
+                fixed_param_names=self._fixed_param_names, remat=remat,
+                param_sharding=getattr(self, "_param_sharding", None))
         except Exception as e:  # fall back to the split path
+            if getattr(self, "_param_sharding", None) not in (
+                    None, "replicated"):
+                # an EXPLICIT sharding request must not silently train
+                # replicated single-device
+                raise MXNetError(
+                    "param_sharding=%r was requested but the fused step "
+                    "could not be built: %s"
+                    % (self._param_sharding, e)) from e
             self.logger.debug("fused step unavailable: %s", e)
             self._fused = None
         if self._fused is None and self._mesh is not None and \

@@ -1,0 +1,151 @@
+"""TP/FSDP parameter sharding through the public API (VERDICT r3 task 5:
+per-device param bytes shrink under fsdp; TP trains identically to
+replicated).  Runs on the 8-virtual-device CPU mesh from conftest."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import create_mesh, mesh_scope
+
+
+def _mlp():
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=64, no_bias=True, name="fc0")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, no_bias=True, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=4, name="fc_out")
+    return mx.sym.SoftmaxOutput(out, name="softmax",
+                                normalization="batch")
+
+
+def _train(param_sharding, mesh_axes, steps=4, batch=16):
+    import jax
+
+    np.random.seed(42)  # identical initializer draws across runs
+    rs = np.random.RandomState(0)
+    X = rs.randn(batch * steps, 32).astype("float32")
+    y = (rs.rand(batch * steps) * 4).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    mesh = create_mesh(mesh_axes, devices=jax.devices()[:8])
+    with mesh_scope(mesh):
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier(rnd_type="uniform",
+                                                   magnitude=2.0))
+        mod.init_optimizer(kvstore="dist_tpu_sync", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9},
+                           param_sharding=param_sharding)
+        assert mod._fused is not None
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+    params, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in params.items()}
+
+
+def test_fsdp_shards_params_and_matches_replicated():
+    mod_r, ref = _train(None, {"data": 8})
+    mod_f, fsdp = _train("fsdp", {"data": 8})
+
+    # numerics: fsdp == replicated
+    for k in ref:
+        np.testing.assert_allclose(fsdp[k], ref[k], rtol=1e-4, atol=1e-5,
+                                   err_msg="fsdp diverges on %s" % k)
+
+    # layout: per-device bytes shrink ~8x on shardable params
+    live = mod_f._fused_states  # device pytree kept by the fused path
+    exec_w = mod_f._exec.arg_dict["fc0_weight"]._data
+    shard = next(iter(exec_w.addressable_shards)).data
+    assert shard.shape[0] * 8 == exec_w.shape[0] or \
+        shard.shape[1] * 8 == exec_w.shape[1], \
+        "fc0_weight not sharded: shard %s of %s" % (shard.shape,
+                                                    exec_w.shape)
+    # momentum state follows the weight's sharding
+    mom = live["fc0_weight"]
+    mom_leaf = [x for x in __import__("jax").tree.leaves(mom)
+                if x.shape == exec_w.shape][0]
+    mshard = next(iter(mom_leaf.addressable_shards)).data
+    assert mshard.shape == shard.shape
+
+
+def test_tp_matches_replicated():
+    mod_r, ref = _train(None, {"data": 8})
+    mod_t, tp = _train("tp", {"data": 4, "model": 2})
+    for k in ref:
+        np.testing.assert_allclose(tp[k], ref[k], rtol=1e-4, atol=1e-5,
+                                   err_msg="tp diverges on %s" % k)
+    # fc0 column-parallel on 'model', fc1 row-parallel
+    w0 = mod_t._exec.arg_dict["fc0_weight"]._data
+    s0 = next(iter(w0.addressable_shards)).data
+    assert s0.shape[0] * 2 == w0.shape[0], (s0.shape, w0.shape)
+    w1 = mod_t._exec.arg_dict["fc1_weight"]._data
+    s1 = next(iter(w1.addressable_shards)).data
+    assert s1.shape[1] * 2 == w1.shape[1], (s1.shape, w1.shape)
+
+
+def test_param_sharding_without_mesh_raises():
+    from mxnet_tpu.fused import TrainStep
+
+    with pytest.raises(mx.base.MXNetError):
+        TrainStep(_mlp(), optimizer="sgd", param_sharding="fsdp")
+
+
+def test_env_var_and_fit_kwarg_paths():
+    """MXNET_PARAM_SHARDING env var and fit(param_sharding=...) both
+    engage sharding (review regressions: env var TypeError'd; fit had no
+    way to pass it)."""
+    import os
+
+    import jax
+
+    np.random.seed(42)
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 32).astype("float32")
+    y = (rs.rand(32) * 4).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mesh = create_mesh({"data": 8}, devices=jax.devices()[:8])
+    os.environ["MXNET_PARAM_SHARDING"] = "fsdp"
+    try:
+        with mesh_scope(mesh):
+            mod = mx.mod.Module(_mlp(), context=mx.cpu())
+            mod.fit(it, num_epoch=1, kvstore="dist_tpu_sync",
+                    optimizer="sgd", initializer=mx.init.Xavier())
+            assert mod._param_sharding == "fsdp"
+            # fit's epoch-end get_params/set_params sync gathers params
+            # (reference _sync_params_from_devices semantics), so assert
+            # the ENGAGED step shardings rather than post-fit layout
+            assert mod._fused is not None
+            spec = mod._fused._in_pshard["fc0_weight"].spec
+            assert "data" in tuple(spec), spec
+    finally:
+        os.environ.pop("MXNET_PARAM_SHARDING", None)
+
+    with mesh_scope(mesh):
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(it, num_epoch=1, kvstore="dist_tpu_sync", optimizer="sgd",
+                initializer=mx.init.Xavier(), param_sharding="fsdp")
+        assert mod._param_sharding == "fsdp"
+
+
+def test_explicit_sharding_request_never_silently_dropped():
+    """A typo'd or un-satisfiable param_sharding raises instead of
+    silently training replicated (review regression)."""
+    import jax
+
+    np.random.seed(42)
+    rs = np.random.RandomState(0)
+    X = rs.randn(32, 32).astype("float32")
+    y = (rs.rand(32) * 4).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mesh = create_mesh({"data": 8}, devices=jax.devices()[:8])
+    with mesh_scope(mesh):
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.init.Xavier())
+        with pytest.raises(mx.base.MXNetError):
+            mod.init_optimizer(kvstore="dist_tpu_sync", optimizer="sgd",
+                               param_sharding="fsdpp")  # typo
