@@ -13,6 +13,7 @@ from . import context
 from .context import Context, cpu, gpu, tpu, current_context
 from . import random
 from . import ops
+from . import operator
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray

@@ -814,8 +814,5 @@ def _identity_kl(attrs, x):
     return x
 
 
-@register("Custom")
-def _custom(attrs, *xs):
-    raise MXNetError(
-        "Custom ops execute via mxnet_tpu.operator.CustomOp (host callback), "
-        "not through the registry compute path")
+# The "Custom" op (Python-defined ops over host callbacks) registers from
+# mxnet_tpu/operator.py — reference src/operator/custom/custom.cc.

@@ -78,4 +78,8 @@ def expected_inputs(op_name, attrs):
         names = ["data"]
     if op_name == "RNN" and attrs.get("mode", "lstm") != "lstm":
         names = [n for n in names if n != "state_cell"]
+    if op_name == "Custom" and "op_type" in attrs:
+        from ..operator import _make_prop
+
+        names = list(_make_prop(attrs).list_arguments())
     return tuple(names), tuple(aux)

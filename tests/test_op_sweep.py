@@ -269,7 +269,8 @@ def _build_specs():
 
 SPECS = _build_specs()
 
-# ops whose forward is expected to raise until their subsystem lands
+# ops that cannot run from a generic spec: Custom needs a user-registered
+# CustomOpProp (covered end-to-end by tests/test_custom_op.py)
 EXPECTED_MISSING = {"Custom"}
 
 
