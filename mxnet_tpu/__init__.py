@@ -33,6 +33,9 @@ from . import image
 from . import kvstore
 from . import kvstore as kv
 from . import callback
+from . import profiler
+from . import monitor
+from .monitor import Monitor
 from . import model
 from . import module
 from . import module as mod

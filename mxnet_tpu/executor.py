@@ -30,8 +30,14 @@ from . import random as _random
 __all__ = ["Executor"]
 
 
-def _trace_fn(sym, is_train):
-    """Build the pure function (args, aux, rng) -> (outputs, new_aux)."""
+def _trace_fn(sym, is_train, node_hook=None):
+    """Build the pure function (args, aux, rng) -> (outputs, new_aux).
+
+    ``node_hook(node_name, out_idx, value)``, when given, fires for every
+    node output — the per-node visibility the reference gets from
+    ``ExecuteMonCallback``.  Hooked functions are for EAGER execution
+    (monitor / NaiveEngine debug mode), not for jitting.
+    """
     import jax
 
     topo = sym._topo()
@@ -65,6 +71,8 @@ def _trace_fn(sym, is_train):
             n_out = node.num_outputs
             for i in range(n_out):
                 env[(id(node), i)] = res[i]
+                if node_hook is not None:
+                    node_hook(node.name, i, res[i])
             # functional aux-state update (reference FMutateInputs)
             for mi, upd in zip(node.op.mutable_inputs, res[n_out:]):
                 src, _ = node.inputs[mi]
@@ -90,6 +98,7 @@ class Executor:
         self._grad_req = grad_req         # name -> str
         self.outputs = []
         self._monitor_callback = None
+        self._monitor_all = False
 
         self._fwd_eval_fn, self._arg_names, self._aux_names = \
             _trace_fn(sym, is_train=False)
@@ -160,6 +169,15 @@ class Executor:
         args = {n: a._data for n, a in self.arg_dict.items()}
         aux = {n: a._data for n, a in self.aux_dict.items()}
         rng = _random.next_key()
+        from .base import get_env
+
+        if (self._monitor_callback is not None and self._monitor_all) or \
+                get_env("MXNET_ENGINE_TYPE", "", str) == "NaiveEngine":
+            # eager node-by-node interpretation: per-node monitor
+            # visibility (reference ExecuteMonCallback) and the
+            # NaiveEngine synchronous debug mode in one — each op runs
+            # and materializes before the next
+            return self._forward_eager(args, aux, rng, is_train)
         if is_train and self._grad_args:
             # release the previous step's residuals before the new forward
             # (holding them would double peak activation memory)
@@ -257,8 +275,41 @@ class Executor:
             ex.aux_dict[n] = arr
         return ex
 
+    def _forward_eager(self, args, aux, rng, is_train):
+        """Monitor / NaiveEngine path: run the graph eagerly, firing the
+        monitor callback per node output, then fall through to the normal
+        vjp caching so backward still works."""
+        import jax
+
+        from .ndarray.ndarray import NDArray as _ND
+
+        cb = self._monitor_callback
+
+        def hook(name, idx, value):
+            if cb is not None:
+                out_name = "%s_output%s" % (name, idx if idx else "")
+                cb(out_name, _ND(value, self._ctx))
+
+        fn, _, _ = _trace_fn(self._symbol, is_train=is_train,
+                             node_hook=hook)
+        outs, new_aux = fn(args, aux, rng)
+        if is_train:
+            for n, v in new_aux.items():
+                self.aux_dict[n]._set_data(v)
+            if self._grad_args:
+                # cache the vjp for backward (the monitor pass above ran
+                # eagerly; the vjp capture runs the jitted path once)
+                self._last_vjp = None
+                _, new_aux2, vjp = self._jit_fwd_vjp(args, aux, rng)
+                self._last_vjp = (vjp, new_aux2)
+            else:
+                self._train_fwd_ran = True
+        self.outputs = [_ND(o, self._ctx) for o in outs]
+        return self.outputs
+
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
 
     @property
     def output_dict(self):

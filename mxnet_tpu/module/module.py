@@ -411,7 +411,10 @@ class Module(BaseModule):
 
     def forward_backward(self, data_batch):
         if getattr(self, "_fused", None) is not None and \
-                len(self._symbol.list_outputs()) == 1:
+                len(self._symbol.list_outputs()) == 1 and \
+                self._exec._monitor_callback is None:
+            # an installed Monitor needs the per-node executor path; the
+            # fused one-program step has no node boundaries to observe
             self._fused_forward_backward_update(data_batch)
             return
         self.forward(data_batch, is_train=True)

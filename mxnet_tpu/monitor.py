@@ -1,0 +1,79 @@
+"""Monitor — per-node tensor statistics during forward.
+
+Reference: ``python/mxnet/monitor.py:33`` over the executor's
+``monitor_callback`` (``ExecuteMonCallback`` fires per node output).
+
+TPU note: the compiled forward is ONE XLA program with no per-node
+boundary, so an installed monitor switches the executor into the eager
+node-by-node interpretation of the same graph (``Executor`` monitor
+mode — also the framework's NaiveEngine-style synchronous debug mode,
+reference ``MXNET_ENGINE_TYPE=NaiveEngine`` / SURVEY.md §5 "race
+detection").  Slow by design; a debugging tool, exactly like the
+reference's.
+"""
+from __future__ import annotations
+
+import re
+
+from .base import MXNetError
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                import jax.numpy as jnp
+
+                return jnp.abs(x).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        """Executor callback: collect stats for matching node outputs."""
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        data = arr._data if hasattr(arr, "_data") else arr
+        self.queue.append((self.step, name, self.stat_func(data)))
+
+    def install(self, exe):
+        """Install on an executor (reference ``Monitor.install`` →
+        ``set_monitor_callback``)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all=True)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; return [(step, name, stat)] with stats
+        realized on host."""
+        import numpy as np
+
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for step, name, value in self.queue:
+            v = np.asarray(value)
+            res.append((step, name,
+                        v.reshape(-1) if v.ndim else v[()]))
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for step, name, value in self.toc():
+            print("Batch: %7d %30s %s" % (step, name, value))

@@ -1,0 +1,93 @@
+"""Profiler — chrome-trace output via the XLA/JAX profiler.
+
+Reference: ``python/mxnet/profiler.py`` over the engine profiler
+(``src/engine/profiler.cc:152`` writes chrome://tracing JSON;
+SURVEY.md §5 "Tracing/profiling").  Here the device timeline comes from
+``jax.profiler`` (XLA's own op-level trace — strictly richer than the
+reference's per-engine-op stat slabs) and ``dump()`` extracts the
+chrome-trace JSON so the output opens in chrome://tracing / Perfetto
+exactly like the reference's.
+
+API surface: ``profiler_set_config(filename=...)``,
+``profiler_set_state('run'|'stop')`` (aliases ``set_config``/
+``set_state``), ``dump()``; env ``MXNET_PROFILER_AUTOSTART=1`` starts
+tracing at import (reference ``env_var.md`` autostart contract).
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import os
+import shutil
+import tempfile
+
+from .base import MXNetError, get_env
+
+__all__ = ["profiler_set_config", "profiler_set_state", "set_config",
+           "set_state", "dump", "dump_profile", "state"]
+
+_config = {"filename": "profile.json", "profile_all": False}
+_state = {"running": False, "tmpdir": None, "dumped": False}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json", **kwargs):
+    """Configure output (reference ``profiler_set_config``; ``mode`` is
+    accepted for API parity — the XLA trace always covers everything)."""
+    _config["filename"] = filename
+    _config["mode"] = mode
+    _config.update(kwargs)
+
+
+def profiler_set_state(state="stop"):
+    """Start/stop tracing (reference ``profiler_set_state``)."""
+    import jax
+
+    if state == "run":
+        if _state["running"]:
+            return
+        _state["tmpdir"] = tempfile.mkdtemp(prefix="mxtpu_profile_")
+        _state["dumped"] = False
+        jax.profiler.start_trace(_state["tmpdir"])
+        _state["running"] = True
+    elif state == "stop":
+        if not _state["running"]:
+            return
+        jax.profiler.stop_trace()
+        _state["running"] = False
+    else:
+        raise MXNetError("profiler state must be 'run' or 'stop', got %r"
+                         % state)
+
+
+set_config = profiler_set_config
+set_state = profiler_set_state
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def dump(finished=True):
+    """Write the chrome-trace JSON to the configured filename (reference
+    ``dump_profile`` → ``Profiler::DumpProfile``)."""
+    if _state["running"] and finished:
+        profiler_set_state("stop")
+    tmpdir = _state["tmpdir"]
+    if tmpdir is None:
+        raise MXNetError("nothing profiled: call "
+                         "profiler_set_state('run') first")
+    traces = sorted(glob.glob(
+        os.path.join(tmpdir, "**", "*.trace.json.gz"), recursive=True))
+    if not traces:
+        raise MXNetError("profiler produced no trace under %s" % tmpdir)
+    with gzip.open(traces[-1], "rb") as src, \
+            open(_config["filename"], "wb") as dst:
+        shutil.copyfileobj(src, dst)
+    _state["dumped"] = True
+    return _config["filename"]
+
+
+dump_profile = dump
+
+if get_env("MXNET_PROFILER_AUTOSTART", False, bool):
+    profiler_set_state("run")
