@@ -65,15 +65,22 @@ class Context:
     # -- JAX mapping ---------------------------------------------------
     @property
     def jax_device(self):
-        """The concrete ``jax.Device`` this context denotes."""
+        """The concrete ``jax.Device`` this context denotes.
+
+        Always a process-LOCAL (addressable) device: under multi-process
+        ``jax.distributed``, ``jax.devices()`` is the global list and
+        ``device_put`` onto another process's device would silently
+        create a non-addressable global array (reference semantics: a
+        Context names a device of THIS worker)."""
         import jax
 
         kind = self.device_type
         if kind in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = jax.local_devices(backend="cpu") if _has_platform("cpu") \
+                else jax.local_devices()
         else:
             # tpu (and gpu, aliased to the accelerator) → default platform
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 

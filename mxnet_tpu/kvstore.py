@@ -163,7 +163,10 @@ class KVStore:
         import jax.numpy as jnp
 
         for k, os_, rid in zip(keys, outs, rids):
-            src = self._store[k]
+            # same source selection as pull(): without an updater the
+            # merged gradient is the pullable value
+            src = self._store[k] if self._updater is not None or \
+                k not in self._merged else self._merged[k]
             orig_ids = np.asarray(
                 rid.asnumpy() if isinstance(rid, NDArray) else rid
             ).astype("int32")

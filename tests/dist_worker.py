@@ -1,0 +1,113 @@
+"""Worker for the two-process DCN test (the launcher-less analogue of the
+reference's ``tests/nightly/dist_sync_kvstore.py`` run with
+``tools/launch.py -n 2 --launcher local``).
+
+Usage: dist_worker.py <coordinator> <num_procs> <rank> <outdir>
+
+Runs three conformance checks against the multi-process (DCN) branch of
+``parallel.collectives.allreduce_nd`` and the KVStore rank/num_workers
+surface, then trains a deterministic MLP through
+``Module.fit(kvstore='dist_tpu_sync')`` on this rank's shard of the data
+and saves the final params for the runner to compare.
+"""
+import json
+import os
+import sys
+
+# one CPU device per process; the split Module path is the multi-process
+# contract under test (grads ride kvstore push/pull over DCN)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MXNET_FUSED_STEP"] = "0"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    coordinator, num_procs, rank, outdir = sys.argv[1:5]
+    num_procs, rank = int(num_procs), int(rank)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_procs,
+                               process_id=rank)
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    assert jax.process_count() == num_procs
+
+    results = {}
+
+    # 1) dense push/pull across processes
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.rank == rank and kv.num_workers == num_procs
+    kv.init("w", mx.nd.zeros((4, 3)))
+    grad = mx.nd.array(np.full((4, 3), float(rank + 1), "float32"))
+    kv.push("w", grad)
+    out = mx.nd.zeros((4, 3))
+    kv.pull("w", out=out)
+    expect = sum(r + 1 for r in range(num_procs))
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    results["dense_push_pull"] = "ok"
+
+    # 2) row_sparse push across processes (densify -> DCN sum -> sparse)
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv.init("emb", mx.nd.zeros((6, 2)))
+    rows = np.array([rank, rank + 2], "int32")
+    vals = np.full((2, 2), float(rank + 1), "float32")
+    rsp = sp.row_sparse_array((vals, rows), shape=(6, 2))
+    kv.push("emb", rsp)
+    dense = mx.nd.zeros((6, 2))
+    kv.pull("emb", out=dense)
+    expect_emb = np.zeros((6, 2), "float32")
+    for r in range(num_procs):
+        expect_emb[r] += r + 1
+        expect_emb[r + 2] += r + 1
+    np.testing.assert_allclose(dense.asnumpy(), expect_emb)
+    results["row_sparse_push"] = "ok"
+
+    # 3) row_sparse_pull of selected rows
+    pulled = mx.nd.zeros((2, 2))
+    kv.row_sparse_pull("emb", out=pulled,
+                       row_ids=mx.nd.array([1.0, 3.0]))
+    np.testing.assert_allclose(pulled.asnumpy(), expect_emb[[1, 3]])
+    results["row_sparse_pull"] = "ok"
+
+    # 4) Module.fit on this rank's shard == single-process full batch
+    np.random.seed(7)  # identical init on every rank
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype("float32")
+    w_true = rs.randn(8, 3).astype("float32")
+    y = (X @ w_true).argmax(axis=1).astype("float32")
+    # interleaved shard: the union of every rank's k-th batch equals the
+    # single-process k-th full batch, so trajectories match exactly
+    Xs = X[rank::num_procs]
+    ys = y[rank::num_procs]
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=16)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=3, kvstore="dist_tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    params, _ = mod.get_params()
+    np.savez(os.path.join(outdir, "params_rank%d.npz" % rank),
+             **{k: v.asnumpy() for k, v in params.items()})
+    results["fit"] = "ok"
+
+    with open(os.path.join(outdir, "result_rank%d.json" % rank), "w") as f:
+        json.dump(results, f)
+    print("WORKER %d DONE" % rank)
+
+
+if __name__ == "__main__":
+    main()

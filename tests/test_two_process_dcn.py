@@ -1,0 +1,109 @@
+"""Two-process DCN correctness (VERDICT r3 task 8): spawn 2 CPU
+processes under jax.distributed, exercise kvstore push/pull (dense +
+row_sparse) over the multi-process collectives branch, and check
+Module.fit(kvstore='dist_tpu_sync') produces rank-identical params that
+match a single-process full-batch run.
+
+Reference analogue: ``tests/nightly/dist_sync_kvstore.py`` +
+``dist_lenet.py`` via ``tools/launch.py --launcher local``.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_kvstore_and_fit(tmp_path):
+    worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
+    coord = "127.0.0.1:%d" % _free_port()
+    procs = []
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(rank),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        assert p.returncode == 0, \
+            "worker %d failed:\n%s" % (rank, out[-4000:])
+
+    for rank in range(2):
+        with open(str(tmp_path / ("result_rank%d.json" % rank))) as f:
+            res = json.load(f)
+        assert res == {"dense_push_pull": "ok", "row_sparse_push": "ok",
+                       "row_sparse_pull": "ok", "fit": "ok"}, res
+
+    p0 = dict(np.load(str(tmp_path / "params_rank0.npz")))
+    p1 = dict(np.load(str(tmp_path / "params_rank1.npz")))
+    # both ranks end with identical parameters (sync data parallelism)
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=1e-5, atol=1e-6,
+                                   err_msg="ranks diverge on %s" % k)
+
+    # and they match a single-process run over the FULL batch (the
+    # reference's dist_sync == local equivalence; run in a subprocess so
+    # jax.distributed never touches this pytest process)
+    single = subprocess.run(
+        [sys.executable, "-c", _SINGLE_PROC_SCRIPT, str(tmp_path)],
+        env=dict(env, JAX_PLATFORMS="cpu", MXNET_FUSED_STEP="0"),
+        capture_output=True, text=True, timeout=420,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert single.returncode == 0, single.stdout + single.stderr
+    ref = dict(np.load(str(tmp_path / "params_single.npz")))
+    for k in ref:
+        np.testing.assert_allclose(
+            p0[k], ref[k], rtol=1e-4, atol=1e-5,
+            err_msg="dist diverges from single-process on %s" % k)
+
+
+_SINGLE_PROC_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, "")
+import numpy as np
+import mxnet_tpu as mx
+
+outdir = sys.argv[1]
+np.random.seed(7)
+rs = np.random.RandomState(0)
+X = rs.randn(64, 8).astype("float32")
+w_true = rs.randn(8, 3).astype("float32")
+y = (X @ w_true).argmax(axis=1).astype("float32")
+it = mx.io.NDArrayIter(X, y, batch_size=32)
+
+data = mx.sym.Variable("data")
+fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+act = mx.sym.Activation(fc1, act_type="relu")
+fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=3, kvstore="local", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        initializer=mx.init.Xavier())
+params, _ = mod.get_params()
+np.savez(os.path.join(outdir, "params_single.npz"),
+         **{k: v.asnumpy() for k, v in params.items()})
+print("SINGLE DONE")
+"""
