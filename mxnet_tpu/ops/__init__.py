@@ -13,6 +13,7 @@ from . import nn_ops        # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops # noqa: F401
 from . import rnn_ops       # noqa: F401
+from . import contrib_ops   # noqa: F401
 
 __all__ = ["OpDef", "register", "get", "list_ops", "invoke", "FrozenAttrs",
            "registry"]

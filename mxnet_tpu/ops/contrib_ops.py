@@ -1,0 +1,537 @@
+"""Contrib operators — detection / research ops.
+
+Reference: ``src/operator/contrib/`` (~12k LoC of CUDA/C++; SURVEY.md
+§2.1 "Operators — contrib"): the SSD family (MultiBoxPrior/Target/
+Detection), the R-CNN family (Proposal, PSROIPooling,
+DeformableConvolution), CTCLoss, FFT, quantization.
+
+TPU-first formulations: everything is static-shape.  NMS and proposal
+selection keep FIXED candidate counts (top-k + masked suppression loops
+via ``lax.fori_loop`` — invalid slots carry -1/0 like the reference's
+pad semantics) instead of the reference's dynamic-length CUDA kernels;
+CTC is the log-domain forward recursion as one ``lax.scan`` with the
+gradient from autodiff; deformable conv gathers bilinear samples and
+contracts on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _tuple_attr(attrs, name, default):
+    v = attrs.get(name, default)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# SSD family
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def _multibox_prior(attrs, data):
+    """Anchor generation (reference ``multibox_prior.cc``): one anchor
+    per (size, ratio) combo per cell, count = len(sizes)+len(ratios)-1,
+    output (1, H*W*A, 4) corner-form normalized boxes."""
+    sizes = _tuple_attr(attrs, "sizes", (1.0,))
+    ratios = _tuple_attr(attrs, "ratios", (1.0,))
+    clip = bool(attrs.get("clip", False))
+    steps = _tuple_attr(attrs, "steps", (-1.0, -1.0))
+    offsets = _tuple_attr(attrs, "offsets", (0.5, 0.5))
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if len(steps) > 1 and steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchor (w, h) list: all sizes with ratio[0], then ratios[1:] with
+    # size[0] (the reference's combination rule)
+    whs = [(s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0]))
+           for s in sizes]
+    whs += [(sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r))
+            for r in ratios[1:]]
+    aw = jnp.asarray([x[0] for x in whs], jnp.float32)
+    ah = jnp.asarray([x[1] for x in whs], jnp.float32)
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")     # (H, W)
+    cyg = cyg[:, :, None]
+    cxg = cxg[:, :, None]
+    xmin = cxg - aw / 2.0
+    ymin = cyg - ah / 2.0
+    xmax = cxg + aw / 2.0
+    ymax = cyg + ah / 2.0
+    out = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # (H, W, A, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.reshape(1, -1, 4)
+
+
+def _iou_matrix(a, b):
+    """(N,4) corner boxes x (M,4) -> (N,M) IoU."""
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          num_outputs=3)
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Anchor->GT matching (reference ``multibox_target.cc``).
+
+    anchor (1, N, 4); label (B, M, 5) rows [cls, xmin, ymin, xmax, ymax]
+    padded with cls=-1; cls_pred is unused for matching (the reference
+    uses it only for negative mining, which is subsumed by the loss-side
+    weighting here).  Outputs: loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N) — cls_target is gt class + 1, 0 = background.
+    """
+    overlap = float(attrs.get("overlap_threshold", 0.5))
+    variances = _tuple_attr(attrs, "variances", (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor[0]                                   # (N, 4)
+
+    def one(lbl):
+        valid = lbl[:, 0] >= 0                            # (M,)
+        ious = _iou_matrix(anchors, lbl[:, 1:5])          # (N, M)
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)                # (N,)
+        best_iou = jnp.max(ious, axis=1)
+        matched = best_iou >= overlap
+        # force-match: each VALID gt claims its best anchor; padded rows
+        # scatter out of bounds and are dropped (they would all land on
+        # anchor 0 otherwise, clobbering real matches)
+        n_anchors = anchors.shape[0]
+        best_anchor = jnp.argmax(ious, axis=0)            # (M,)
+        safe_anchor = jnp.where(valid, best_anchor, n_anchors)
+        forced = jnp.zeros(n_anchors, bool).at[safe_anchor].set(
+            True, mode="drop")
+        gt_for_forced = jnp.zeros(n_anchors, jnp.int32).at[
+            safe_anchor].set(jnp.arange(lbl.shape[0], dtype=jnp.int32),
+                             mode="drop")
+        gt_idx = jnp.where(forced, gt_for_forced, best_gt)
+        pos = matched | forced
+
+        gt = lbl[gt_idx]                                  # (N, 5)
+        # center-form offsets scaled by variances (reference encoding)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ahh = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+        gh = jnp.maximum(gt[:, 4] - gt[:, 2], 1e-8)
+        gcx = (gt[:, 1] + gt[:, 3]) / 2
+        gcy = (gt[:, 2] + gt[:, 4]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ahh, 1e-8) / variances[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+        th = jnp.log(gh / jnp.maximum(ahh, 1e-8)) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)       # (N, 4)
+        mask = pos[:, None].astype(jnp.float32) * jnp.ones((1, 4))
+        cls_t = jnp.where(pos, gt[:, 0].astype(jnp.float32) + 1.0, 0.0)
+        return (loc_t * mask).reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    return loc_t, loc_m, cls_t
+
+
+def _decode_boxes(anchors, deltas, variances):
+    """Invert the center-form encoding: (N,4) anchors + deltas -> corners."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = deltas[:, 0] * variances[0] * aw + acx
+    cy = deltas[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(deltas[:, 2] * variances[2]) * aw
+    h = jnp.exp(deltas[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _nms_mask(boxes, scores, iou_thr, topk, cls_id=None):
+    """Static-shape greedy NMS: returns keep mask.  ``topk`` rounds of
+    select-max + suppress (the reference's nms_topk cap).  With
+    ``cls_id`` given, suppression applies only within the same class
+    (the reference's ``force_suppress=False`` default)."""
+    n = boxes.shape[0]
+    ious = _iou_matrix(boxes, boxes)
+
+    def body(_, state):
+        alive, keep = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        any_alive = masked[best] > -jnp.inf
+        keep = keep.at[best].set(keep[best] | any_alive)
+        suppress = ious[best] > iou_thr
+        if cls_id is not None:
+            suppress = suppress & (cls_id == cls_id[best])
+        alive = alive & ~suppress & (jnp.arange(n) != best)
+        return alive, keep
+
+    alive0 = jnp.ones(n, bool)
+    keep0 = jnp.zeros(n, bool)
+    _, keep = lax.fori_loop(0, min(topk, n), body, (alive0, keep0))
+    return keep
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + per-class NMS (reference ``multibox_detection.cc``).
+
+    cls_prob (B, C, N) incl. background class 0; loc_pred (B, N*4);
+    anchor (1, N, 4).  Output (B, N, 6): [cls_id, score, xmin, ymin,
+    xmax, ymax], suppressed/invalid rows get cls_id -1.
+    """
+    thr = float(attrs.get("threshold", 0.01))
+    nms_thr = float(attrs.get("nms_threshold", 0.5))
+    topk = int(attrs.get("nms_topk", -1))
+    clip = bool(attrs.get("clip", True))
+    force_suppress = bool(attrs.get("force_suppress", False))
+    variances = _tuple_attr(attrs, "variances", (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor[0]
+    n = anchors.shape[0]
+    if topk <= 0:
+        topk = n
+
+    def one(probs, deltas):
+        boxes = _decode_boxes(anchors, deltas.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        score = jnp.max(probs[1:], axis=0)          # best non-background
+        cls_id = jnp.argmax(probs[1:], axis=0).astype(jnp.float32)
+        keep = _nms_mask(boxes, score, nms_thr, topk,
+                         cls_id=None if force_suppress else cls_id)
+        ok = keep & (score > thr)
+        cls_out = jnp.where(ok, cls_id, -1.0)
+        return jnp.concatenate([cls_out[:, None], score[:, None], boxes],
+                               axis=-1)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# R-CNN family
+# ---------------------------------------------------------------------------
+
+@register("_contrib_Proposal", aliases=("Proposal", "MultiProposal",
+                                        "_contrib_MultiProposal"))
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal generation (reference ``proposal.cc``): decode
+    per-anchor deltas, clip to image, score by objectness, fixed-count
+    top-k + NMS.  Output (B, rpn_post_nms_top_n, 5) rows
+    [batch_idx, x1, y1, x2, y2] (invalid rows repeat the best box, like
+    the reference's padding)."""
+    scales = _tuple_attr(attrs, "scales", (4.0, 8.0, 16.0, 32.0))
+    ratios = _tuple_attr(attrs, "ratios", (0.5, 1.0, 2.0))
+    stride = float(attrs.get("feature_stride", 16))
+    pre_n = int(attrs.get("rpn_pre_nms_top_n", 6000))
+    post_n = int(attrs.get("rpn_post_nms_top_n", 300))
+    nms_thr = float(attrs.get("threshold", 0.7))
+    min_size = float(attrs.get("rpn_min_size", 16))
+
+    b, a2, h, w = cls_prob.shape
+    num_anchors = a2 // 2
+    # base anchors at each cell (corner form, image coords)
+    base = []
+    for r in ratios:
+        for s in scales:
+            ww = stride * s * jnp.sqrt(1.0 / r)
+            hh = stride * s * jnp.sqrt(r)
+            base.append((-ww / 2, -hh / 2, ww / 2, hh / 2))
+    base = jnp.asarray(base, jnp.float32)        # (A, 4)
+    sy = jnp.arange(h, dtype=jnp.float32) * stride
+    sx = jnp.arange(w, dtype=jnp.float32) * stride
+    cyg, cxg = jnp.meshgrid(sy, sx, indexing="ij")
+    shift = jnp.stack([cxg, cyg, cxg, cyg], axis=-1)  # (H, W, 4)
+    anchors = (shift[:, :, None, :] + base[None, None, :, :]
+               ).reshape(-1, 4)                       # (H*W*A, 4)
+
+    def one(scores_map, deltas_map, info):
+        # scores: foreground half of cls_prob, layout (A, H, W)
+        fg = scores_map[num_anchors:].transpose(1, 2, 0).reshape(-1)
+        deltas = deltas_map.reshape(num_anchors, 4, h, w) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = _decode_boxes_rcnn(anchors, deltas)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        ms = min_size * info[2]
+        valid = (ws >= ms) & (hs >= ms)
+        fg = jnp.where(valid, fg, -jnp.inf)
+        k = min(pre_n, fg.shape[0])
+        top_scores, top_idx = lax.top_k(fg, k)
+        top_boxes = boxes[top_idx]
+        keep = _nms_mask(top_boxes, top_scores, nms_thr, post_n)
+        score_keep = jnp.where(keep, top_scores, -jnp.inf)
+        kk = min(post_n, k)
+        _, sel = lax.top_k(score_keep, kk)
+        out_boxes = top_boxes[sel]
+        if kk < post_n:
+            out_boxes = jnp.concatenate(
+                [out_boxes, jnp.broadcast_to(out_boxes[:1],
+                                             (post_n - kk, 4))])
+        return out_boxes
+
+    outs = jax.vmap(one)(cls_prob, bbox_pred, im_info)   # (B, post, 4)
+    bidx = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.float32)[:, None, None], (b, post_n, 1))
+    return jnp.concatenate([bidx, outs], axis=-1)
+
+
+def _decode_boxes_rcnn(anchors, deltas):
+    """R-CNN style decoding (pixel coords, +1 widths)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(deltas[:, 2]) * aw
+    h = jnp.exp(deltas[:, 3]) * ah
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5, cy + h * 0.5], axis=-1)
+
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def _psroi_pooling(attrs, data, rois):
+    """Position-sensitive ROI pooling (reference ``psroi_pooling.cc``):
+    channel block (i,j) of the output grid average-pools its own group
+    of input channels inside subcell (i,j) of the ROI."""
+    spatial_scale = float(attrs["spatial_scale"])
+    output_dim = int(attrs["output_dim"])
+    pooled = int(attrs.get("pooled_size", attrs.get("group_size", 7)))
+    group = int(attrs.get("group_size", pooled))
+    n, c, h, w = data.shape
+    if c != output_dim * group * group:
+        raise MXNetError("PSROIPooling: data channels %d != output_dim*"
+                         "group_size^2 = %d" % (c, output_dim * group *
+                                                group))
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        x2 = roi[3] * spatial_scale
+        y2 = roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw = rw / pooled
+        bh = rh / pooled
+        img = data[bidx].reshape(output_dim, group * group, h, w)
+
+        def cell(iy, ix):
+            cy0 = y1 + iy * bh
+            cy1 = y1 + (iy + 1) * bh
+            cx0 = x1 + ix * bw
+            cx1 = x1 + (ix + 1) * bw
+            m = ((ys[:, None] >= jnp.floor(cy0)) &
+                 (ys[:, None] < jnp.maximum(jnp.ceil(cy1),
+                                            jnp.floor(cy0) + 1)) &
+                 (xs[None, :] >= jnp.floor(cx0)) &
+                 (xs[None, :] < jnp.maximum(jnp.ceil(cx1),
+                                            jnp.floor(cx0) + 1)))
+            mf = m.astype(jnp.float32)
+            denom = jnp.maximum(mf.sum(), 1.0)
+            # output cell -> channel group via floor scaling (reference
+            # psroi_pooling: gh = floor(ph*group/pooled)), NOT modulo
+            gidx = (iy * group // pooled) * group + (ix * group // pooled)
+            plane = img[:, gidx]                 # (output_dim, h, w)
+            return (plane * mf).sum(axis=(1, 2)) / denom
+
+        iy, ix = jnp.meshgrid(jnp.arange(pooled), jnp.arange(pooled),
+                              indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(iy, ix)  # (p, p, output_dim)
+        return jnp.moveaxis(cells, -1, 0)          # (output_dim, p, p)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def _deformable_conv(attrs, data, offset, weight, *bias):
+    """Deformable convolution v1 (reference ``deformable_convolution.cc``):
+    each kernel tap samples the input at a learned fractional offset via
+    bilinear interpolation; the contraction is a plain MXU matmul over
+    the gathered patches."""
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    kh, kw = kernel
+    stride = tuple(int(s) for s in attrs.get("stride", (1, 1)))
+    pad = tuple(int(p) for p in attrs.get("pad", (0, 0)))
+    dilate = tuple(int(d) for d in attrs.get("dilate", (1, 1)))
+    groups = int(attrs.get("num_group", 1))
+    dgroups = int(attrs.get("num_deformable_group", 1))
+    if groups != 1 or dgroups != 1:
+        raise MXNetError("DeformableConvolution: only num_group=1, "
+                         "num_deformable_group=1 are supported")
+    n, c, h, w = data.shape
+    out_h = (h + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    out_w = (w + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+
+    oy = jnp.arange(out_h) * stride[0] - pad[0]
+    ox = jnp.arange(out_w) * stride[1] - pad[1]
+    ky = jnp.arange(kh) * dilate[0]
+    kx = jnp.arange(kw) * dilate[1]
+    # base sample positions (kh, kw, out_h, out_w)
+    py = ky[:, None, None, None] + oy[None, None, :, None] + \
+        jnp.zeros((1, kw, 1, out_w))
+    px = kx[None, :, None, None] + ox[None, None, None, :] + \
+        jnp.zeros((kh, 1, out_h, 1))
+
+    def bilinear(img, y, x):
+        """img (c, h, w); y/x sample grids (...,) -> (c, ...)."""
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+
+        def tap(yy, xx):
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yi, xi]
+            ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            return jnp.where(ok, v, 0.0)
+
+        return (tap(y0, x0) * (1 - wy) * (1 - wx) +
+                tap(y0, x0 + 1) * (1 - wy) * wx +
+                tap(y0 + 1, x0) * wy * (1 - wx) +
+                tap(y0 + 1, x0 + 1) * wy * wx)
+
+    def one(img, off):
+        off = off.reshape(kh, kw, 2, out_h, out_w)
+        sy = py + off[:, :, 0]
+        sx = px + off[:, :, 1]
+        patches = bilinear(img, sy, sx)      # (c, kh, kw, oh, ow)
+        return patches
+
+    patches = jax.vmap(one)(data, offset)     # (n, c, kh, kw, oh, ow)
+    out = jnp.einsum("nckhyx,ockh->noyx",
+                     patches.reshape(n, c, kh, kw, out_h, out_w),
+                     weight.reshape(weight.shape[0], c, kh, kw))
+    if bias:
+        out = out + bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CTC loss
+# ---------------------------------------------------------------------------
+
+@register("_contrib_CTCLoss", aliases=("CTCLoss", "ctc_loss"))
+def _ctc_loss(attrs, data, label):
+    """Connectionist temporal classification loss (reference
+    ``ctc_loss.cc`` over the bundled warpctc kernels).
+
+    ``data`` (T, N, C) un-normalized activations (softmax applied
+    internally, warpctc contract); ``label`` (N, L) with class ids in
+    [1, C-1], 0-padded; blank is class 0 (``blank_label='first'``).
+    Output: per-sequence loss (N,).  Gradient comes from autodiff of the
+    log-domain forward recursion (one ``lax.scan`` over time).
+    """
+    t_len, n, c = data.shape
+    l_max = label.shape[1]
+    log_probs = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    labels = label.astype(jnp.int32)
+    lab_len = jnp.sum((labels != 0).astype(jnp.int32), axis=1)
+
+    # extended label sequence: blank, l1, blank, l2, ... blank (2L+1)
+    s = 2 * l_max + 1
+    ext = jnp.zeros((n, s), jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+
+    neg_inf = jnp.float32(-1e30)
+    # alpha recursion in log domain
+    def step(alpha, lp):
+        # lp: (N, C) log prob at time t
+        emit = jnp.take_along_axis(lp, ext, axis=1)     # (N, S)
+        shift1 = jnp.concatenate(
+            [jnp.full((n, 1), neg_inf), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((n, 2), neg_inf), alpha[:, :-2]], axis=1)
+        # skip allowed only between different non-blank labels
+        prev2 = jnp.concatenate(
+            [jnp.zeros((n, 2), jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != 0) & (ext != prev2)
+        best = jnp.logaddexp(alpha, shift1)
+        best = jnp.where(can_skip, jnp.logaddexp(best, shift2), best)
+        new_alpha = best + emit
+        return new_alpha, None
+
+    alpha0 = jnp.full((n, s), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, 0])
+    first_lab = ext[:, 1]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(log_probs[0], first_lab[:, None],
+                            axis=1)[:, 0])
+    alpha, _ = lax.scan(step, alpha0, log_probs[1:])
+
+    # total prob ends at the last blank or last label position
+    end1 = 2 * lab_len          # last blank
+    end2 = 2 * lab_len - 1      # last label
+    a_end1 = jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0]
+    a_end2 = jnp.take_along_axis(
+        alpha, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0]
+    log_p = jnp.where(lab_len > 0, jnp.logaddexp(a_end1, a_end2), a_end1)
+    return -log_p
+
+
+# ---------------------------------------------------------------------------
+# fft / quantization / count_sketch
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", aliases=("fft",))
+def _fft(attrs, data):
+    """1-D FFT over the last axis (reference ``fft.cc`` via cuFFT);
+    complex output packed as interleaved [re, im] like the reference."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def _ifft(attrs, data):
+    half = data.shape[-1] // 2
+    unpacked = data.reshape(data.shape[:-1] + (half, 2))
+    comp = unpacked[..., 0] + 1j * unpacked[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * half
+
+
+@register("_contrib_quantize", aliases=("quantize",), num_outputs=3)
+def _quantize(attrs, data, min_range, max_range):
+    """Affine quantize to uint8 (reference ``quantize.cc``)."""
+    qmin, qmax = 0.0, 255.0
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-8)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(jnp.uint8), min_range, max_range
+
+
+@register("_contrib_dequantize", aliases=("dequantize",))
+def _dequantize(attrs, data, min_range, max_range):
+    scale = jnp.maximum(max_range - min_range, 1e-8) / 255.0
+    return data.astype(jnp.float32) * scale + min_range
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def _count_sketch(attrs, data, h, s):
+    """Count sketch projection (reference ``count_sketch.cc``): hash each
+    input dim into out_dim buckets with sign flips."""
+    out_dim = int(attrs["out_dim"])
+    idx = h.astype(jnp.int32).reshape(-1) % out_dim
+    sign = s.astype(data.dtype).reshape(-1)
+    contrib = data * sign[None, :]
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., idx].add(contrib)

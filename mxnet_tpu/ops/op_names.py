@@ -43,7 +43,26 @@ INPUT_NAMES = {
     "where": (("condition", "x", "y"), ()),
     "take": (("a", "indices"), ()),
     "RNN": (("data", "parameters", "state", "state_cell"), ()),
+    "MultiBoxTarget": (("anchor", "label", "cls_pred"), ()),
+    "MultiBoxDetection": (("cls_prob", "loc_pred", "anchor"), ()),
+    "Proposal": (("cls_prob", "bbox_pred", "im_info"), ()),
+    "MultiProposal": (("cls_prob", "bbox_pred", "im_info"), ()),
+    "PSROIPooling": (("data", "rois"), ()),
+    "DeformableConvolution": (("data", "offset", "weight", "bias"), ()),
+    "CTCLoss": (("data", "label"), ()),
+    "quantize": (("data", "min_range", "max_range"), ()),
+    "dequantize": (("data", "min_range", "max_range"), ()),
+    "count_sketch": (("data", "h", "s"), ()),
 }
+# contrib ops answer under both their legacy and _contrib_ names
+_CONTRIB = ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+            "Proposal", "MultiProposal", "PSROIPooling",
+            "DeformableConvolution", "CTCLoss", "quantize", "dequantize",
+            "count_sketch")
+for _name in _CONTRIB:
+    if _name in INPUT_NAMES:
+        INPUT_NAMES["_contrib_" + _name] = INPUT_NAMES[_name]
+INPUT_NAMES["ctc_loss"] = INPUT_NAMES["CTCLoss"]
 
 _BINARY_DEFAULT = ("lhs", "rhs")
 

@@ -251,6 +251,50 @@ def _build_specs():
         {"state_size": 4, "num_layers": 1, "mode": "lstm",
          "state_outputs": True})
 
+    # -- contrib detection / research ops ---------------------------------
+    s["MultiBoxPrior"] = ([_f(1, 3, 4, 4)],
+                          {"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)})
+    anchors = np.clip(np.sort(
+        np.random.RandomState(0).rand(1, 8, 4), axis=2), 0, 1
+    ).astype("float32")
+    label = np.array([[[1, 0.1, 0.1, 0.5, 0.5], [-1, 0, 0, 0, 0]],
+                      [[0, 0.4, 0.4, 0.9, 0.9], [-1, 0, 0, 0, 0]]],
+                     "float32")
+    s["MultiBoxTarget"] = ([anchors, label, _f(2, 3, 8)], {})
+    s["MultiBoxDetection"] = (
+        [np.abs(_f(2, 3, 8)), _f(2, 32) * 0.1, anchors], {})
+    s["Proposal"] = s["MultiProposal"] = (
+        [np.abs(_f(1, 2, 4, 4)), _f(1, 4, 4, 4) * 0.1,
+         np.array([[64, 64, 1.0]], "float32")],
+        {"scales": (8.0,), "ratios": (1.0,), "rpn_pre_nms_top_n": 12,
+         "rpn_post_nms_top_n": 4, "rpn_min_size": 0})
+    s["PSROIPooling"] = (
+        [_f(1, 8, 8, 8), np.array([[0, 0, 0, 6, 6]], "float32")],
+        {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+         "group_size": 2})
+    s["DeformableConvolution"] = (
+        [_f(1, 3, 6, 6), _f(1, 18, 6, 6) * 0.1, _f(4, 3, 3, 3)],
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 4,
+         "no_bias": True})
+    s["CTCLoss"] = s["ctc_loss"] = (
+        [_f(5, 2, 4), np.array([[1, 2], [3, 0]], "float32")], {})
+    s["fft"] = ([_f(2, 8)], {})
+    s["ifft"] = ([_f(2, 16)], {})
+    s["quantize"] = ([_f(3, 4), np.array([-2.0], "float32"),
+                      np.array([2.0], "float32")], {})
+    s["dequantize"] = (
+        [np.array([[0, 128, 255]], "uint8"),
+         np.array([-2.0], "float32"), np.array([2.0], "float32")], {})
+    s["count_sketch"] = (
+        [_f(2, 6), np.array([[0, 1, 2, 3, 0, 1]], "float32"),
+         np.array([[1, -1, 1, -1, 1, 1]], "float32")],
+        {"out_dim": 4})
+    for _n in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+               "Proposal", "MultiProposal", "PSROIPooling",
+               "DeformableConvolution", "CTCLoss", "fft", "ifft",
+               "quantize", "dequantize", "count_sketch"):
+        s["_contrib_" + _n] = s[_n]
+
     # -- optimizer updates -------------------------------------------------
     s["sgd_update"] = ([_f(4), _f(4)], {"lr": 0.1})
     s["sgd_mom_update"] = ([_f(4), _f(4), _f(4)], {"lr": 0.1,
