@@ -108,7 +108,7 @@ class Conv2DTranspose(_Conv):
 
 class _Pool(HybridBlock):
     def __init__(self, pool_size, strides, padding, global_pool, pool_type,
-                 ndim, **kwargs):
+                 ndim, ceil_mode=False, **kwargs):
         super().__init__(**kwargs)
         self._kernel = _tup(pool_size, ndim)
         self._stride = _tup(strides if strides is not None else pool_size,
@@ -116,13 +116,16 @@ class _Pool(HybridBlock):
         self._pad = _tup(padding, ndim)
         self._global = global_pool
         self._pool_type = pool_type
+        self._ceil = ceil_mode
 
     def forward(self, x):
         from ... import ndarray as nd
 
         return nd.Pooling(x, kernel=self._kernel, stride=self._stride,
                           pad=self._pad, pool_type=self._pool_type,
-                          global_pool=self._global)
+                          global_pool=self._global,
+                          pooling_convention="full" if self._ceil
+                          else "valid")
 
 
 class MaxPool1D(_Pool):

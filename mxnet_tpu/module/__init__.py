@@ -2,5 +2,8 @@
 from .base_module import BaseModule, BatchEndParam
 from .module import Module
 from .bucketing_module import BucketingModule
+from .sequential_module import (SequentialModule, PythonModule,
+                                PythonLossModule)
 
-__all__ = ["BaseModule", "Module", "BucketingModule", "BatchEndParam"]
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
+           "PythonModule", "PythonLossModule", "BatchEndParam"]

@@ -1,0 +1,288 @@
+"""SequentialModule + PythonModule (reference
+``python/mxnet/module/sequential_module.py`` / ``python_module.py``)."""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule", "PythonModule", "PythonLossModule"]
+
+
+class SequentialModule(BaseModule):
+    """Chain modules: each module's outputs feed the next one's data
+    (reference ``SequentialModule``).  ``add(mod, take_labels=True)``
+    marks the module that receives the iterator's labels (typically the
+    loss head)."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    @property
+    def data_names(self):
+        if self._modules:
+            return self._modules[0].data_names
+        return []
+
+    @property
+    def output_names(self):
+        if self._modules:
+            return self._modules[-1].output_names
+        return []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for mod in self._modules:
+            arg, aux = mod.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        for mod in self._modules:
+            mod.init_params(initializer=initializer, arg_params=arg_params,
+                            aux_params=aux_params,
+                            allow_missing=True if arg_params is not None
+                            else allow_missing,
+                            force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if not self._modules:
+            raise MXNetError("SequentialModule has no modules; call add()")
+        assert shared_module is None
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._label_shapes = label_shapes
+
+        cur_shapes = data_shapes
+        n = len(self._modules)
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, i == n - 1)
+            mod.bind(cur_shapes,
+                     label_shapes if take_labels else None,
+                     for_training=for_training,
+                     inputs_need_grad=inputs_need_grad or i > 0,
+                     force_rebind=force_rebind, grad_req=grad_req)
+            # next module's data shapes = this module's output shapes,
+            # named by ITS data_names (auto wiring)
+            if i + 1 < n:
+                nxt = self._modules[i + 1]
+                out_shapes = mod.output_shapes
+                if len(out_shapes) != len(nxt.data_names):
+                    raise MXNetError(
+                        "cannot wire module %d (%d outputs) into module "
+                        "%d (%d data inputs)" % (i, len(out_shapes),
+                                                 i + 1,
+                                                 len(nxt.data_names)))
+                cur_shapes = [(name, shape) for name, shape in
+                              zip(nxt.data_names, out_shapes)]
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        for mod in self._modules:
+            mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        from ..io import DataBatch
+
+        data = data_batch.data
+        n = len(self._modules)
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, i == n - 1)
+            batch = DataBatch(
+                data=data,
+                label=data_batch.label if take_labels else None,
+                pad=data_batch.pad, index=data_batch.index)
+            mod.forward(batch, is_train=is_train)
+            if i + 1 < n:
+                data = mod.get_outputs()
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        grads = out_grads
+        for i, mod in reversed(list(enumerate(self._modules))):
+            mod.backward(out_grads=grads)
+            if i > 0:
+                grads = mod.get_input_grads()
+
+    def update(self):
+        assert self.optimizer_initialized
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        for mod, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS,
+                        mod is self._modules[-1]):
+                mod.update_metric(eval_metric, labels)
+
+    def install_monitor(self, monitor):
+        for mod in self._modules:
+            mod.install_monitor(monitor)
+
+
+class PythonModule(BaseModule):
+    """A module whose compute is arbitrary Python (reference
+    ``PythonModule``): subclasses override ``forward``/``backward``;
+    parameterless by default."""
+
+    def __init__(self, data_names, label_names, output_names,
+                 logger=logging):
+        super().__init__(logger=logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, *args, **kwargs):
+        self.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self.optimizer_initialized = True
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels):
+        if self._label_names:
+            eval_metric.update(labels, self.get_outputs())
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError
+
+
+class PythonLossModule(PythonModule):
+    """Pass-through loss head in Python (reference ``PythonLossModule``):
+    forward is identity; the gradient function is user-supplied."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 grad_func=None):
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        d = self._data_shapes[0]
+        shape = d.shape if hasattr(d, "shape") else d[1]
+        return [shape]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if data_batch.label:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        if self._grad_func is not None:
+            self._scores_grad = self._grad_func(self._scores, self._labels)
+        else:
+            raise MXNetError("PythonLossModule needs grad_func")
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]
+
+    def install_monitor(self, monitor):
+        pass

@@ -1,0 +1,161 @@
+"""FeedForward estimator, SequentialModule, PythonLossModule, and the
+Gluon model zoo (reference: model.py:408, sequential_module.py,
+python_module.py, gluon/model_zoo/vision)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp_loss():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax",
+                                normalization="batch")
+
+
+def _toy_data(n=150, d=10, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, d).astype("float32")
+    w = rs.randn(d, c).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    return X, y
+
+
+def test_feedforward_fit_predict_score_save_load(tmp_path):
+    X, y = _toy_data()
+    model = mx.model.FeedForward(_mlp_loss(), num_epoch=10,
+                                 optimizer="adam", learning_rate=0.02,
+                                 numpy_batch_size=25,
+                                 initializer=mx.init.Xavier())
+    model.fit(X, y)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=25))
+    assert acc > 0.9, acc
+
+    preds = model.predict(X)
+    assert preds.shape == (150, 3)
+    assert (preds.argmax(axis=1) == y).mean() > 0.9
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 10)
+    preds2 = loaded.predict(X)
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_module_trains():
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=25, shuffle=True)
+
+    d1 = mx.sym.Variable("data")
+    net1 = mx.sym.Activation(mx.sym.FullyConnected(d1, num_hidden=16,
+                                                   name="fc1"),
+                             act_type="relu")
+    d2 = mx.sym.Variable("data")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d2, num_hidden=3, name="fc2"),
+        name="softmax", normalization="batch")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=[], context=mx.cpu()))
+    seq.add(mx.mod.Module(net2, context=mx.cpu()), take_labels=True)
+    seq.fit(it, num_epoch=12, optimizer="adam",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.02})
+    score = dict(seq.score(it, mx.metric.Accuracy()))
+    assert score["accuracy"] > 0.9, score
+
+
+def test_python_loss_module_backward():
+    mod = mx.mod.PythonLossModule(
+        grad_func=lambda scores, labels: mx.nd.array(
+            scores.asnumpy() * 2.0))
+    batch = mx.io.DataBatch(data=[mx.nd.ones((2, 3))],
+                            label=[mx.nd.zeros((2,))])
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (2, 3), "float32")])
+    mod.forward(batch)
+    out = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, 1.0)
+    mod.backward()
+    np.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(), 2.0)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet50_v1",
+                                  "resnet34_v2", "vgg11", "alexnet",
+                                  "squeezenet1.0", "densenet121",
+                                  "mobilenet0.25"])
+def test_model_zoo_builds_and_runs(name):
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    net = get_model(name, classes=4)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 3, 64, 64)
+                    .astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 4)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_model_zoo_hybridize_matches_eager():
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    net = get_model("resnet18_v1", classes=5)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 3, 32, 32)
+                    .astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(hybrid, eager, rtol=1e-4, atol=1e-5)
+
+
+def test_model_zoo_unknown_raises():
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    with pytest.raises(mx.base.MXNetError):
+        get_model("resnet9000")
+    with pytest.raises(mx.base.MXNetError):
+        get_model("resnet18_v1", pretrained=True)
+
+
+def test_feedforward_small_dataset_and_create():
+    """Review regressions: batch clamps to dataset size; create() routes
+    callbacks to fit, not the optimizer."""
+    X, y = _toy_data(n=10)
+    seen = []
+    model = mx.model.FeedForward.create(
+        _mlp_loss(), X, y, num_epoch=2, optimizer="sgd",
+        learning_rate=0.1,
+        eval_end_callback=lambda *a, **k: seen.append(1),
+        eval_data=mx.io.NDArrayIter(X, y, batch_size=5))
+    preds = model.predict(np.zeros((3, 10), "float32"))
+    assert preds.shape == (3, 3)
+
+    out, d, lbl = model.predict(mx.io.NDArrayIter(X, y, batch_size=5),
+                                return_data=True)
+    assert out.shape == (10, 3) and d.shape == (10, 10)
+    assert lbl.shape == (10,)
+
+    with pytest.raises(mx.base.MXNetError):
+        mx.model.FeedForward(_mlp_loss()).save("x")  # num_epoch unset
+
+
+def test_sequential_module_default_label_names():
+    """Intermediate modules with DEFAULT label_names must not receive
+    labels (review regression)."""
+    X, y = _toy_data(n=50)
+    it = mx.io.NDArrayIter(X, y, batch_size=25)
+    net1 = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                              name="s1fc"), act_type="relu")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="s2fc"), name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, context=mx.cpu()))   # default label_names
+    seq.add(mx.mod.Module(net2, context=mx.cpu()), take_labels=True)
+    seq.fit(it, num_epoch=2, optimizer="sgd",
+            initializer=mx.init.Xavier())
+    assert dict(seq.score(it, mx.metric.Accuracy()))["accuracy"] >= 0.2
