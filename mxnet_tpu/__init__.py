@@ -34,6 +34,10 @@ from . import kvstore
 from . import kvstore as kv
 from . import callback
 from . import profiler
+from . import visualization
+from . import visualization as viz
+from . import predictor
+from .predictor import Predictor
 from . import monitor
 from .monitor import Monitor
 from . import model

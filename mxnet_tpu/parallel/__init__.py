@@ -11,7 +11,11 @@ adds as first-class: tensor parallelism, sequence/context parallelism
 from . import mesh
 from . import collectives
 from . import sharding
-from .mesh import create_mesh, current_mesh, set_mesh, mesh_scope
+from . import sequence
+from .mesh import (create_mesh, current_mesh, set_mesh, mesh_scope,
+                   init_distributed)
+from .sequence import ring_attention, sequence_parallel_attention
 
-__all__ = ["mesh", "collectives", "sharding", "create_mesh", "current_mesh",
-           "set_mesh", "mesh_scope"]
+__all__ = ["mesh", "collectives", "sharding", "sequence", "create_mesh",
+           "current_mesh", "set_mesh", "mesh_scope", "init_distributed", "ring_attention",
+           "sequence_parallel_attention"]

@@ -96,3 +96,30 @@ def axis_size(name):
 
 def data_axis_size():
     return axis_size("data")
+
+
+def init_distributed(coordinator=None, num_processes=None,
+                     process_id=None):
+    """Join a multi-process job (the worker-side counterpart of
+    ``tools/launch.py``; the reference's ps-lite rendezvous role is
+    played by ``jax.distributed``'s coordination service).
+
+    Arguments default from the launcher env: ``MXNET_COORDINATOR``,
+    ``MXNET_NUM_WORKERS``, ``MXNET_WORKER_ID``.  No-op when those are
+    absent (single-process run).
+    """
+    import os
+
+    import jax
+
+    coordinator = coordinator or os.environ.get("MXNET_COORDINATOR")
+    if coordinator is None:
+        return False
+    if num_processes is None:
+        num_processes = int(os.environ.get("MXNET_NUM_WORKERS", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("MXNET_WORKER_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True

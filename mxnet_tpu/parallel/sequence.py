@@ -1,0 +1,156 @@
+"""Sequence/context parallelism — ring attention over the 'seq' mesh axis.
+
+The reference has nothing to port here (2017: bucketing + truncated BPTT
+were its long-sequence story, SURVEY.md §5 "Long-context"); this is the
+fresh TPU-first design the blueprint calls for: shard the SEQUENCE axis
+of Q/K/V over the mesh's 'seq' axis, and rotate K/V blocks around the
+ring with ``lax.ppermute`` while each device accumulates its queries'
+attention in flash-attention style (running max + running sum), so the
+full T×T score matrix never materializes and each hop's communication
+overlaps the current block's compute (Liu et al., Ring Attention, 2023 —
+public technique).
+
+Two entry points:
+
+* :func:`ring_attention` — inside ``shard_map``/``pjit`` code: takes the
+  LOCAL (per-device) Q/K/V chunks and an axis name.
+* :func:`sequence_parallel_attention` — whole-array convenience: shards
+  (B, H, T, D) tensors over the active mesh's 'seq' axis via shard_map
+  and runs :func:`ring_attention`.
+
+Causal masking is supported: block positions are recovered from the ring
+hop index, so masking stays exact under rotation.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+from .mesh import current_mesh
+
+__all__ = ["ring_attention", "sequence_parallel_attention"]
+
+
+def _online_softmax_merge(acc, m, l, scores, v):
+    """One flash-attention accumulation step.
+
+    acc: (Tq, D) weighted-value accumulator; m: (Tq, 1) running max;
+    l: (Tq, 1) running denominator; scores: (Tq, Tk) this block's
+    logits; v: (Tk, D).  Returns updated (acc, m, l).
+    """
+    import jax.numpy as jnp
+
+    block_max = jnp.max(scores, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, block_max)
+    # guard against all--inf rows (fully masked block): exp(-inf - -inf)
+    new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    correction = jnp.exp(m - new_m_safe)
+    correction = jnp.where(jnp.isfinite(m), correction, 0.0)
+    p = jnp.exp(scores - new_m_safe)
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    new_l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    new_acc = acc * correction + p @ v
+    return new_acc, new_m, new_l
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Ring attention on LOCAL chunks inside shard_map.
+
+    q/k/v: (..., T_local, D) — leading dims (batch, heads) are free; the
+    sequence axis is sharded over ``axis_name``.  Each of the
+    ``axis_size`` hops computes one (T_local x T_local) score block and
+    rotates K/V to the next neighbor over ICI (``ppermute``), so peak
+    memory is O(T_local^2 / ring) per device and the transfer of hop
+    i+1 overlaps the matmul of hop i in XLA's schedule.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    t_local = q.shape[-2]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    neg_inf = jnp.float32(-jnp.inf)
+    acc0 = jnp.zeros(q.shape[:-1] + (d,), jnp.float32)
+    m0 = jnp.full(q.shape[:-1] + (1,), neg_inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+
+    q32 = q.astype(jnp.float32) * scale
+    if causal:
+        # global positions of this device's queries
+        q_pos = rank * t_local + jnp.arange(t_local)
+
+    def hop(i, state):
+        acc, m, l, kk, vv = state
+        # the K/V block now resident came from rank - i (ring rotation)
+        src = (rank - i) % n
+        scores = jnp.einsum("...qd,...kd->...qk", q32,
+                            kk.astype(jnp.float32))
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask, scores, neg_inf)
+        acc, m, l = _online_softmax_merge(acc, m, l, scores,
+                                          vv.astype(jnp.float32))
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return acc, m, l, kk, vv
+
+    state = (acc0, m0, l0, k, v)
+    for i in range(n):  # static unroll: n is a mesh constant
+        state = hop(i, state)
+    acc, m, l, _, _ = state
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_attention(q, k, v, causal=False, mesh=None,
+                                axis="seq"):
+    """Whole-array sequence-parallel attention.
+
+    q/k/v: (B, H, T, D) with T divisible by the mesh's ``axis`` size.
+    Shards T over the mesh and runs :func:`ring_attention` under
+    ``shard_map``; batch/heads stay replicated unless the caller already
+    sharded them (composable with data parallelism via ``pjit``).
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None or axis not in mesh.shape:
+        raise MXNetError(
+            "sequence_parallel_attention needs a mesh with a %r axis "
+            "(create one with parallel.create_mesh)" % axis)
+    t = q.shape[-2]
+    n = mesh.shape[axis]
+    if t % n != 0:
+        raise MXNetError("sequence length %d not divisible by %s=%d"
+                         % (t, axis, n))
+    return _sp_attention_fn(mesh, axis, causal)(q, k, v)
+
+
+@functools.lru_cache(maxsize=32)
+def _sp_attention_fn(mesh, axis, causal):
+    """Cached jitted shard_map program per (mesh, axis, causal): jit
+    caches by function identity, so rebuilding per call would re-trace
+    and recompile every step."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis, None)
+    body = functools.partial(ring_attention, axis_name=axis,
+                             causal=causal)
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:  # older jax spells the flag check_rep
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return jax.jit(fn)

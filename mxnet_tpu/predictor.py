@@ -1,0 +1,122 @@
+"""Predictor — the forward-only deployment surface.
+
+Reference: ``src/c_api/c_predict_api.cc`` + ``amalgamation/`` (SURVEY.md
+§2.1 "Amalgamation / predictor ABI"): create from a symbol-JSON string +
+a parameter blob, set inputs, forward, read outputs — no gradient
+machinery, no optimizer, suitable for serving.
+
+TPU-native form: the bound graph compiles to ONE inference XLA program
+(cached per input shapes); ``Predictor`` never builds the vjp, so its
+memory footprint is the weights plus one activation set.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from .base import MXNetError
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Forward-only executor (reference ``MXPredCreate``/``MXPredForward``
+    / ``MXPredGetOutput``)."""
+
+    def __init__(self, symbol_json, param_bytes_or_dict, input_shapes,
+                 ctx=None):
+        from . import symbol as sym_mod
+        from .ndarray import NDArray
+
+        if isinstance(symbol_json, str):
+            self._symbol = sym_mod.load_json(symbol_json)
+        else:
+            self._symbol = symbol_json
+        if isinstance(param_bytes_or_dict, (bytes, bytearray)):
+            params = self._load_param_bytes(bytes(param_bytes_or_dict))
+        else:
+            params = dict(param_bytes_or_dict)
+        # reference param files prefix keys with arg:/aux:
+        arg_params, aux_params = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        self._input_shapes = dict(input_shapes)
+        self._exec = self._symbol.simple_bind(
+            ctx, grad_req="null", **self._input_shapes)
+        for name, arr in arg_params.items():
+            if name in self._exec.arg_dict:
+                if tuple(arr.shape) != self._exec.arg_dict[name].shape:
+                    raise MXNetError(
+                        "param %s shape %s != expected %s"
+                        % (name, tuple(arr.shape),
+                           self._exec.arg_dict[name].shape))
+                arr.copyto(self._exec.arg_dict[name])
+        for name, arr in aux_params.items():
+            if name in self._exec.aux_dict:
+                arr.copyto(self._exec.aux_dict[name])
+        # label variables are not needed for inference (loss heads ignore
+        # them at is_train=False); they stay zero-filled
+        missing = [n for n in self._exec.arg_dict
+                   if n not in arg_params and n not in self._input_shapes
+                   and not n.endswith("_label")]
+        if missing:
+            raise MXNetError("predictor missing parameters: %s" % missing)
+
+    @staticmethod
+    def _load_param_bytes(blob):
+        import io as _io
+        import zipfile
+
+        import numpy as np
+
+        from .ndarray import array
+
+        with zipfile.ZipFile(_io.BytesIO(blob)) as zf:
+            data = {k: np.load(_io.BytesIO(zf.read(k))) for k in
+                    zf.namelist()}
+        return {(k[:-4] if k.endswith(".npy") else k): array(v)
+                for k, v in data.items()}
+
+    @classmethod
+    def load(cls, prefix, epoch, input_shapes, ctx=None):
+        """Create from checkpoint files (reference ``MXPredCreate`` over
+        ``prefix-symbol.json`` + ``prefix-%04d.params``)."""
+        from .model import load_checkpoint
+
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        params = {"arg:" + k: v for k, v in arg.items()}
+        params.update({"aux:" + k: v for k, v in aux.items()})
+        return cls(sym, params, input_shapes, ctx=ctx)
+
+    def set_input(self, name, value):
+        """Reference ``MXPredSetInput``."""
+        if name not in self._input_shapes:
+            raise MXNetError("unknown input %r (inputs: %s)"
+                             % (name, sorted(self._input_shapes)))
+        from .ndarray import NDArray, array
+
+        arr = value if isinstance(value, NDArray) else array(value)
+        arr.copyto(self._exec.arg_dict[name])
+
+    def forward(self, **inputs):
+        """Run inference; optional inputs as kwargs (reference
+        ``MXPredForward``)."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._exec.forward(is_train=False)
+        return self._exec.outputs
+
+    def get_output(self, index=0):
+        """Reference ``MXPredGetOutput`` — returns a numpy array."""
+        outs = self._exec.outputs
+        if not outs:
+            raise MXNetError("call forward() before get_output()")
+        return outs[index].asnumpy()
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()

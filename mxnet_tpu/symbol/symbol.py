@@ -146,11 +146,79 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, **kwargs):
-        # dtype inference: trace with given dtypes (default float32)
-        arg_names = self.list_arguments()
-        return ([kwargs.get(n, "float32") for n in arg_names],
-                ["float32"] * len(self._outputs),
-                ["float32"] * len(self.list_auxiliary_states()))
+        """Dtype inference (reference per-op FInferType).
+
+        Propagation rules: most ops are same-type (inputs promote via
+        numpy rules and outputs follow); ``Cast`` and the random
+        initializer ops take their ``dtype`` attr; integer-output ops
+        (argmax/argsort/one_hot indices) keep the reference's
+        float-output convention so no special case is needed.  Unknown
+        parameter variables are back-filled from their consumer's
+        resolved dtype (the reference's backward inference: conv weights
+        take the data's dtype), then default to float32.
+        """
+        import numpy as np
+
+        dtypes = {}  # var name -> np.dtype
+        for k, v in kwargs.items():
+            if v is not None:
+                dtypes[k] = np.dtype(v)
+        node_out = {}  # (node id) -> np.dtype
+
+        _ATTR_DTYPE_OPS = {"Cast", "cast", "_zeros", "_ones", "_arange",
+                           "zeros", "ones", "arange"}
+
+        def resolve_once():
+            changed = False
+            for node in self._topo():
+                if node.is_variable:
+                    continue
+                from_attr = node.op.name in _ATTR_DTYPE_OPS and \
+                    "dtype" in node.attrs
+                if from_attr:
+                    dt = np.dtype(str(node.attrs["dtype"]))
+                else:
+                    known = []
+                    for (src, _i) in node.inputs:
+                        if src.is_variable:
+                            if src.name in dtypes:
+                                known.append(dtypes[src.name])
+                        elif id(src) in node_out:
+                            known.append(node_out[id(src)])
+                    if not known:
+                        continue
+                    dt = known[0]
+                    for other in known[1:]:
+                        dt = np.promote_types(dt, other)
+                if node_out.get(id(node)) != dt:
+                    node_out[id(node)] = dt
+                    changed = True
+                # backward fill: unresolved variable inputs adopt dt —
+                # except through attr-dtyped ops (Cast's output says
+                # nothing about its input)
+                if not from_attr:
+                    for (src, _i) in node.inputs:
+                        if src.is_variable and src.name not in dtypes:
+                            dtypes[src.name] = dt
+                            changed = True
+            return changed
+
+        for _ in range(3):  # DAG fixpoint: 2 passes suffice, 3 is safety
+            if not resolve_once():
+                break
+
+        default = np.dtype("float32")
+        arg_types = [dtypes.get(n, default)
+                     for n in self.list_arguments()]
+        aux_types = [dtypes.get(n, default)
+                     for n in self.list_auxiliary_states()]
+        out_types = []
+        for (n, _i) in self._outputs:
+            if n.is_variable:
+                out_types.append(dtypes.get(n.name, default))
+            else:
+                out_types.append(node_out.get(id(n), default))
+        return arg_types, out_types, aux_types
 
     def _infer(self, shape_kwargs, key="shape"):
         """Infer every argument/aux shape from the given input shapes by

@@ -1,0 +1,157 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``):
+``print_summary`` — layer table with shapes and parameter counts;
+``plot_network`` — graphviz Digraph (DOT-text fallback when graphviz is
+not installed, which is the case in this build environment)."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_label(node):
+    a = node.attrs
+    op = node.op.name if node.op is not None else "null"
+    if op in ("Convolution", "Deconvolution"):
+        kernel = "x".join(str(k) for k in a.get("kernel", ()))
+        stride = "x".join(str(s) for s in a.get("stride", (1,)))
+        return "%s\n%s/%s, %s" % (op, kernel, stride,
+                                  a.get("num_filter", "?"))
+    if op == "FullyConnected":
+        return "FullyConnected\n%s" % a.get("num_hidden", "?")
+    if op == "Pooling":
+        return "Pooling\n%s, %s" % (a.get("pool_type", "max"),
+                                    tuple(a.get("kernel", ())))
+    if op in ("Activation", "LeakyReLU"):
+        return "%s\n%s" % (op, a.get("act_type", ""))
+    return op
+
+
+def _per_node_output_shapes(symbol, arg_shapes):
+    """Abstractly evaluate the graph once, recording every node's first
+    output shape (the summary's 'Output Shape' column)."""
+    import jax
+    from .ops import registry as _registry
+
+    shapes = {}
+
+    def trace():
+        env = {}
+        for node in symbol._topo():
+            if node.is_variable:
+                env[(id(node), 0)] = jax.numpy.zeros(
+                    arg_shapes[node.name], "float32")
+                continue
+            ins = [env[(id(n), i)] for (n, i) in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op.uses_train_mode:
+                attrs["__is_train__"] = False
+            if node.op.needs_rng:
+                ins = [jax.random.PRNGKey(0)] + ins
+            res = node.op.compute(_registry.FrozenAttrs(attrs), *ins)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for i, r in enumerate(res):
+                env[(id(node), i)] = r
+        return tuple(env[(id(n), 0)] for n in symbol._topo()
+                     if not n.is_variable)
+
+    try:
+        specs = jax.eval_shape(trace)
+    except Exception:
+        return {}
+    nodes = [n for n in symbol._topo() if not n.is_variable]
+    for node, spec in zip(nodes, specs):
+        shapes[id(node)] = str(tuple(int(d) for d in spec.shape))
+    return shapes
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Layer-by-layer summary (reference ``print_summary``): name, type,
+    output shape, parameter count, inputs.  Returns total params."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    if shape is None:
+        shape = {}
+    arg_shapes = {}
+    node_shapes = {}
+    if shape:
+        from .symbol.symbol import _infer_param_shapes
+
+        arg_shapes = _infer_param_shapes(symbol, dict(shape))
+        node_shapes = _per_node_output_shapes(symbol, arg_shapes)
+
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def row(fields):
+        line = ""
+        for f, p in zip(fields, positions):
+            line = (line + str(f))[:p - 1].ljust(p)
+        print(line)
+
+    print("=" * line_length)
+    row(headers)
+    print("=" * line_length)
+    total = 0
+    arg_names = set(symbol.list_arguments())
+    data_names = set(shape)
+    seen_params = set()
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        params = 0
+        prevs = []
+        for (src, _i) in node.inputs:
+            if src.is_variable:
+                if src.name in data_names:
+                    prevs.append(src.name)
+                elif src.name.endswith("_label"):
+                    prevs.append(src.name)
+                elif src.name in arg_names and src.name in arg_shapes \
+                        and src.name not in seen_params:
+                    seen_params.add(src.name)
+                    n = 1
+                    for d in arg_shapes[src.name]:
+                        n *= d
+                    params += n
+            else:
+                prevs.append(src.name)
+        total += params
+        out_shape = node_shapes.get(id(node), "")
+        row(["%s (%s)" % (node.name, node.op.name), out_shape, params,
+             ",".join(prevs[:2])])
+    print("=" * line_length)
+    print("Total params: %d" % total)
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", shape=None, node_attrs=None,
+                 save_format="dot"):
+    """Build a graphviz ``Digraph`` of the symbol (reference
+    ``plot_network``).  Without the graphviz package installed, returns
+    the DOT source text instead — same graph, renderable elsewhere."""
+    node_attrs = node_attrs or {}
+    lines = ["digraph %s {" % title.replace(" ", "_"),
+             '  rankdir="BT";']
+    ids = {}
+    for i, node in enumerate(symbol._topo()):
+        ids[id(node)] = "n%d" % i
+        if node.is_variable:
+            lines.append('  n%d [label="%s", shape=oval];'
+                         % (i, node.name))
+        else:
+            lines.append('  n%d [label="%s", shape=box];'
+                         % (i, _node_label(node).replace("\n", "\\n")))
+    for node in symbol._topo():
+        for (src, _i) in node.inputs:
+            lines.append("  %s -> %s;" % (ids[id(src)], ids[id(node)]))
+    lines.append("}")
+    dot_src = "\n".join(lines)
+    try:
+        import graphviz  # noqa: F401
+
+        g = graphviz.Source(dot_src)
+        return g
+    except ImportError:
+        return dot_src

@@ -1,0 +1,123 @@
+"""Predictor deployment surface, visualization, log parsing, launcher
+env plumbing (reference: c_predict_api.cc, visualization.py,
+tools/parse_log.py, tools/launch.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _train_tiny(tmp_path):
+    rs = np.random.RandomState(0)
+    X = rs.randn(60, 6).astype("float32")
+    w = rs.randn(6, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"),
+        name="softmax", normalization="batch")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=5, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "tiny")
+    mod.save_checkpoint(prefix, 5)
+    return prefix, X, mod
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    prefix, X, mod = _train_tiny(tmp_path)
+    pred = mx.Predictor.load(prefix, 5, {"data": (10, 6)})
+    pred.set_input("data", X[:10])
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.shape == (10, 3)
+
+    # matches the training module's forward
+    mod_out = []
+    it = mx.io.NDArrayIter(X[:10], np.zeros(10, "float32"),
+                           batch_size=10)
+    for b in it:
+        mod.forward(b, is_train=False)
+        mod_out.append(mod.get_outputs()[0].asnumpy())
+    np.testing.assert_allclose(out, mod_out[0], rtol=1e-5, atol=1e-6)
+
+    # error surface
+    with pytest.raises(mx.base.MXNetError):
+        pred.set_input("nope", X[:10])
+
+
+def test_predictor_missing_params_raises(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    with pytest.raises(mx.base.MXNetError):
+        mx.Predictor(net.tojson(), {}, {"data": (2, 6)})
+
+
+def test_print_summary_and_plot(capsys):
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.Convolution(mx.sym.Variable("data"), num_filter=8,
+                                   kernel=(3, 3), name="c1"),
+                act_type="relu"),
+            num_hidden=10, name="fc1"), name="softmax")
+    total = mx.viz.print_summary(net, shape={"data": (1, 3, 8, 8)})
+    out = capsys.readouterr().out
+    assert "c1" in out and "fc1" in out
+    assert "(1, 8, 6, 6)" in out  # conv output shape column populated
+    # conv: 8*3*3*3 + 8 ; fc: 10*(8*6*6) + 10
+    assert total == 8 * 3 * 3 * 3 + 8 + 10 * 8 * 6 * 6 + 10
+
+    dot = mx.viz.plot_network(net, shape={"data": (1, 3, 8, 8)})
+    src = dot if isinstance(dot, str) else dot.source
+    assert "digraph" in src and "c1" in src or "Convolution" in src
+
+
+def test_parse_log(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import parse_log
+
+    log = [
+        "INFO Epoch[0] Batch [10] Speed: 100.0 samples/sec",
+        "INFO Epoch[0] Batch [20] Speed: 200.0 samples/sec",
+        "INFO Epoch[0] Train-accuracy=0.5",
+        "INFO Epoch[0] Time cost=3.25",
+        "INFO Epoch[1] Train-accuracy=0.75",
+        "INFO Epoch[1] Validation-accuracy=0.7",
+    ]
+    rows = parse_log.parse(log)
+    assert rows[0]["train-accuracy"] == 0.5
+    assert rows[0]["time"] == 3.25
+    assert rows[0]["speed"] == 150.0
+    assert rows[1]["validation-accuracy"] == 0.7
+
+
+def test_launcher_local_sets_env(tmp_path):
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "launch.py")
+    script = tmp_path / "worker.py"
+    # per-rank output files: concurrent workers sharing one pipe would
+    # interleave mid-line
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['MXNET_WORKER_ID']\n"
+        "line = ' '.join(['RANK', rank, os.environ['MXNET_NUM_WORKERS'],\n"
+        "                 'COORD' if os.environ.get('MXNET_COORDINATOR')\n"
+        "                 else ''])\n"
+        "with open(os.path.join(sys.argv[1], 'out_' + rank), 'w') as f:\n"
+        "    f.write(line)\n")
+    out = subprocess.run(
+        [sys.executable, tool, "-n", "2", "--launcher", "local",
+         sys.executable, str(script), str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    lines = sorted((tmp_path / ("out_%d" % r)).read_text()
+                   for r in range(2))
+    assert lines == ["RANK 0 2 COORD", "RANK 1 2 COORD"]

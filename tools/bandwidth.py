@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""bandwidth.py — measure allreduce/collective bandwidth over the mesh.
+
+Reference: ``tools/bandwidth/measure.py`` (kvstore push/pull bandwidth —
+the tool BASELINE.md points at for the unpublished comm numbers).  Here
+the measured primitive is the XLA collective itself: psum over the
+'data' axis of the active mesh, swept over sizes, reporting algorithmic
+bus bandwidth (2(n-1)/n factor for ring allreduce).
+
+Usage: python tools/bandwidth.py [--sizes-mb 1,4,16,64] [--iters 20]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes-mb", default="1,4,16,64")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.parallel import create_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = create_mesh({"data": n}, devices=devices)
+    print("devices: %d x %s" % (n, getattr(devices[0], "device_kind",
+                                           "?")))
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+
+    for mb in [float(x) for x in args.sizes_mb.split(",")]:
+        elems = int(mb * (1 << 20) / 4)
+        per_dev = -(-elems // n)
+        x = jax.device_put(
+            np.ones((n * per_dev,), "float32"),
+            NamedSharding(mesh, P("data")))
+
+        fn = jax.jit(shard_map(
+            lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+            in_specs=P("data"), out_specs=P("data")))
+        out = fn(x)
+        float(np.asarray(out.addressable_shards[0].data[0]))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(out)
+        float(np.asarray(out.addressable_shards[0].data[0]))
+        dt = (time.perf_counter() - t0) / args.iters
+        nbytes = elems * 4
+        busbw = 2 * (n - 1) / n * nbytes / dt
+        print("size %8.1f MB  time %8.3f ms  busbw %8.2f GB/s"
+              % (mb, dt * 1e3, busbw / 1e9))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""launch.py — multi-process/multi-host job launcher.
+
+Reference: ``tools/launch.py`` over dmlc-tracker (ssh/mpi/sge/yarn/local
+launchers spawning scheduler+server+worker processes with ``DMLC_*``
+env).  The TPU build has no parameter servers: every process is a
+worker, rendezvous runs through ``jax.distributed`` (the TPU runtime's
+coordination service), so the launcher only needs to spawn N copies of
+the training script with the coordinator address and process ids.
+
+    # local: N worker processes on this machine (CPU devices, tests)
+    python tools/launch.py -n 4 --launcher local python train.py ...
+
+    # ssh: one worker per host listed in a hostfile
+    python tools/launch.py -n 2 --launcher ssh -H hosts python train.py
+
+Workers read MXNET_COORDINATOR / MXNET_NUM_WORKERS / MXNET_WORKER_ID and
+call ``mxnet_tpu.parallel.init_distributed()`` (or pass them straight to
+``jax.distributed.initialize``).  On real TPU pods the runtime provides
+these automatically and this launcher is unnecessary — it exists for the
+reference's local/ssh cluster workflow.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers, command, env):
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    for rank in range(num_workers):
+        wenv = dict(env, MXNET_COORDINATOR=coordinator,
+                    MXNET_NUM_WORKERS=str(num_workers),
+                    MXNET_WORKER_ID=str(rank))
+        procs.append(subprocess.Popen(command, env=wenv))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_ssh(num_workers, hostfile, command, env):
+    import shlex
+
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if len(hosts) < num_workers:
+        raise SystemExit("hostfile has %d hosts, need %d"
+                         % (len(hosts), num_workers))
+    coordinator = "%s:%d" % (hosts[0], 29400)
+    passthrough = " ".join(
+        shlex.quote("%s=%s" % (k, v)) for k, v in env.items()
+        if k.startswith(("MXNET_", "MXTPU_", "JAX_", "XLA_")))
+    cmd = " ".join(shlex.quote(c) for c in command)
+    procs = []
+    for rank in range(num_workers):
+        remote = ("cd %s && env %s MXNET_COORDINATOR=%s "
+                  "MXNET_NUM_WORKERS=%d MXNET_WORKER_ID=%d %s"
+                  % (shlex.quote(os.getcwd()), passthrough, coordinator,
+                     num_workers, rank, cmd))
+        procs.append(subprocess.Popen(["ssh", hosts[rank], remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=("local", "ssh"),
+                    default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        raise SystemExit("no command given")
+    env = dict(os.environ)
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, args.command, env))
+    if args.hostfile is None:
+        raise SystemExit("--launcher ssh needs -H hostfile")
+    sys.exit(launch_ssh(args.num_workers, args.hostfile, args.command,
+                        env))
+
+
+if __name__ == "__main__":
+    main()

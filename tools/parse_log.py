@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""parse_log.py — extract per-epoch metrics from training logs
+(reference ``tools/parse_log.py``: turns Module.fit/Speedometer output
+into a table).
+
+Usage: python tools/parse_log.py logfile [--format markdown|csv]
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+# Epoch[3] Train-accuracy=0.912345   /  Epoch[3] Validation-accuracy=...
+_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([\d.eE+-]+)")
+# Epoch[3] Time cost=12.345
+_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.eE+-]+)")
+# Speedometer: Epoch[3] Batch [40]  Speed: 123.45 samples/sec
+_SPEED = re.compile(r"Epoch\[(\d+)\].*Speed[:=]\s*([\d.eE+-]+)")
+
+
+def parse(lines):
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = _METRIC.search(line)
+        if m:
+            epoch, phase, name, val = m.groups()
+            rows[int(epoch)]["%s-%s" % (phase.lower(), name)] = float(val)
+            continue
+        m = _TIME.search(line)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+            continue
+        m = _SPEED.search(line)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(2)))
+    for epoch, vals in speeds.items():
+        rows[epoch]["speed"] = sum(vals) / len(vals)
+    return dict(rows)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=("markdown", "csv"),
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        return
+    cols = sorted({k for v in rows.values() for k in v})
+    if args.format == "csv":
+        print(",".join(["epoch"] + cols))
+        for e in sorted(rows):
+            print(",".join([str(e)] + [str(rows[e].get(c, ""))
+                                       for c in cols]))
+    else:
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("|" + "---|" * (len(cols) + 1))
+        for e in sorted(rows):
+            print("| %d | " % e + " | ".join(
+                "%.6g" % rows[e][c] if c in rows[e] else ""
+                for c in cols) + " |")
+
+
+if __name__ == "__main__":
+    main()
