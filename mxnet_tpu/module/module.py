@@ -558,6 +558,9 @@ def _create_kvstore(kvstore, num_device, arg_params):
     else:
         raise TypeError("kvstore must be KVStore, str or None")
     # update_on_kvstore: the reference defaults True unless explicitly
-    # disabled; sync dist types always update on the (virtual) store
-    update_on_kvstore = True
+    # disabled via MXNET_UPDATE_ON_KVSTORE=0 (env_var.md) — then the
+    # worker-side updater runs on pulled merged gradients instead
+    from ..base import get_env
+
+    update_on_kvstore = get_env("MXNET_UPDATE_ON_KVSTORE", True, bool)
     return kv, update_on_kvstore

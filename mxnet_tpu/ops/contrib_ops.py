@@ -535,3 +535,130 @@ def _count_sketch(attrs, data, h, s):
     contrib = data * sign[None, :]
     out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
     return out.at[..., idx].add(contrib)
+
+
+@register("Correlation")
+def _correlation(attrs, data1, data2):
+    """Patch cross-correlation (reference ``src/operator/correlation.cc``,
+    the FlowNet op): for each displacement within max_displacement,
+    correlate kernel_size x kernel_size patches of data1 with shifted
+    patches of data2, normalized by patch volume.  Output
+    (N, D*D, H, W) with D = 2*floor(max_displacement/stride2)+1."""
+    k = int(attrs.get("kernel_size", 1))
+    max_d = int(attrs.get("max_displacement", 1))
+    stride1 = int(attrs.get("stride1", 1))
+    stride2 = int(attrs.get("stride2", 1))
+    pad = int(attrs.get("pad_size", 0))
+    is_multiply = bool(attrs.get("is_multiply", True))
+    n, c, h, w = data1.shape
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    grid = max_d // stride2
+    disps = [(dy * stride2, dx * stride2)
+             for dy in range(-grid, grid + 1)
+             for dx in range(-grid, grid + 1)]
+    bound = max_d + k // 2
+    # per-displacement: elementwise product (or abs-diff) averaged over
+    # channels, then averaged over the kernel window
+    window = (1, 1, k, k)
+    import numpy as _onp
+
+    maps = []
+    for dy, dx in disps:
+        shifted = jnp.roll(d2, shift=(-dy, -dx), axis=(2, 3))
+        if is_multiply:
+            prod = (d1 * shifted).mean(axis=1, keepdims=True)
+        else:
+            prod = jnp.abs(d1 - shifted).mean(axis=1, keepdims=True)
+        summed = lax.reduce_window(
+            prod, _onp.array(0, prod.dtype), lax.add, window,
+            (1, 1, 1, 1),
+            ((0, 0), (0, 0), (k // 2, k // 2), (k // 2, k // 2)))
+        maps.append(summed / (k * k))
+    out = jnp.concatenate(maps, axis=1)
+    # crop the padded border so displaced reads never leave the map
+    lo = bound
+    out = out[:, :, lo:lo + h + 2 * pad - 2 * bound:stride1,
+              lo:lo + w + 2 * pad - 2 * bound:stride1]
+    return out
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",))
+def _deformable_psroi_pooling(attrs, data, rois, *trans):
+    """Deformable PSROI pooling (reference
+    ``deformable_psroi_pooling.cc``): PSROIPooling whose (iy, ix) cell
+    samples at a learned normalized offset.  ``trans`` (N_roi, 2*g*g,
+    ...) gives per-cell (dy, dx) in units of the ROI size; absent or
+    ``no_trans`` -> plain position-sensitive pooling on a sample grid."""
+    spatial_scale = float(attrs["spatial_scale"])
+    output_dim = int(attrs["output_dim"])
+    pooled = int(attrs.get("pooled_size", attrs.get("group_size", 7)))
+    group = int(attrs.get("group_size", pooled))
+    sample = int(attrs.get("sample_per_part", 2))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    no_trans = bool(attrs.get("no_trans", False)) or not trans
+    n, c, h, w = data.shape
+    if c != output_dim * group * group:
+        raise MXNetError("DeformablePSROIPooling: data channels %d != "
+                         "output_dim*group_size^2" % c)
+
+    def bilinear(img, y, x):
+        y0 = jnp.floor(y); x0 = jnp.floor(x)
+        wy = y - y0; wx = x - x0
+
+        def tap(yy, xx):
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            return jnp.where(ok, img[yi, xi], 0.0)
+
+        return (tap(y0, x0) * (1 - wy) * (1 - wx) +
+                tap(y0, x0 + 1) * (1 - wy) * wx +
+                tap(y0 + 1, x0) * wy * (1 - wx) +
+                tap(y0 + 1, x0 + 1) * wy * wx)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale - x1, 0.1)
+        rh = jnp.maximum(roi[4] * spatial_scale - y1, 0.1)
+        img = data[bidx].reshape(output_dim, group * group, h, w)
+
+        def cell(iy, ix):
+            gh = iy * group // pooled
+            gw = ix * group // pooled
+            gidx = gh * group + gw
+            if no_trans:
+                off_y = 0.0
+                off_x = 0.0
+            else:
+                off_y = tr[(gh * group + gw) * 2] * trans_std * rh
+                off_x = tr[(gh * group + gw) * 2 + 1] * trans_std * rw
+            bh = rh / pooled
+            bw = rw / pooled
+            ss = jnp.arange(sample, dtype=jnp.float32) + 0.5
+            ys = y1 + iy * bh + off_y + ss[:, None] * (bh / sample)
+            xs = x1 + ix * bw + off_x + ss[None, :] * (bw / sample)
+            ys = jnp.broadcast_to(ys, (sample, sample))
+            xs = jnp.broadcast_to(xs, (sample, sample))
+            plane = img[:, gidx]
+
+            def per_dim(pl):
+                return jax.vmap(jax.vmap(lambda y, x: bilinear(pl, y, x)))(
+                    ys, xs).mean()
+
+            return jax.vmap(per_dim)(plane)
+
+        iy, ix = jnp.meshgrid(jnp.arange(pooled), jnp.arange(pooled),
+                              indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(iy, ix)
+        return jnp.moveaxis(cells, -1, 0)
+
+    if no_trans:
+        tr_arr = jnp.zeros((rois.shape[0], 2 * group * group),
+                           jnp.float32)
+    else:
+        tr_arr = trans[0].reshape(rois.shape[0], -1)
+    return jax.vmap(one_roi)(rois, tr_arr)

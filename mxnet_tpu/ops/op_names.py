@@ -50,6 +50,8 @@ INPUT_NAMES = {
     "PSROIPooling": (("data", "rois"), ()),
     "DeformableConvolution": (("data", "offset", "weight", "bias"), ()),
     "CTCLoss": (("data", "label"), ()),
+    "Correlation": (("data1", "data2"), ()),
+    "DeformablePSROIPooling": (("data", "rois", "trans"), ()),
     "quantize": (("data", "min_range", "max_range"), ()),
     "dequantize": (("data", "min_range", "max_range"), ()),
     "count_sketch": (("data", "h", "s"), ()),
@@ -57,8 +59,8 @@ INPUT_NAMES = {
 # contrib ops answer under both their legacy and _contrib_ names
 _CONTRIB = ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "Proposal", "MultiProposal", "PSROIPooling",
-            "DeformableConvolution", "CTCLoss", "quantize", "dequantize",
-            "count_sketch")
+            "DeformableConvolution", "DeformablePSROIPooling", "CTCLoss",
+            "quantize", "dequantize", "count_sketch")
 for _name in _CONTRIB:
     if _name in INPUT_NAMES:
         INPUT_NAMES["_contrib_" + _name] = INPUT_NAMES[_name]

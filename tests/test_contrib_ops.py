@@ -273,3 +273,43 @@ def test_ctc_loss_symbol_input_names():
     sym = mx.sym.ctc_loss(mx.sym.Variable("data"),
                           mx.sym.Variable("label"))
     assert set(sym.list_arguments()) == {"data", "label"}
+
+
+def test_correlation_self_zero_displacement():
+    """Correlation of x with itself at displacement 0 equals the
+    channel-mean of x^2 (kernel 1, no pad beyond bound)."""
+    rs = np.random.RandomState(6)
+    x = rs.randn(1, 4, 8, 8).astype("float32")
+    out = imperative_invoke(
+        "Correlation", [mx.nd.array(x), mx.nd.array(x)],
+        {"kernel_size": 1, "max_displacement": 1, "pad_size": 1}
+    )[0].asnumpy()
+    # D = 3 -> 9 displacement maps; the center map (index 4) is dy=dx=0
+    assert out.shape[1] == 9
+    center = out[0, 4]
+    ref = (x[0] ** 2).mean(axis=0)
+    np.testing.assert_allclose(center, ref[:center.shape[0],
+                                           :center.shape[1]],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_zero_trans_close_to_psroi():
+    """Zero offsets ~= plain PSROIPooling (sampled average vs masked
+    average differ only by sampling scheme)."""
+    rs = np.random.RandomState(7)
+    # constant planes make both pooling schemes exact
+    data = np.zeros((1, 8, 8, 8), "float32")
+    for ch in range(8):
+        data[0, ch] = ch
+    rois = np.array([[0, 0, 0, 7, 7]], "float32")
+    trans = np.zeros((1, 8), "float32")
+    out_d = imperative_invoke(
+        "DeformablePSROIPooling",
+        [mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans)],
+        {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+         "group_size": 2})[0].asnumpy()
+    out_p = imperative_invoke(
+        "PSROIPooling", [mx.nd.array(data), mx.nd.array(rois)],
+        {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+         "group_size": 2})[0].asnumpy()
+    np.testing.assert_allclose(out_d, out_p, rtol=1e-5, atol=1e-5)

@@ -197,3 +197,47 @@ def test_initializers():
     arr = nd.zeros((4,))
     mx.initializer.Xavier()(mx.initializer.InitDesc("bn_gamma"), arr)
     np.testing.assert_allclose(arr.asnumpy(), 1)
+
+
+def test_update_on_kvstore_env_override():
+    """MXNET_UPDATE_ON_KVSTORE=0 (reference env_var.md) moves the update
+    to the worker-side updater; training result is unchanged."""
+    import os
+
+    import numpy as np
+
+    def run():
+        np.random.seed(11)
+        rs = np.random.RandomState(0)
+        X = rs.randn(48, 6).astype("float32")
+        y = (rs.rand(48) * 3).astype("float32")
+        it = mx.io.NDArrayIter(X, y, batch_size=16)
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                  name="fc"), name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        os.environ["MXNET_FUSED_STEP"] = "0"  # exercise the split path
+        try:
+            mod.fit(it, num_epoch=2, kvstore="dist_tpu_sync",
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.1,
+                                      "momentum": 0.9},
+                    initializer=mx.init.Xavier())
+        finally:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        params, _ = mod.get_params()
+        return mod, {k: v.asnumpy() for k, v in params.items()}
+
+    mod_on, p_on = run()
+    assert mod_on._update_on_kvstore
+
+    os.environ["MXNET_UPDATE_ON_KVSTORE"] = "0"
+    try:
+        mod_off, p_off = run()
+    finally:
+        os.environ.pop("MXNET_UPDATE_ON_KVSTORE", None)
+    assert not mod_off._update_on_kvstore
+    assert mod_off._updater is not None
+    for k in p_on:
+        np.testing.assert_allclose(p_off[k], p_on[k], rtol=1e-5,
+                                   atol=1e-6)

@@ -278,6 +278,14 @@ def _build_specs():
          "no_bias": True})
     s["CTCLoss"] = s["ctc_loss"] = (
         [_f(5, 2, 4), np.array([[1, 2], [3, 0]], "float32")], {})
+    s["Correlation"] = ([_f(1, 3, 8, 8), _f(1, 3, 8, 8)],
+                        {"kernel_size": 1, "max_displacement": 2,
+                         "pad_size": 2})
+    s["DeformablePSROIPooling"] = (
+        [_f(1, 8, 8, 8), np.array([[0, 0, 0, 6, 6]], "float32"),
+         _f(1, 8) * 0.1],
+        {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+         "group_size": 2})
     s["fft"] = ([_f(2, 8)], {})
     s["ifft"] = ([_f(2, 16)], {})
     s["quantize"] = ([_f(3, 4), np.array([-2.0], "float32"),
@@ -291,8 +299,9 @@ def _build_specs():
         {"out_dim": 4})
     for _n in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
                "Proposal", "MultiProposal", "PSROIPooling",
-               "DeformableConvolution", "CTCLoss", "fft", "ifft",
-               "quantize", "dequantize", "count_sketch"):
+               "DeformableConvolution", "DeformablePSROIPooling",
+               "CTCLoss", "fft", "ifft", "quantize", "dequantize",
+               "count_sketch"):
         s["_contrib_" + _n] = s[_n]
 
     # -- optimizer updates -------------------------------------------------
