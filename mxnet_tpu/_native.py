@@ -1,0 +1,92 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime around the compute path is C++ (engine, storage,
+IO — SURVEY.md §2.1); on TPU the engine/storage layers are PJRT/XLA, and
+the native layer that remains worthwhile is host-side IO.  This module
+compiles ``src/*.cc`` with the system ``g++`` on first use (no pybind11
+in this image; the ABI is plain C for ctypes) and caches the shared
+object under ``mxnet_tpu/_build/``.
+
+Degrades gracefully: if no compiler is available the callers fall back
+to their pure-Python paths (``native_recordio() is None``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_LIB = {}
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+
+
+def _load(name):
+    """Compile (if stale) and dlopen src/<name>.cc; returns CDLL or
+    None."""
+    with _LOCK:
+        if name in _LIB:
+            return _LIB[name]
+        src = os.path.join(_SRC_DIR, name + ".cc")
+        so = os.path.join(_BUILD_DIR, name + ".so")
+        lib = None
+        try:
+            if os.path.exists(src):
+                if not os.path.exists(so) or \
+                        os.path.getmtime(so) < os.path.getmtime(src):
+                    os.makedirs(_BUILD_DIR, exist_ok=True)
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                         "-o", so, src],
+                        check=True, capture_output=True, timeout=120)
+                lib = ctypes.CDLL(so)
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        _LIB[name] = lib
+        return lib
+
+
+def native_recordio():
+    """The recordio scanner library, or None (pure-Python fallback)."""
+    lib = _load("recordio")
+    if lib is None:
+        return None
+    if not getattr(lib, "_rio_configured", False):
+        lib.rio_scan.restype = ctypes.c_long
+        lib.rio_scan.argtypes = [ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.POINTER(ctypes.c_uint32),
+                                 ctypes.c_long]
+        lib.rio_count.restype = ctypes.c_long
+        lib.rio_count.argtypes = [ctypes.c_char_p]
+        lib._rio_configured = True
+    return lib
+
+
+def scan_recordio(path):
+    """Index a .rec file natively: returns (offsets list, lengths list)
+    or None when the native library is unavailable.  Raises on corrupt
+    files (negative return codes from the scanner)."""
+    from .base import MXNetError
+
+    lib = native_recordio()
+    if lib is None:
+        return None
+    n = lib.rio_count(path.encode())
+    if n < 0:
+        raise MXNetError("native recordio scan failed on %s (code %d: "
+                         "%s)" % (path, n,
+                                  {-1: "cannot open", -2: "bad magic",
+                                   -3: "truncated",
+                                   -4: "bad split framing"}.get(n, "?")))
+    offsets = (ctypes.c_uint64 * max(n, 1))()
+    lengths = (ctypes.c_uint32 * max(n, 1))()
+    n2 = lib.rio_scan(path.encode(), offsets, lengths, n)
+    if n2 != n:
+        raise MXNetError("native recordio rescan mismatch on %s" % path)
+    return list(offsets[:n]), list(lengths[:n])

@@ -393,14 +393,14 @@ class ImageIter(DataIter):
         self.record = None
         self.imglist = None
         if path_imgrec:
+            # a missing .idx sidecar is rebuilt by the native frame
+            # scanner inside MXIndexedRecordIO.open
             idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
-            if not os.path.exists(idx_path):
-                raise MXNetError(
-                    "ImageIter needs the .idx sidecar for %s (pack with "
-                    "tools/im2rec.py or MXIndexedRecordIO)" % path_imgrec)
             self.record = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
                                                     "r")
             keys = list(self.record.keys)
+            if not keys:
+                raise MXNetError("no records found in %s" % path_imgrec)
         elif path_imglist or imglist is not None:
             if path_imglist:
                 imglist = []

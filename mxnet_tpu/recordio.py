@@ -172,6 +172,35 @@ class MXIndexedRecordIO(MXRecordIO):
                         key = self.key_type(parts[0])
                         self.idx[key] = int(parts[1])
                         self.keys.append(key)
+            else:
+                # no sidecar: index the frames with the native C++
+                # scanner (src/recordio.cc); keys become 0..n-1 — the
+                # im2rec convention.  Pure-Python fallback scans via
+                # sequential read().
+                self._build_index_by_scan()
+
+    def _build_index_by_scan(self):
+        from ._native import scan_recordio
+
+        scanned = scan_recordio(self.uri)
+        if scanned is not None:
+            offsets, _lengths = scanned
+            for i, off in enumerate(offsets):
+                key = self.key_type(i)
+                self.idx[key] = off
+                self.keys.append(key)
+            return
+        # fallback: one sequential pass with the Python reader
+        i = 0
+        while True:
+            pos = self._fp.tell()
+            if self.read() is None:
+                break
+            key = self.key_type(i)
+            self.idx[key] = pos
+            self.keys.append(key)
+            i += 1
+        self.reset()
 
     def close(self):
         if self.is_open:

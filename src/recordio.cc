@@ -1,0 +1,124 @@
+// Native RecordIO frame scanner.
+//
+// The TPU build's counterpart of the reference's dmlc-core recordio C++
+// layer (SURVEY.md §2.1 "Data IO": dmlc::RecordIOReader/Writer used by
+// src/io/iter_image_recordio_2.cc).  The Python recordio.py owns the
+// pack/unpack logic; this native module does the scan-heavy work:
+// walking a .rec file's framing (magic / cflag+length words, 4-byte
+// padding, split-record reassembly) to produce the offset/length index
+// in one buffered pass — what the reference gets from the .idx sidecar
+// or a C++ scan, and what lets MXIndexedRecordIO open a .rec with a
+// missing sidecar.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image):
+//   rio_scan(path, offsets, lengths, capacity) -> n_records | -errcode
+//   rio_count(path)                            -> n_records | -errcode
+// offsets[i] is the file offset of record i's first frame header;
+// lengths[i] is the LOGICAL payload length (split records summed).
+//
+// Build: g++ -O2 -shared -fPIC (driven by mxnet_tpu/_native.py).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+constexpr long kErrOpen = -1;
+constexpr long kErrMagic = -2;
+constexpr long kErrTruncated = -3;
+constexpr long kErrSplit = -4;
+
+struct Frame {
+  uint32_t cflag;
+  uint32_t length;
+};
+
+// Reads one frame header; returns 0 on success, 1 on clean EOF,
+// negative error otherwise.  Leaves the file positioned after the
+// padded payload.
+long next_frame(std::FILE* f, Frame* out) {
+  uint32_t head[2];
+  size_t got = std::fread(head, sizeof(uint32_t), 2, f);
+  if (got == 0) return 1;  // clean EOF
+  if (got != 2) return kErrTruncated;
+  if (head[0] != kMagic) return kErrMagic;
+  out->cflag = head[1] >> 29;
+  out->length = head[1] & ((1u << 29) - 1);
+  uint32_t padded = (out->length + 3u) & ~3u;
+  if (std::fseek(f, static_cast<long>(padded), SEEK_CUR) != 0)
+    return kErrTruncated;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scans the file, filling offsets/lengths up to `capacity` logical
+// records.  Returns the TOTAL number of logical records in the file
+// (which may exceed capacity — call rio_count first or retry with a
+// bigger buffer), or a negative error code.
+long rio_scan(const char* path, uint64_t* offsets, uint32_t* lengths,
+              long capacity) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return kErrOpen;
+  long n = 0;
+  bool in_split = false;
+  uint64_t split_offset = 0;
+  uint64_t split_length = 0;
+  for (;;) {
+    long offset = std::ftell(f);
+    Frame frame;
+    long rc = next_frame(f, &frame);
+    if (rc == 1) break;
+    if (rc < 0) {
+      std::fclose(f);
+      return rc;
+    }
+    switch (frame.cflag) {
+      case 0:  // complete record
+        if (in_split) { std::fclose(f); return kErrSplit; }
+        if (n < capacity && offsets != nullptr) {
+          offsets[n] = static_cast<uint64_t>(offset);
+          lengths[n] = frame.length;
+        }
+        ++n;
+        break;
+      case 1:  // split start
+        if (in_split) { std::fclose(f); return kErrSplit; }
+        in_split = true;
+        split_offset = static_cast<uint64_t>(offset);
+        split_length = frame.length;
+        break;
+      case 2:  // split middle
+        if (!in_split) { std::fclose(f); return kErrSplit; }
+        split_length += frame.length;
+        break;
+      case 3:  // split end
+        if (!in_split) { std::fclose(f); return kErrSplit; }
+        split_length += frame.length;
+        if (n < capacity && offsets != nullptr) {
+          offsets[n] = split_offset;
+          lengths[n] = static_cast<uint32_t>(split_length);
+        }
+        ++n;
+        in_split = false;
+        break;
+      default:
+        std::fclose(f);
+        return kErrSplit;
+    }
+  }
+  std::fclose(f);
+  if (in_split) return kErrTruncated;
+  return n;
+}
+
+long rio_count(const char* path) {
+  return rio_scan(path, nullptr, nullptr, 0);
+}
+
+}  // extern "C"

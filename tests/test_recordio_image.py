@@ -175,3 +175,72 @@ def test_im2rec_tool(tmp_path):
     for b in it:
         labels.update(b.label[0].asnumpy().tolist())
     assert labels == {0.0, 1.0}
+
+
+def test_native_scanner_matches_python_index(tmp_path):
+    """The C++ frame scanner (src/recordio.cc) reproduces the .idx
+    offsets exactly and counts split records as one."""
+    from mxnet_tpu._native import scan_recordio
+
+    path = str(tmp_path / "n.rec")
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "n.idx"), path, "w")
+    expected = []
+    for i in range(25):
+        payload = bytes([i]) * (i * 7 + 1)
+        rec.write_idx(i, payload)
+        expected.append(payload)
+    rec.close()
+
+    scanned = scan_recordio(path)
+    assert scanned is not None, "native build unavailable"
+    offsets, lengths = scanned
+    with open(str(tmp_path / "n.idx")) as f:
+        idx_offsets = [int(l.split("\t")[1]) for l in f if l.strip()]
+    assert offsets == idx_offsets
+    assert lengths == [len(p) for p in expected]
+
+
+def test_indexed_recordio_without_sidecar(tmp_path):
+    """Opening a .rec with a MISSING .idx builds the index by scanning
+    (native, Python fallback) — random access still works."""
+    import os
+
+    path = str(tmp_path / "m.rec")
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "m.idx"), path, "w")
+    for i in range(10):
+        rec.write_idx(i, b"payload-%d" % i)
+    rec.close()
+    os.remove(str(tmp_path / "m.idx"))
+
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "ghost.idx"), path,
+                                     "r")
+    assert rec.keys == list(range(10))
+    assert rec.read_idx(7) == b"payload-7"
+    assert rec.read_idx(0) == b"payload-0"
+    rec.close()
+
+
+def test_image_iter_without_sidecar(tmp_path):
+    import os
+
+    from mxnet_tpu.image import ImageIter
+
+    prefix = _make_rec(tmp_path, n=12, hw=8, classes=2)
+    os.remove(prefix + ".idx")
+    it = ImageIter(4, (3, 8, 8), path_imgrec=prefix + ".rec")
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 8, 8)
+
+
+def test_native_scanner_detects_corruption(tmp_path):
+    from mxnet_tpu._native import scan_recordio
+
+    from mxnet_tpu._native import native_recordio
+
+    if native_recordio() is None:
+        pytest.skip("no native build")
+    path = str(tmp_path / "bad.rec")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 16)
+    with pytest.raises(mx.base.MXNetError):
+        scan_recordio(path)
