@@ -73,11 +73,11 @@ def _measure(step, shapes, batch, iters=20):
     # warmup/compile; completion is forced with a host fetch because
     # block_until_ready does not synchronize through the axon tunnel
     params, aux, states, out = step(params, aux, states, batch_dict, rng)
-    float(np.asarray(out[0, 0]))
+    float(np.asarray(out[0][0, 0]))
     t0 = time.perf_counter()
     for _ in range(iters):
         params, aux, states, out = step(params, aux, states, batch_dict, rng)
-    float(np.asarray(out[0, 0]))  # forces the whole dependency chain
+    float(np.asarray(out[0][0, 0]))  # forces the whole dependency chain
     return batch * iters / (time.perf_counter() - t0), xla_flops
 
 

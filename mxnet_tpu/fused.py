@@ -159,7 +159,9 @@ class TrainStep:
                     params[k], g, states[k],
                     lr * lr_mults[k], base_wd * wd_mults[k], t,
                     jax.random.fold_in(rng, i + 1))
-            return new_params, new_aux, new_states, outs[0]
+            # all outputs come back (multi-loss symbols run fused too);
+            # a batch-sharded prefix sharding covers the whole tuple
+            return new_params, new_aux, new_states, outs
 
         self._step_fn = step
         self._batch_sharding_axis = batch_sharding_axis

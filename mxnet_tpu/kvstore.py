@@ -117,16 +117,24 @@ class KVStore:
                     import jax
 
                     if jax.process_count() > 1:
-                        # multi-host: densify, reduce over DCN, re-sparsify
-                        # (ragged per-host nnz cannot ride the dense
-                        # allgather directly)
-                        from .ndarray.sparse import cast_storage
+                        from .ndarray.sparse import (RowSparseNDArray,
+                                                     cast_storage)
 
-                        stype = merged.stype
-                        dense = self._cross_replica_sum(
-                            merged.todense(),
-                            is_partial_stack=is_partial_stack)
-                        merged = cast_storage(dense, stype)
+                        if isinstance(merged, RowSparseNDArray):
+                            # stays sparse on the wire: padded-nnz
+                            # allgather + sparse merge (the bandwidth
+                            # win row_sparse exists for; reference
+                            # kvstore_dist.h:346-385)
+                            from .parallel.collectives import \
+                                allreduce_row_sparse
+
+                            merged = allreduce_row_sparse(merged)
+                        else:  # CSR: densify (no CSR wire format yet)
+                            stype = merged.stype
+                            dense = self._cross_replica_sum(
+                                merged.todense(),
+                                is_partial_stack=is_partial_stack)
+                            merged = cast_storage(dense, stype)
                 else:
                     merged = self._cross_replica_sum(
                         merged, is_partial_stack=is_partial_stack)

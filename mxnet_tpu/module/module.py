@@ -377,13 +377,13 @@ class Module(BaseModule):
         for i in range(len(self._param_names)):
             o._update_count(i)
         t = o.num_update
-        new_params, new_aux, self._fused_states, out = self._fused(
+        new_params, new_aux, self._fused_states, outs = self._fused(
             params, aux, self._fused_states, batch, _rnd.next_key(), lr, t)
         for n, v in new_params.items():
             self._exec.arg_dict[n]._set_data(v)
         for n, v in new_aux.items():
             self._exec.aux_dict[n]._set_data(v)
-        self._exec.outputs = [NDArray(out, self._context[0])]
+        self._exec.outputs = [NDArray(o, self._context[0]) for o in outs]
         self._fused_ran = True
 
     # -- compute --------------------------------------------------------
@@ -411,7 +411,6 @@ class Module(BaseModule):
 
     def forward_backward(self, data_batch):
         if getattr(self, "_fused", None) is not None and \
-                len(self._symbol.list_outputs()) == 1 and \
                 self._exec._monitor_callback is None:
             # an installed Monitor needs the per-node executor path; the
             # fused one-program step has no node boundaries to observe

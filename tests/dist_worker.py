@@ -61,6 +61,11 @@ def main():
     vals = np.full((2, 2), float(rank + 1), "float32")
     rsp = sp.row_sparse_array((vals, rows), shape=(6, 2))
     kv.push("emb", rsp)
+    # the merged value stayed SPARSE across the DCN reduce (no densify —
+    # the bandwidth property row_sparse exists for)
+    assert isinstance(kv._merged["emb"], sp.RowSparseNDArray), \
+        type(kv._merged["emb"])
+    assert kv._merged["emb"]._indices.shape[0] <= 4  # <= sum of nnz
     dense = mx.nd.zeros((6, 2))
     kv.pull("emb", out=dense)
     expect_emb = np.zeros((6, 2), "float32")

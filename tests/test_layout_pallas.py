@@ -82,6 +82,7 @@ def test_resnet_nhwc_symbol_binds_and_trains():
     bd = {"data": jax.random.normal(rng, shapes["data"], "float32"),
           "softmax_label": jnp.zeros((4,), "float32")}
     p2, a2, s2, out = step(p, a, s, bd, rng)
+    out = out[0]
     assert out.shape == (4, 4)
     assert np.isfinite(np.asarray(out)).all()
 
