@@ -8,6 +8,8 @@ XLA computation, distribution is jax.sharding meshes + XLA collectives over
 ICI/DCN, and Gluon-style blocks hybridize into jitted programs.
 """
 from . import base
+from . import attribute
+from .attribute import AttrScope
 from .base import MXNetError
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context

@@ -571,3 +571,158 @@ def get_model(name, **kwargs):
         raise MXNetError("model %r is not in the zoo (known: %s)"
                          % (name, sorted(_MODELS)))
     return _MODELS[name](**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Inception V3 (reference inception.py; input 299x299)
+# ---------------------------------------------------------------------------
+
+def _make_basic_conv(**kwargs):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential(prefix="")
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for channels, kernel_size, strides, padding in conv_settings:
+        out.add(_make_basic_conv(channels=channels,
+                                 kernel_size=kernel_size,
+                                 strides=strides, padding=padding))
+    return out
+
+
+class _InceptionBlock(HybridBlock):
+    """Concat of parallel branches (the A/B/C/D/E blocks share this
+    shape; branch settings differ)."""
+
+    def __init__(self, branches, **kwargs):
+        super().__init__(**kwargs)
+        self.branches = branches
+        for i, b in enumerate(branches):
+            self.register_child(b, "b%d" % i)
+
+    def hybrid_forward(self, F, x):
+        return F.Concat(*[b(x) for b in self.branches], dim=1)
+
+
+def _make_A(pool_features):
+    return _InceptionBlock([
+        _make_branch(None, (64, 1, 1, 0)),
+        _make_branch(None, (48, 1, 1, 0), (64, 5, 1, 2)),
+        _make_branch(None, (64, 1, 1, 0), (96, 3, 1, 1),
+                     (96, 3, 1, 1)),
+        _make_branch("avg", (pool_features, 1, 1, 0))])
+
+
+def _make_B():
+    return _InceptionBlock([
+        _make_branch(None, (384, 3, 2, 0)),
+        _make_branch(None, (64, 1, 1, 0), (96, 3, 1, 1),
+                     (96, 3, 2, 0)),
+        _make_branch("max")])
+
+
+def _make_C(channels_7x7):
+    return _InceptionBlock([
+        _make_branch(None, (192, 1, 1, 0)),
+        _make_branch(None, (channels_7x7, 1, 1, 0),
+                     (channels_7x7, (1, 7), 1, (0, 3)),
+                     (192, (7, 1), 1, (3, 0))),
+        _make_branch(None, (channels_7x7, 1, 1, 0),
+                     (channels_7x7, (7, 1), 1, (3, 0)),
+                     (channels_7x7, (1, 7), 1, (0, 3)),
+                     (channels_7x7, (7, 1), 1, (3, 0)),
+                     (192, (1, 7), 1, (0, 3))),
+        _make_branch("avg", (192, 1, 1, 0))])
+
+
+def _make_D():
+    return _InceptionBlock([
+        _make_branch(None, (192, 1, 1, 0), (320, 3, 2, 0)),
+        _make_branch(None, (192, 1, 1, 0), (192, (1, 7), 1, (0, 3)),
+                     (192, (7, 1), 1, (3, 0)), (192, 3, 2, 0)),
+        _make_branch("max")])
+
+
+class _InceptionE(HybridBlock):
+    """The E block's 3x3 branches split into parallel 1x3/3x1 halves."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.b0 = _make_branch(None, (320, 1, 1, 0))
+        self.b1_stem = _make_basic_conv(channels=384, kernel_size=1,
+                                        strides=1, padding=0)
+        self.b1_a = _make_basic_conv(channels=384, kernel_size=(1, 3),
+                                     strides=1, padding=(0, 1))
+        self.b1_b = _make_basic_conv(channels=384, kernel_size=(3, 1),
+                                     strides=1, padding=(1, 0))
+        self.b2_stem = nn.HybridSequential(prefix="")
+        self.b2_stem.add(_make_basic_conv(channels=448, kernel_size=1,
+                                          strides=1, padding=0))
+        self.b2_stem.add(_make_basic_conv(channels=384, kernel_size=3,
+                                          strides=1, padding=1))
+        self.b2_a = _make_basic_conv(channels=384, kernel_size=(1, 3),
+                                     strides=1, padding=(0, 1))
+        self.b2_b = _make_basic_conv(channels=384, kernel_size=(3, 1),
+                                     strides=1, padding=(1, 0))
+        self.b3 = _make_branch("avg", (192, 1, 1, 0))
+
+    def hybrid_forward(self, F, x):
+        s1 = self.b1_stem(x)
+        s2 = self.b2_stem(x)
+        return F.Concat(self.b0(x), self.b1_a(s1), self.b1_b(s1),
+                        self.b2_a(s2), self.b2_b(s2), self.b3(x), dim=1)
+
+
+class Inception3(HybridBlock):
+    """Inception V3 (reference ``Inception3``; Szegedy et al. 2015)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                           strides=2, padding=0))
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                           strides=1, padding=0))
+        self.features.add(_make_basic_conv(channels=64, kernel_size=3,
+                                           strides=1, padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_basic_conv(channels=80, kernel_size=1,
+                                           strides=1, padding=0))
+        self.features.add(_make_basic_conv(channels=192, kernel_size=3,
+                                           strides=1, padding=0))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_InceptionE())
+        self.features.add(_InceptionE())
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Dropout(0.5))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return Inception3(**kwargs)
+
+
+_MODELS["inceptionv3"] = inception_v3
+__all__.append("inception_v3")

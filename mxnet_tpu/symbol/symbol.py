@@ -489,9 +489,18 @@ def _fill_param_shapes(node, env, shapes):
 
 def _apply(op_name, input_syms, attrs, name=None):
     """Compose an op over symbols (the reference's atomic-symbol
-    CreateAtomicSymbol + Compose C API path)."""
+    CreateAtomicSymbol + Compose C API path).  Active ``AttrScope``
+    attributes apply under explicit ones (reference AttrScope.get)."""
+    from ..attribute import current as _scope_attrs
+
     op = _registry.get(op_name)
-    attrs = dict(attrs)
+    scoped = _scope_attrs()
+    if scoped:
+        merged = dict(scoped)
+        merged.update(attrs)
+        attrs = merged
+    else:
+        attrs = dict(attrs)
     name = name or attrs.pop("name", None) or \
         _auto_name(op_name.lower().lstrip("_"))
     attrs.pop("name", None)

@@ -159,3 +159,15 @@ def test_sequential_module_default_label_names():
     seq.fit(it, num_epoch=2, optimizer="sgd",
             initializer=mx.init.Xavier())
     assert dict(seq.score(it, mx.metric.Accuracy()))["accuracy"] >= 0.2
+
+
+def test_inception_v3_builds_and_runs():
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    net = get_model("inceptionv3", classes=3)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 3, 96, 96)
+                    .astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 3)
+    assert np.isfinite(out.asnumpy()).all()

@@ -166,3 +166,20 @@ def test_infer_type_cast_does_not_backfill_input():
     arg_types, out_types, _ = net.infer_type()
     assert np.dtype(arg_types[0]) == np.dtype("float32")
     assert np.dtype(out_types[0]) == np.dtype("float16")
+
+
+def test_attr_scope_applies_to_symbols():
+    """AttrScope (reference attribute.py: the group2ctx channel) tags
+    symbols built inside the scope; explicit attrs win; scopes nest."""
+    with mx.AttrScope(ctx_group="stage1", __lr_mult__="2.0"):
+        a = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4,
+                                  name="fca")
+        with mx.AttrScope(ctx_group="stage2"):
+            b = mx.sym.FullyConnected(a, num_hidden=4, name="fcb")
+    c = mx.sym.FullyConnected(b, num_hidden=4, name="fcc")
+    attrs = c.attr_dict()
+    assert attrs["fca"]["ctx_group"] == "stage1"
+    assert attrs["fca"]["__lr_mult__"] == "2.0"
+    assert attrs["fcb"]["ctx_group"] == "stage2"   # inner scope wins
+    assert attrs["fcb"]["__lr_mult__"] == "2.0"    # outer still applies
+    assert "ctx_group" not in attrs.get("fcc", {})
