@@ -12,10 +12,15 @@ from . import mesh
 from . import collectives
 from . import sharding
 from . import sequence
+from . import pipeline
+from . import expert
 from .mesh import (create_mesh, current_mesh, set_mesh, mesh_scope,
                    init_distributed)
 from .sequence import ring_attention, sequence_parallel_attention
+from .pipeline import pipeline_apply
+from .expert import moe_ffn
 
 __all__ = ["mesh", "collectives", "sharding", "sequence", "create_mesh",
            "current_mesh", "set_mesh", "mesh_scope", "init_distributed", "ring_attention",
-           "sequence_parallel_attention"]
+           "sequence_parallel_attention", "pipeline", "expert",
+           "pipeline_apply", "moe_ffn"]

@@ -1,0 +1,85 @@
+"""Expert parallelism — mixture-of-experts FFN over the 'expert' axis.
+
+Nothing to port (the reference predates MoE; SURVEY.md §2.3 lists EP as
+a fresh first-class design).  The layout: expert weights are sharded on
+their leading EXPERT axis over the mesh's 'expert' axis, tokens stay
+replicated across it; each device runs only ITS experts over all tokens,
+weighting by the (replicated) gate, and one ``psum`` combines — the
+dense-dispatch MoE form, which is exact for any gating (soft or top-k
+masked) and keeps per-device FFN compute at ``E_local/E`` of the total.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+from .mesh import current_mesh
+
+__all__ = ["moe_ffn"]
+
+
+def moe_ffn(x, gate_w, w1, w2, top_k=None, mesh=None, axis="expert"):
+    """Mixture-of-experts feed-forward.
+
+    ``x`` (B, D) tokens; ``gate_w`` (D, E); ``w1`` (E, D, H);
+    ``w2`` (E, H, D) — w1/w2 sharded over ``axis``.  Gating is softmax
+    over experts, optionally masked to the ``top_k`` largest (weights
+    renormalized), and each expert runs relu(x@w1_e)@w2_e.
+    Returns (B, D), replicated over the expert axis.
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None or axis not in mesh.shape:
+        raise MXNetError("moe_ffn needs a mesh with a %r axis" % axis)
+    n_exp = w1.shape[0]
+    if gate_w.shape[1] != n_exp:
+        raise MXNetError(
+            "gate_w has %d expert columns but w1 has %d experts — a "
+            "mismatch would silently drop/duplicate gate mass"
+            % (gate_w.shape[1], n_exp))
+    if n_exp % mesh.shape[axis] != 0:
+        raise MXNetError("num experts %d not divisible by %s=%d"
+                         % (n_exp, axis, mesh.shape[axis]))
+    return _moe_fn(mesh, axis, top_k)(x, gate_w, w1, w2)
+
+
+@functools.lru_cache(maxsize=32)
+def _moe_fn(mesh, axis, top_k):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[axis]
+
+    def body(x, gate_w, w1, w2):
+        # w1/w2: local expert slices (E_local, D, H) / (E_local, H, D)
+        e_local = w1.shape[0]
+        rank = lax.axis_index(axis)
+        logits = x @ gate_w                       # (B, E) replicated
+        if top_k is not None:
+            kth = lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)   # renormalized over mask
+        # this device's gate columns
+        local_probs = lax.dynamic_slice_in_dim(
+            probs, rank * e_local, e_local, axis=1)  # (B, E_local)
+        h = jnp.einsum("bd,edh->ebh", x, w1)
+        h = jnp.maximum(h, 0.0)
+        y = jnp.einsum("ebh,ehd->ebd", h, w2)     # (E_local, B, D)
+        out = jnp.einsum("ebd,be->bd", y, local_probs)
+        return lax.psum(out, axis)
+
+    try:
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(), P(), P(axis), P(axis)),
+                       out_specs=P(), check_vma=False)
+    except TypeError:
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(), P(), P(axis), P(axis)),
+                       out_specs=P(), check_rep=False)
+    return jax.jit(fn)

@@ -1,0 +1,114 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over the
+'pipe' mesh axis.
+
+The reference's only pipelining is manual ``group2ctx`` staging
+(``example/model-parallel-lstm``, SURVEY.md §2.3 "Model parallelism"):
+layers pinned to devices, activations copied at boundaries, no
+microbatching.  This is the fresh TPU-first design: stage parameters are
+stacked on a leading axis sharded over 'pipe' (each device HOLDS one
+stage), and inside ``shard_map`` a ``lax.fori_loop`` runs the classic
+GPipe schedule — at tick t, stage 0 ingests microbatch t while stage s
+processes the activation ``ppermute``'d from stage s-1, so all stages
+are busy once the pipeline fills (M + S - 1 ticks for M microbatches on
+S stages).  The hop rides ICI between ring neighbors.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+from .mesh import current_mesh
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, mesh=None,
+                   axis="pipe"):
+    """Run ``microbatches`` through a pipeline of stages.
+
+    ``stage_fn(params, x) -> y``: one stage's computation; every stage
+    shares this code (same shapes in = shapes out, the homogeneous-stage
+    form — e.g. a transformer block).
+
+    ``stage_params``: pytree whose leaves have a leading STAGE axis of
+    size ``mesh.shape[axis]``; it is sharded so each device holds its
+    stage's slice.
+
+    ``microbatches``: (M, micro_batch, ...) array; returns the stacked
+    outputs (M, micro_batch, ...), replicated over the pipe axis.
+    """
+    import jax
+
+    mesh = mesh or current_mesh()
+    if mesh is None or axis not in mesh.shape:
+        raise MXNetError("pipeline_apply needs a mesh with a %r axis"
+                         % axis)
+    n_stages = mesh.shape[axis]
+    leaves = jax.tree.leaves(stage_params)
+    for leaf in leaves:
+        if leaf.shape[0] != n_stages:
+            raise MXNetError(
+                "stage_params leading dim %d != pipe axis size %d"
+                % (leaf.shape[0], n_stages))
+    return _pipeline_fn(mesh, axis, stage_fn,
+                        jax.tree.structure(stage_params))(
+        stage_params, microbatches)
+
+
+@functools.lru_cache(maxsize=32)
+def _pipeline_fn(mesh, axis, stage_fn, params_treedef):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_stages = mesh.shape[axis]
+
+    def body(params, micro):
+        # params leaves: (1, ...) local stage slice; micro: (M, mb, ...)
+        local_params = jax.tree.map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        m = micro.shape[0]
+        ticks = m + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        carry0 = jnp.zeros_like(micro[0])   # activation arriving from prev
+        out0 = jnp.zeros_like(micro)
+
+        def tick(t, state):
+            carry, out = state
+            feed = micro[jnp.minimum(t, m - 1)]
+            x = jnp.where(stage == 0, feed, carry)
+            y = stage_fn(local_params, x)
+            # last stage emits microbatch t-(S-1) at tick t
+            emit_idx = t - (n_stages - 1)
+            is_emit = (stage == n_stages - 1) & (emit_idx >= 0)
+            out = lax.cond(
+                is_emit,
+                lambda o: o.at[jnp.maximum(emit_idx, 0)].set(y),
+                lambda o: o, out)
+            carry = lax.ppermute(y, axis, perm)
+            return carry, out
+
+        _, out = lax.fori_loop(0, ticks, tick, (carry0, out0))
+        # outputs live on the last stage; replicate over the pipe axis
+        out = lax.psum(
+            jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)),
+            axis)
+        return out
+
+    pspec = jax.tree.unflatten(
+        params_treedef,
+        [P(axis)] * params_treedef.num_leaves)
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                       out_specs=P(), check_vma=False)
+    except TypeError:
+        fn = shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                       out_specs=P(), check_rep=False)
+    return jax.jit(fn)

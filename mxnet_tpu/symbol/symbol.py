@@ -512,11 +512,15 @@ def _apply(op_name, input_syms, attrs, name=None):
             raise MXNetError("cannot compose multi-output symbol directly")
         inputs.append(s._outputs[0])
     # auto-create missing parameter/aux variables (reference behavior:
-    # conv = sym.Convolution(data) creates convolution0_weight, ...)
+    # conv = sym.Convolution(data) creates convolution0_weight, ...);
+    # they inherit the active AttrScope like explicit Variables, which is
+    # how `with AttrScope(__lr_mult__=...)` reaches the parameters the
+    # optimizer keys multipliers on
     total_wanted = len(arg_names) + len(aux_names)
     if len(inputs) < total_wanted and op_name in _PARAMETRIC_OPS:
         for extra in list(arg_names)[len(inputs):] + list(aux_names):
-            vnode = _Node(None, "%s_%s" % (name, extra), {}, [])
+            vnode = _Node(None, "%s_%s" % (name, extra),
+                          dict(scoped) if scoped else {}, [])
             inputs.append((vnode, 0))
     aux_slots = tuple(range(len(arg_names),
                             len(arg_names) + len(aux_names)))
@@ -535,8 +539,13 @@ _PARAMETRIC_OPS = {
 
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
-    """Create a symbolic variable (reference ``mx.sym.Variable``)."""
-    attrs = dict(attr or {})
+    """Create a symbolic variable (reference ``mx.sym.Variable``);
+    active AttrScope attributes apply under explicit ones (reference
+    ``symbol.var`` applies AttrScope)."""
+    from ..attribute import current as _scope_attrs
+
+    attrs = dict(_scope_attrs())
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if lr_mult is not None:

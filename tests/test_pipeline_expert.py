@@ -1,0 +1,107 @@
+"""Pipeline parallelism (GPipe microbatch schedule over 'pipe') and
+expert parallelism (MoE over 'expert') — both fresh first-class designs
+(SURVEY §2.3: the reference has only manual group2ctx staging and no
+MoE).  Sharded results must equal single-device references exactly."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (create_mesh, mesh_scope, moe_ffn,
+                                pipeline_apply)
+
+
+def _stage_fn(params, x):
+    import jax.numpy as jnp
+
+    w, b = params["w"], params["b"]
+    return jnp.tanh(x @ w + b)
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 4), (4, 8)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    import jax
+
+    rs = np.random.RandomState(0)
+    d, mb = 8, 4
+    params = {"w": rs.randn(n_stages, d, d).astype("float32") * 0.3,
+              "b": rs.randn(n_stages, d).astype("float32") * 0.1}
+    micro = rs.randn(n_micro, mb, d).astype("float32")
+    mesh = create_mesh({"pipe": n_stages},
+                       devices=jax.devices()[:n_stages])
+    with mesh_scope(mesh):
+        out = np.asarray(pipeline_apply(_stage_fn, params, micro))
+
+    ref = micro.astype("float64")
+    for s in range(n_stages):
+        ref = np.tanh(ref @ params["w"][s] + params["b"][s])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_needs_pipe_axis():
+    import jax
+
+    mesh = create_mesh({"data": 8}, devices=jax.devices()[:8])
+    with pytest.raises(mx.base.MXNetError):
+        pipeline_apply(_stage_fn, {"w": np.zeros((2, 4, 4))},
+                       np.zeros((2, 2, 4)), mesh=mesh)
+
+
+def _ref_moe(x, gate_w, w1, w2, top_k):
+    logits = x @ gate_w
+    if top_k is not None:
+        kth = np.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = np.where(logits >= kth, logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    out = np.zeros_like(x)
+    for e in range(w1.shape[0]):
+        h = np.maximum(x @ w1[e], 0)
+        out += p[:, e:e + 1] * (h @ w2[e])
+    return out
+
+
+@pytest.mark.parametrize("top_k", [None, 2])
+@pytest.mark.parametrize("ep", [2, 4])
+def test_moe_matches_reference(top_k, ep):
+    import jax
+
+    rs = np.random.RandomState(1)
+    b, d, h, e = 6, 8, 16, 8
+    x = rs.randn(b, d).astype("float32")
+    gate_w = rs.randn(d, e).astype("float32") * 0.3
+    w1 = rs.randn(e, d, h).astype("float32") * 0.3
+    w2 = rs.randn(e, h, d).astype("float32") * 0.3
+    mesh = create_mesh({"expert": ep}, devices=jax.devices()[:ep])
+    with mesh_scope(mesh):
+        out = np.asarray(moe_ffn(x, gate_w, w1, w2, top_k=top_k))
+    ref = _ref_moe(x.astype("float64"), gate_w, w1, w2, top_k)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_composes_with_data_axis():
+    """data x expert hybrid mesh (tokens sharded on data would need a
+    gather; here tokens replicated, experts sharded — the EP layout)."""
+    import jax
+
+    rs = np.random.RandomState(2)
+    x = rs.randn(4, 4).astype("float32")
+    gate_w = rs.randn(4, 4).astype("float32")
+    w1 = rs.randn(4, 4, 8).astype("float32") * 0.3
+    w2 = rs.randn(4, 8, 4).astype("float32") * 0.3
+    mesh = create_mesh({"data": 2, "expert": 4},
+                       devices=jax.devices()[:8])
+    with mesh_scope(mesh):
+        out = np.asarray(moe_ffn(x, gate_w, w1, w2))
+    ref = _ref_moe(x.astype("float64"), gate_w, w1, w2, None)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_gate_expert_mismatch_raises():
+    import jax
+
+    mesh = create_mesh({"expert": 2}, devices=jax.devices()[:2])
+    x = np.zeros((2, 4), "float32")
+    with pytest.raises(mx.base.MXNetError):
+        moe_ffn(x, np.zeros((4, 16), "float32"),
+                np.zeros((8, 4, 8), "float32"),
+                np.zeros((8, 8, 4), "float32"), mesh=mesh)

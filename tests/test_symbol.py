@@ -183,3 +183,30 @@ def test_attr_scope_applies_to_symbols():
     assert attrs["fcb"]["ctx_group"] == "stage2"   # inner scope wins
     assert attrs["fcb"]["__lr_mult__"] == "2.0"    # outer still applies
     assert "ctx_group" not in attrs.get("fcc", {})
+
+
+def test_attr_scope_reaches_parameters_and_optimizer():
+    """Review regression: AttrScope must land on the auto-created
+    parameter VARIABLES (the names the optimizer keys multipliers on),
+    so `with AttrScope(__lr_mult__='0')` really freezes layers."""
+    from mxnet_tpu import optimizer as opt
+
+    with mx.AttrScope(__lr_mult__="0.0"):
+        frozen = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                       num_hidden=4, name="fc_frozen")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(frozen, num_hidden=2, name="fc_live"),
+        name="softmax")
+    attrs = net.attr_dict()
+    assert attrs["fc_frozen_weight"]["__lr_mult__"] == "0.0"
+    assert "__lr_mult__" not in attrs.get("fc_live_weight", {})
+
+    o = opt.create("sgd", sym=net, learning_rate=0.5)
+    o.set_lr_mult({})
+    assert o.lr_mult.get("fc_frozen_weight") == 0.0
+    assert "fc_live_weight" not in o.lr_mult
+
+    # explicit Variable under a scope also carries the attrs
+    with mx.AttrScope(ctx_group="g7"):
+        v = mx.sym.Variable("vv")
+    assert v.attr_dict()["vv"]["ctx_group"] == "g7"
