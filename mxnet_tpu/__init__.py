@@ -36,6 +36,7 @@ from . import kvstore
 from . import kvstore as kv
 from . import callback
 from . import profiler
+from . import rtc
 from . import visualization
 from . import visualization as viz
 from . import predictor
