@@ -51,5 +51,10 @@ from . import rnn
 from . import parallel
 from . import test_utils
 from .model import save_checkpoint, load_checkpoint
+from . import name
+from . import libinfo
+from . import executor_manager
+from . import kvstore_server
+from . import contrib
 
 __version__ = "0.1.0"

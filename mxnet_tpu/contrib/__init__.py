@@ -1,0 +1,14 @@
+"""Experimental contributions (reference ``python/mxnet/contrib/``).
+
+``mx.contrib.ndarray`` / ``mx.contrib.symbol`` expose the contrib op pack
+(``_contrib_*`` registry entries — SSD MultiBox*, Proposal, deformable ops,
+CTC, fft, quantize, khatri_rao; see ``mxnet_tpu/ops/contrib_ops.py``) under
+their short names, mirroring how the reference filters registry names by
+the ``_contrib_`` prefix at import.
+"""
+from . import ndarray
+from . import symbol
+from . import ndarray as nd
+from . import symbol as sym
+from . import autograd
+from . import tensorboard

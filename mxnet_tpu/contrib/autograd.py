@@ -1,0 +1,83 @@
+"""Old-style contrib autograd API (reference
+``python/mxnet/contrib/autograd.py`` — the pre-Gluon surface:
+``train_section``/``test_section`` scopes, ``mark_variables``,
+``compute_gradient``, and the ``grad``/``grad_and_loss`` decorators).
+Implemented over the main :mod:`mxnet_tpu.autograd` tape.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+from .. import autograd as _ag
+from ..ndarray import NDArray, zeros_like
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Legacy global switch: returns the previous value."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+def train_section():
+    """``with train_section():`` — record with train mode on (reference
+    ``contrib/autograd.py:74``)."""
+    return _ag.record(train_mode=True)
+
+
+def test_section():
+    """``with test_section():`` — pause recording (reference ``:88``)."""
+    return _ag.pause(train_mode=False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias of :func:`backward` (reference ``:166``)."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator: returns ``(gradients, loss)`` of ``func`` w.r.t. its
+    NDArray arguments (reference ``:171``)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        idx = range(len(args)) if argnum is None else (
+            [argnum] if isinstance(argnum, int) else list(argnum))
+        variables = [args[i] for i in idx]
+        for v in variables:
+            if not isinstance(v, NDArray):
+                raise MXNetError("differentiated argument must be NDArray")
+        grads = [zeros_like(v) for v in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            out = func(*args)
+        backward([out] if isinstance(out, NDArray) else out)
+        return grads, out
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorator: returns only the gradients (reference ``:203``)."""
+    g_and_l = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return g_and_l(*args)[0]
+
+    return wrapped

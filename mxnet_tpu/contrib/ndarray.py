@@ -1,0 +1,18 @@
+"""``mx.contrib.nd`` — contrib ops, imperative (reference
+``python/mxnet/contrib/ndarray.py``, generated from the ``_contrib_``
+registry prefix)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from .. import ndarray as _nd
+
+
+def _init():
+    mod = _sys.modules[__name__]
+    for name in dir(_nd):
+        if name.startswith("_contrib_"):
+            setattr(mod, name[len("_contrib_"):], getattr(_nd, name))
+
+
+_init()

@@ -1,0 +1,17 @@
+"""``mx.contrib.sym`` — contrib ops, symbolic (reference
+``python/mxnet/contrib/symbol.py``)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from .. import symbol as _sym
+
+
+def _init():
+    mod = _sys.modules[__name__]
+    for name in dir(_sym):
+        if name.startswith("_contrib_"):
+            setattr(mod, name[len("_contrib_"):], getattr(_sym, name))
+
+
+_init()

@@ -1,0 +1,45 @@
+"""TensorBoard logging callback (reference
+``python/mxnet/contrib/tensorboard.py``).
+
+The reference depends on the external ``tensorboard`` pip package's
+``SummaryWriter``; this build is zero-egress, so the writer is pluggable:
+anything with ``add_scalar(tag, value)`` works (e.g.
+``torch.utils.tensorboard.SummaryWriter``, which IS available in this
+image, or a test double).
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback that logs ``eval_metric`` values
+    (reference ``tensorboard.py:25``)::
+
+        mod.fit(..., batch_end_callback=LogMetricsCallback('logs/train'))
+    """
+
+    def __init__(self, logging_dir, prefix=None, summary_writer=None):
+        self.prefix = prefix
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+        else:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self.summary_writer = SummaryWriter(logging_dir)
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "no SummaryWriter backend available; metrics will be "
+                    "dropped (pass summary_writer= explicitly)")
+                self.summary_writer = None
+
+    def __call__(self, param):
+        if param.eval_metric is None or self.summary_writer is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value)

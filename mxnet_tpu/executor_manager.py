@@ -1,0 +1,155 @@
+"""Legacy multi-device executor manager (reference
+``python/mxnet/executor_manager.py``: ``_split_input_slice`` ``:295`` and
+``DataParallelExecutorManager``, used by the old ``FeedForward`` path).
+
+TPU-native stance: the *modern* data-parallel path is the fused SPMD train
+step (``mxnet_tpu/fused.py``) where the mesh shards the batch and XLA
+inserts the collectives — ``Module``/``FeedForward`` use that.  This module
+keeps the reference's explicit slice-per-context contract working for
+scripts that drive it directly: each context gets an executor over its
+batch slice, gradients are summed across slices host-side (the role of the
+reference's kvstore ``local`` reduction), and parameters are shared.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base import MXNetError
+from .context import cpu
+
+__all__ = ["_split_input_slice", "DataParallelExecutorManager"]
+
+
+def _split_input_slice(batch_size, work_load_list=None):
+    """Split ``batch_size`` into per-device ``slice``s proportional to
+    ``work_load_list`` (reference ``executor_manager.py:12-43``)."""
+    if work_load_list is None:
+        work_load_list = [1]
+    total = sum(work_load_list)
+    if batch_size < len(work_load_list):
+        raise MXNetError("batch size %d cannot cover %d devices"
+                         % (batch_size, len(work_load_list)))
+    slices = []
+    start = 0
+    accum = 0.0
+    for i, w in enumerate(work_load_list):
+        accum += float(w) / total * batch_size
+        end = batch_size if i == len(work_load_list) - 1 \
+            else int(round(accum))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorManager:
+    """Per-context executors over batch slices sharing one parameter set
+    (reference ``executor_manager.py:295``)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx or cpu()]
+        self.logger = logger or logging
+        if work_load_list is None:
+            work_load_list = [1] * len(self.ctx)
+        if len(work_load_list) != len(self.ctx):
+            raise MXNetError("work_load_list must match number of contexts")
+        data_shapes = {d.name: d.shape for d in train_data.provide_data}
+        label_shapes = {d.name: d.shape
+                        for d in (train_data.provide_label or [])}
+        batch_size = next(iter(data_shapes.values()))[0]
+        self.slices = _split_input_slice(batch_size, work_load_list)
+        self.arg_names = arg_names or symbol.list_arguments()
+        self.aux_names = aux_names or symbol.list_auxiliary_states()
+        data_like = set(data_shapes) | set(label_shapes)
+        self.param_names = param_names or [
+            n for n in self.arg_names if n not in data_like]
+        self._data_names = list(data_shapes)
+        self._label_names = list(label_shapes)
+
+        self.execs = []
+        for ctx_i, slc in zip(self.ctx, self.slices):
+            n = slc.stop - slc.start
+            shapes = {k: (n,) + tuple(v[1:]) for k, v in data_shapes.items()}
+            shapes.update({k: (n,) + tuple(v[1:])
+                           for k, v in label_shapes.items()})
+            grad_req = {name: ("write" if name in self.param_names
+                               else "null") for name in self.arg_names}
+            # deliberately NOT shared_exec: each slice keeps its own grad
+            # buffers (the reference reduces them via kvstore); parameters
+            # are aliased to the master's arrays below
+            ex = symbol.simple_bind(ctx=ctx_i, grad_req=grad_req, **shapes)
+            self.execs.append(ex)
+        # parameters are shared: slave executors view the master's arrays
+        master = self.execs[0]
+        for ex in self.execs[1:]:
+            for name in self.param_names:
+                ex.arg_dict[name] = master.arg_dict[name]
+            for name in self.aux_names:
+                ex.aux_dict[name] = master.aux_dict[name]
+        self._monitor = None
+
+    # -- parameter access (reference :364-392) -----------------------------
+    @property
+    def param_arrays(self):
+        return [[self.execs[0].arg_dict[n]] for n in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        return [[ex.grad_dict[n] for ex in self.execs]
+                for n in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[self.execs[0].aux_dict[n]] for n in self.aux_names]
+
+    def set_params(self, arg_params, aux_params):
+        for ex in self.execs[:1]:
+            ex.copy_params_from(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        master = self.execs[0]
+        for name in self.param_names:
+            arg_params[name] = master.arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = master.aux_dict[name].copy()
+
+    def install_monitor(self, monitor):
+        for ex in self.execs:
+            monitor.install(ex)
+
+    # -- the train loop surface (reference :398-430) -----------------------
+    def load_data_batch(self, data_batch):
+        self._cur_batch = data_batch
+
+    def forward(self, is_train=False):
+        data = {n: a for n, a in zip(self._data_names,
+                                     self._cur_batch.data)}
+        labels = {n: a for n, a in zip(self._label_names,
+                                       self._cur_batch.label or [])}
+        for ex, slc in zip(self.execs, self.slices):
+            feeds = {k: v[slc.start:slc.stop] for k, v in data.items()}
+            feeds.update({k: v[slc.start:slc.stop]
+                          for k, v in labels.items()})
+            ex.forward(is_train=is_train, **feeds)
+
+    def backward(self):
+        for ex in self.execs:
+            ex.backward()
+
+    def update_metric(self, metric, labels):
+        for ex, slc in zip(self.execs, self.slices):
+            lab = [l[slc.start:slc.stop] for l in labels]
+            metric.update(lab, ex.outputs)
+
+    @property
+    def outputs(self):
+        from .ndarray import concat
+
+        outs = []
+        for i in range(len(self.execs[0].outputs)):
+            parts = [ex.outputs[i] for ex in self.execs]
+            outs.append(parts[0] if len(parts) == 1 else concat(
+                *parts, dim=0))
+        return outs

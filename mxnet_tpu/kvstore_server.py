@@ -1,0 +1,75 @@
+"""Server-role entry point (reference ``python/mxnet/kvstore_server.py``).
+
+In the reference, processes launched with ``DMLC_ROLE=server`` never return
+from ``import mxnet``: ``_init_kvstore_server_module`` (`kvstore_server.py:75-85`)
+detects the role and blocks in ``KVStoreServer.run`` — a C++ request loop
+(``src/kvstore/kvstore_dist_server.h:139``) that merges worker pushes and
+applies the pickled optimizer.
+
+The TPU design has **no server processes**: ``dist_tpu_sync`` is SPMD — the
+optimizer runs inside every worker's compiled step and the gradient merge is
+an XLA all-reduce over ICI (see ``parallel/collectives.py``).  This module
+keeps launcher compatibility: a process started with the server role simply
+joins the coordination service (so ``jax.distributed`` rendezvous still
+counts it) and exits cleanly, and ``KVStoreServer`` exists so scripts that
+instantiate it don't crash.  ``tools/launch.py`` therefore never needs ``-s``
+servers; it warns if asked for them.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """API-compatible stand-in for the reference server wrapper
+    (``kvstore_server.py:28``)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handle = getattr(kvstore, "handle", None)
+        self.init_logging = False
+
+    def _controller(self):
+        """Reference servers receive pickled optimizers via
+        ``_send_command_to_servers``; under SPMD the optimizer already
+        lives in the worker step, so commands are logged and dropped."""
+
+        def server_controller(cmd_id, cmd_body):
+            if cmd_id == 3:  # kController_SetOptimizer in the reference
+                try:
+                    pickle.loads(cmd_body.encode("latin1"))
+                except Exception:
+                    pass
+            logging.getLogger(__name__).info(
+                "kvstore server command (%d) ignored: SPMD workers own "
+                "the optimizer", cmd_id)
+
+        return server_controller
+
+    def run(self):
+        """Return immediately: there is no server request loop to block in.
+        The reference blocks here forever (``KVStoreDistServer::Run``)."""
+        logging.getLogger(__name__).warning(
+            "KVStoreServer.run(): dist_tpu_sync has no parameter servers; "
+            "returning (role treated as a no-op participant)")
+
+
+def _init_kvstore_server_module():
+    """Role dispatch at import (reference ``kvstore_server.py:75-85``)."""
+    role = os.environ.get("DMLC_ROLE", os.environ.get("MXNET_ROLE", ""))
+    if role == "server":
+        from . import kvstore
+
+        server = KVStoreServer(kvstore.create("dist_tpu_sync"))
+        server.run()
+        raise SystemExit(0)
+    # workers and schedulers fall through to a normal import
+
+
+if os.environ.get("DMLC_ROLE") == "server" and \
+        os.environ.get("MXNET_KVSTORE_SERVER_AUTORUN", "1") == "1":
+    _init_kvstore_server_module()

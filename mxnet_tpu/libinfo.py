@@ -1,0 +1,35 @@
+"""Library metadata and native-library discovery (reference
+``python/mxnet/libinfo.py``: ``find_lib_path`` locates ``libmxnet.so``;
+``__version__`` is read from it).
+
+Here the native component is the RecordIO scanner built from
+``src/recordio.cc`` at first use (see ``mxnet_tpu/_native.py``); everything
+else executes through XLA/PJRT, which jax itself loads.  ``find_lib_path``
+returns the built shared objects so deployment scripts that bundle
+"the native libs" keep working.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+__version__ = "0.1.0"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_lib_path():
+    """Paths of the framework's compiled native libraries (may build them
+    on first call; empty list when no compiler is available)."""
+    from . import _native
+
+    libs = []
+    if _native.native_recordio() is not None:
+        libs.append(os.path.join(_native._BUILD_DIR, "recordio.so"))
+    return libs
+
+
+def find_include_path():
+    """Native sources shipped in place of a C header tree."""
+    return os.path.join(_REPO, "src")

@@ -127,3 +127,57 @@ def add_n(*args):
 
 elemwise_sum = add_n
 ElementWiseSum = add_n
+
+
+# -- host image ops (reference src/io/image_io.cc registers _cvimdecode /
+# _cvimread / _cvimresize / _cvcopyMakeBorder as CPU-only ops; decode is
+# host work by nature, so here they are host functions returning NDArrays
+# rather than jitted registry entries) -------------------------------------
+
+def _cvimdecode(buf, flag=1, to_rgb=1, **kwargs):
+    from .. import image as _image
+
+    return array(_image.imdecode(buf, to_rgb=to_rgb, flag=flag))
+
+
+def _cvimread(filename, flag=1, **kwargs):
+    from .. import image as _image
+
+    return array(_image.imread(filename, flag=flag))
+
+
+def _cvimresize(src, w, h, interp=2, **kwargs):
+    from .. import image as _image
+
+    return array(_image.imresize(_np.asarray(src.asnumpy() if isinstance(
+        src, NDArray) else src), int(w), int(h), int(interp)))
+
+
+def _cvcopyMakeBorder(src, top, bot, left, right, fill_value=0, **kwargs):
+    from .. import image as _image
+
+    return array(_image.copyMakeBorder(_np.asarray(
+        src.asnumpy() if isinstance(src, NDArray) else src),
+        int(top), int(bot), int(left), int(right), fill_value))
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode with the reference's pre-1.0 ``nd.imdecode`` signature
+    (``python/mxnet/ndarray.py``): optional crop rectangle
+    ``(x0, y0, x1, y1)``, channel count, and mean subtraction."""
+    from .. import image as _image
+
+    arr = _image.imdecode(str_img, flag=1 if channels == 3 else 0)
+    x0, y0, x1, y1 = clip_rect
+    if (x0, y0, x1, y1) != (0, 0, 0, 0):
+        arr = arr[y0:y1, x0:x1]
+    arr = arr.astype(_np.float32)
+    if mean is not None:
+        arr = arr - (mean.asnumpy() if isinstance(mean, NDArray) else
+                     _np.asarray(mean))
+    res = array(arr)
+    if out is not None:
+        out[:] = res
+        return out
+    return res

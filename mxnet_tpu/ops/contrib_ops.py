@@ -662,3 +662,17 @@ def _deformable_psroi_pooling(attrs, data, rois, *trans):
     else:
         tr_arr = trans[0].reshape(rois.shape[0], -1)
     return jax.vmap(one_roi)(rois, tr_arr)
+
+
+@register("khatri_rao", aliases=("_contrib_khatri_rao", "krprod"))
+def _khatri_rao(attrs, *mats):
+    """Column-wise Khatri-Rao product (reference
+    ``src/operator/contrib/krprod.cc``): for matrices with shapes
+    ``(r_i, k)`` the result has shape ``(prod r_i, k)`` where each column
+    is the Kronecker product of the corresponding columns.  On TPU this is
+    a broadcast-multiply-reshape — one fused XLA kernel, no gather."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(
+            out.shape[0] * m.shape[0], m.shape[1])
+    return out

@@ -92,6 +92,10 @@ def _swapaxes(attrs, x):
 
 @register("slice", aliases=("crop",))
 def _slice(attrs, x):
+    return x[_slice_tuple(attrs, x.ndim)]
+
+
+def _slice_tuple(attrs, ndim):
     begin, end = attrs["begin"], attrs["end"]
     step = attrs.get("step") or (None,) * len(begin)
     idx = tuple(
@@ -99,7 +103,33 @@ def _slice(attrs, x):
               None if e is None else int(e),
               None if s in (None, 0) else int(s))
         for b, e, s in zip(begin, end, step))
-    return x[idx]
+    return idx + (slice(None),) * (ndim - len(idx))
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(attrs, lhs, rhs):
+    """Write ``rhs`` into the slice region of ``lhs`` and return the result
+    (reference ``_slice_assign``/``_crop_assign``,
+    ``src/operator/tensor/matrix_op.cc``).  Functional on TPU: XLA turns the
+    ``.at[].set`` into an in-place dynamic-update-slice when the input
+    buffer is donated, so no copy survives in the compiled program."""
+    return lhs.at[_slice_tuple(attrs, lhs.ndim)].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(attrs, lhs):
+    return lhs.at[_slice_tuple(attrs, lhs.ndim)].set(
+        jnp.asarray(float(attrs.get("scalar", 0.0)), lhs.dtype))
+
+
+@register("_CrossDeviceCopy")
+def _cross_device_copy(attrs, x):
+    """Device-boundary marker (reference ``src/operator/cross_device_copy.cc``,
+    inserted by the PlaceDevice pass at ``graph_executor.cc:395``).  Under
+    SPMD there is no device boundary inside a program — placement is
+    expressed as sharding, so this is an identity XLA can elide; kept so
+    legacy ``group2ctx`` graphs load and bind."""
+    return x
 
 
 @register("slice_axis")

@@ -24,13 +24,10 @@ from ..ops.op_names import expected_inputs
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
-_name_counter = {}
-
-
 def _auto_name(prefix):
-    idx = _name_counter.get(prefix, 0)
-    _name_counter[prefix] = idx + 1
-    return "%s%d" % (prefix, idx)
+    from .. import name as _name_mod
+
+    return _name_mod.current().get(None, prefix)
 
 
 class _Node:
@@ -485,6 +482,25 @@ def _fill_param_shapes(node, env, shapes):
         set_var(1, data)
     elif op == "softmax_cross_entropy":
         set_var(1, (data[0],))
+    elif op == "Custom":
+        # the user's CustomOpProp.infer_shape derives every input shape
+        # from the data shape (reference python/mxnet/operator.py
+        # infer_shape_entry)
+        from ..operator import _make_prop
+
+        prop = _make_prop(a)
+        n_args = len(prop.list_arguments())
+        known_in = [list(in_shape(i) or ()) for i in range(n_args)]
+        try:
+            in_sh, _out_sh, _aux_sh = prop.infer_shape(known_in)
+        except Exception:
+            return
+        for pos, s in enumerate(in_sh[:len(node.inputs)]):
+            # an empty shape means the prop echoed an unknown input back
+            # (CustomOpProp.infer_shape base default); leave it unknown so
+            # simple_bind raises instead of binding a bogus 0-d scalar
+            if s:
+                set_var(pos, tuple(int(d) for d in s))
 
 
 def _apply(op_name, input_syms, attrs, name=None):
@@ -501,8 +517,12 @@ def _apply(op_name, input_syms, attrs, name=None):
         attrs = merged
     else:
         attrs = dict(attrs)
-    name = name or attrs.pop("name", None) or \
-        _auto_name(op_name.lower().lstrip("_"))
+    # explicit names also go through the NameManager so Prefix prepends to
+    # them too (reference name.py Prefix.get applies to given names)
+    from .. import name as _name_mod
+
+    name = _name_mod.current().get(name or attrs.pop("name", None),
+                                   op_name.lower().lstrip("_"))
     attrs.pop("name", None)
 
     arg_names, aux_names = expected_inputs(op_name, attrs)
@@ -534,6 +554,10 @@ _PARAMETRIC_OPS = {
     "SoftmaxOutput", "Softmax", "SVMOutput", "LinearRegressionOutput",
     "MAERegressionOutput", "LogisticRegressionOutput",
     "softmax_cross_entropy", "LeakyReLU",
+    # Custom ops declare their arguments via CustomOpProp.list_arguments;
+    # the reference Compose path auto-creates the missing ones just like
+    # any layer op (python/mxnet/operator.py)
+    "Custom",
 }
 
 

@@ -1,0 +1,182 @@
+"""PyTorch interop bridge (the TPU-era analogue of the reference's Torch
+plugin: ``plugin/torch`` ``TorchModule``/``TorchCriterion`` ops and the
+``python/mxnet/torch.py`` frontend, which bridged *Lua* Torch modules into
+MXNet graphs).
+
+Design: a ``torch.nn.Module`` (CPU) becomes a framework op through the same
+host-callback machinery as CustomOp (``mxnet_tpu/operator.py`` —
+``jax.pure_callback`` forward + ``jax.custom_vjp`` backward), with
+``torch.autograd`` supplying the backward pass.  Like the reference plugin,
+this runs the foreign framework's kernels on the host — it exists for
+interop and porting, not for the TPU hot path (document: not fusable; under
+the fused Module path it falls back to the split executor, exactly like
+CustomOp).
+
+Surfaces:
+
+* ``TorchModuleOp`` / ``TorchCriterionOp`` — ``CustomOp`` subclasses
+  (usable via ``mx.sym.Custom(op_type=...)`` after ``register_module``).
+* ``apply(module, *args)`` — imperative one-shot: run a torch module on
+  NDArrays, differentiable through the autograd tape.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from . import operator as _op
+
+__all__ = ["TorchModuleOp", "TorchCriterionOp", "register_module", "apply"]
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError:  # pragma: no cover - torch is baked into the image
+        raise MXNetError(
+            "the torch bridge needs the 'torch' package") from None
+
+
+class TorchModuleOp(_op.CustomOp):
+    """Wrap a ``torch.nn.Module``: inputs = (data, *module parameters) so
+    the module's parameters are trainable framework arguments (reference
+    TorchModule keeps them inside the Lua closure; exposing them as op
+    inputs is what lets the TPU autograd/optimizer see them)."""
+
+    def __init__(self, module):
+        self.module = module.cpu().float()
+        self._params = list(self.module.parameters())
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = _torch()
+        data = torch.from_numpy(in_data[0].asnumpy().copy())
+        with torch.no_grad():
+            for p, v in zip(self._params, in_data[1:]):
+                p.copy_(torch.from_numpy(v.asnumpy().copy()))
+            out = self.module(data)
+        self.assign(out_data[0], req[0], array(out.numpy()))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _torch()
+        data = torch.from_numpy(in_data[0].asnumpy().copy()).requires_grad_(True)
+        with torch.no_grad():
+            for p, v in zip(self._params, in_data[1:]):
+                p.copy_(torch.from_numpy(v.asnumpy().copy()))
+        for p in self._params:
+            p.requires_grad_(True)
+            p.grad = None
+        out = self.module(data)
+        out.backward(torch.from_numpy(out_grad[0].asnumpy().copy()))
+        grads = [data.grad] + [p.grad for p in self._params]
+        for i, g in enumerate(grads):
+            self.assign(in_grad[i], req[i],
+                        array(g.detach().numpy()) if g is not None
+                        else in_grad[i] * 0)
+
+
+class TorchCriterionOp(_op.CustomOp):
+    """Wrap a torch loss (criterion): ``forward(data, label) -> loss``
+    (reference ``plugin/torch`` TorchCriterion)."""
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = _torch()
+        data = torch.from_numpy(in_data[0].asnumpy().copy())
+        label = torch.from_numpy(in_data[1].asnumpy().copy())
+        with torch.no_grad():
+            loss = self.criterion(data, label)
+        self.assign(out_data[0], req[0], array(loss.numpy().reshape(1)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _torch()
+        data = torch.from_numpy(in_data[0].asnumpy().copy()).requires_grad_(True)
+        label = torch.from_numpy(in_data[1].asnumpy().copy())
+        loss = self.criterion(data, label)
+        loss.backward()
+        scale = float(out_grad[0].asnumpy().reshape(-1)[0])
+        self.assign(in_grad[0], req[0], array(data.grad.numpy()) * scale)
+        self.assign(in_grad[1], req[1], in_grad[1] * 0)
+
+
+def register_module(op_type, module_factory):
+    """Register a torch module factory as a Custom op type, so it works in
+    Symbol graphs::
+
+        mx.torch.register_module('torch_mlp', lambda: nn.Sequential(...))
+        out = mx.sym.Custom(data, op_type='torch_mlp')
+    """
+    probe = module_factory()
+    _register_prop(op_type, lambda: probe, module_factory)
+    return [tuple(p.shape) for p in probe.parameters()]
+
+
+def _register_prop(op_type, get_probe, make_operator_module):
+    """Build and register the CustomOpProp.  ``get_probe`` returns the
+    module used for shape inference (may return None if it was weakly
+    held and collected); ``make_operator_module`` builds the module for
+    ``create_operator``."""
+    torch = _torch()
+    probe = get_probe()
+    param_shapes = [tuple(p.shape) for p in probe.parameters()]
+    # torch names like "0.weight" become "0_weight": the _weight/_bias
+    # suffix lets the default initializer's name patterns apply
+    param_names = [n.replace(".", "_")
+                   for n, _ in probe.named_parameters()]
+
+    class _Prop(_op.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"] + param_names
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            m = get_probe()
+            if m is None:
+                raise MXNetError("torch module for op %r was garbage "
+                                 "collected" % op_type)
+            data_shape = in_shape[0]
+            with torch.no_grad():
+                out = m(torch.zeros(*data_shape))
+            return ([list(data_shape)] +
+                    [list(s) for s in param_shapes],
+                    [list(out.shape)], [])
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            m = make_operator_module()
+            if m is None:
+                raise MXNetError("torch module for op %r was garbage "
+                                 "collected" % op_type)
+            return TorchModuleOp(m)
+
+    _Prop.__name__ = "TorchProp_%s" % op_type
+    _op.register(op_type)(_Prop)
+
+
+def apply(module, data):
+    """Run a torch module imperatively on an NDArray.  Routed through the
+    ``Custom`` registry op, so it records on the autograd tape and
+    ``autograd.backward`` reaches both the data and the module's
+    parameters (passed as trailing Custom inputs)."""
+    import weakref
+
+    from . import ndarray as nd
+
+    if not isinstance(data, NDArray):
+        data = array(data)
+    op_type = "_torch_apply_%x" % id(module)
+    if op_type not in _op._CUSTOM_PROPS:
+        # hold the module WEAKLY (a strong closure would keep every
+        # transient module alive in the process-global registry forever)
+        # and drop the registry entry when it is collected
+        ref = weakref.ref(module)
+        _register_prop(op_type, ref, ref)
+        weakref.finalize(module, _op._CUSTOM_PROPS.pop, op_type, None)
+    params = [array(p.detach().numpy()) for p in module.parameters()]
+    return nd.Custom(data, *params, op_type=op_type)

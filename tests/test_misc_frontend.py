@@ -1,0 +1,273 @@
+"""Tests for the misc frontend parity modules: name scopes, contrib
+package, executor_manager, kvstore_server, libinfo, and the torch bridge
+(reference counterparts: python/mxnet/name.py, contrib/, executor_manager.py,
+kvstore_server.py, libinfo.py, plugin/torch)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import name as name_mod
+
+
+def test_name_manager_scopes():
+    data = mx.sym.Variable("data")
+    with name_mod.NameManager():
+        a = mx.sym.FullyConnected(data, num_hidden=4)
+        b = mx.sym.FullyConnected(a, num_hidden=4)
+    with name_mod.NameManager():
+        c = mx.sym.FullyConnected(data, num_hidden=4)
+    assert a.name == "fullyconnected0"
+    assert b.name == "fullyconnected1"
+    assert c.name == "fullyconnected0"  # counters restart per scope
+
+
+def test_name_prefix():
+    data = mx.sym.Variable("data")
+    with name_mod.Prefix("net_"):
+        a = mx.sym.Activation(data, act_type="relu")
+    assert a.name.startswith("net_activation")
+
+
+def test_contrib_namespaces():
+    from mxnet_tpu import contrib
+
+    assert hasattr(contrib.nd, "MultiBoxPrior")
+    assert hasattr(contrib.sym, "CTCLoss")
+    out = contrib.nd.MultiBoxPrior(mx.nd.zeros((1, 3, 4, 4)),
+                                   sizes=(0.5,), ratios=(1.0,))
+    assert out.shape[-1] == 4
+
+
+def test_contrib_autograd_grad_and_loss():
+    from mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad_and_loss
+    def f(x):
+        return mx.nd.sum(x * x)
+
+    x = mx.nd.array(np.arange(4, dtype="float32"))
+    grads, loss = f(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-5)
+    assert abs(float(loss.asnumpy()) - float((x.asnumpy() ** 2).sum())) \
+        < 1e-4
+
+
+def test_contrib_autograd_grad_decorator():
+    from mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad
+    def f(x):
+        return mx.nd.sum(mx.nd.exp(x))
+
+    x = mx.nd.array(np.array([0.0, 1.0], "float32"))
+    (g,) = f(x)
+    np.testing.assert_allclose(g.asnumpy(), np.exp(x.asnumpy()), rtol=1e-5)
+
+
+def test_tensorboard_callback_with_double():
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from mxnet_tpu.module.base_module import BatchEndParam
+
+    logged = []
+
+    class Writer:
+        def add_scalar(self, tag, value):
+            logged.append((tag, value))
+
+    cb = LogMetricsCallback("unused", prefix="train",
+                            summary_writer=Writer())
+    metric = mx.metric.create("acc")
+    metric.update([mx.nd.array([1, 0])],
+                  [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=metric, locals=None))
+    assert logged and logged[0][0] == "train-accuracy"
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+
+    slices = _split_input_slice(10, [1, 1])
+    assert slices == [slice(0, 5), slice(5, 10)]
+    slices = _split_input_slice(9, [2, 1])
+    assert slices[0] == slice(0, 6) and slices[1] == slice(6, 9)
+
+
+def test_executor_manager_trains():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 4).astype("float32")
+    w_true = rs.rand(4, 1).astype("float32")
+    y = (x @ w_true).ravel()
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="lin_label")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True,
+                                name="fc")
+    net = mx.sym.LinearRegressionOutput(net, mx.sym.Variable("lin_label"),
+                                        name="lin")
+    mgr = DataParallelExecutorManager(net, [mx.cpu(), mx.cpu()], it)
+    assert len(mgr.execs) == 2
+    mgr.set_params({"fc_weight": mx.nd.zeros((1, 4))}, {})
+
+    lr = 0.5
+    for _ in range(300):
+        it.reset()
+        for batch in it:
+            mgr.load_data_batch(batch)
+            mgr.forward(is_train=True)
+            mgr.backward()
+            # host-side reduce across slice grads (the kvstore 'local'
+            # role in the reference loop), then SGD on the shared params
+            for name, grads in zip(mgr.param_names, mgr.grad_arrays):
+                total = grads[0]
+                for g in grads[1:]:
+                    total = total + g
+                arr = mgr.execs[0].arg_dict[name]
+                arr[:] = arr - lr * total / 16.0
+    params = {}
+    mgr.copy_to(params, {})
+    np.testing.assert_allclose(params["fc_weight"].asnumpy().ravel(),
+                               w_true.ravel(), atol=5e-2)
+
+
+def test_executor_manager_outputs_and_metric():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    x = np.random.rand(6, 3).astype("float32")
+    y = np.array([0, 1, 0, 1, 0, 1], "float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=6)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2), name="softmax")
+    mgr = DataParallelExecutorManager(net, [mx.cpu(), mx.cpu()], it)
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward()
+    outs = mgr.outputs
+    assert outs[0].shape == (6, 2)
+    metric = mx.metric.create("acc")
+    mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
+
+
+def test_kvstore_server_is_noop_participant():
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    kv = mx.kv.create("dist_tpu_sync")
+    server = KVStoreServer(kv)
+    server.run()  # returns instead of blocking — SPMD has no servers
+
+
+def test_libinfo():
+    from mxnet_tpu import libinfo
+
+    assert libinfo.__version__ == mx.__version__
+    paths = libinfo.find_lib_path()
+    assert isinstance(paths, list)
+    assert libinfo.find_include_path().endswith("src")
+
+
+# -- torch bridge -----------------------------------------------------------
+
+torch = pytest.importorskip("torch")
+
+
+def test_torch_apply_forward():
+    import mxnet_tpu.torch as mxth
+
+    lin = torch.nn.Linear(4, 3)
+    x = np.random.rand(2, 4).astype("float32")
+    out = mxth.apply(lin, mx.nd.array(x))
+    with torch.no_grad():
+        ref = lin(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_module_in_symbol_graph_grads():
+    import mxnet_tpu.torch as mxth
+
+    mxth.register_module("torch_tanh_mlp",
+                         lambda: torch.nn.Sequential(
+                             torch.nn.Linear(4, 3), torch.nn.Tanh()))
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="torch_tanh_mlp", name="tnet")
+    args = net.list_arguments()
+    assert args == ["data", "tnet_0_weight", "tnet_0_bias"]
+
+    x = np.random.rand(2, 4).astype("float32")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.arg_dict["data"][:] = mx.nd.array(x)
+    w0 = ex.arg_dict["tnet_0_weight"].asnumpy()
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 3)
+    ex.backward(out_grads=[mx.nd.ones((2, 3))])
+    # finite-difference check one weight element through torch
+    lin = torch.nn.Linear(4, 3)
+    with torch.no_grad():
+        lin.weight.copy_(torch.from_numpy(w0))
+        lin.bias.copy_(torch.from_numpy(ex.arg_dict["tnet_0_bias"].asnumpy()))
+    xt = torch.from_numpy(x)
+    lin.weight.requires_grad_(True)
+    torch.tanh(lin(xt)).sum().backward()
+    np.testing.assert_allclose(ex.grad_dict["tnet_0_weight"].asnumpy(),
+                               lin.weight.grad.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_name_prefix_applies_to_explicit_names():
+    # reference Prefix.get prepends even to explicitly-given names
+    data = mx.sym.Variable("data")
+    with name_mod.Prefix("resnet_"):
+        a = mx.sym.Activation(data, act_type="relu", name="act1")
+    assert a.name == "resnet_act1"
+
+
+def test_custom_unknown_shape_raises_not_scalar_bind():
+    # a prop that echoes unknown inputs (base-class infer_shape default)
+    # must NOT cause params to bind as 0-d scalars
+    from mxnet_tpu import operator as op_mod
+
+    @op_mod.register("echo_shape_prop")
+    class EchoProp(op_mod.CustomOpProp):
+        def list_arguments(self):
+            return ["data", "weight"]
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):  # pragma: no cover
+            raise NotImplementedError
+
+    net = mx.sym.Custom(mx.sym.Variable("data"),
+                        op_type="echo_shape_prop", name="c")
+    with pytest.raises(mx.MXNetError):
+        net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+
+
+def test_nd_imdecode_reference_signature():
+    from PIL import Image
+    import io as pyio
+
+    img = Image.fromarray((np.arange(20 * 30 * 3) % 255).astype(
+        "uint8").reshape(20, 30, 3))
+    bio = pyio.BytesIO()
+    img.save(bio, format="PNG")
+    out = mx.nd.imdecode(bio.getvalue(), clip_rect=(5, 2, 25, 18),
+                         mean=mx.nd.ones((1, 1, 3)))
+    assert out.shape == (16, 20, 3)
+    full = mx.nd.imdecode(bio.getvalue())
+    assert full.shape == (20, 30, 3)
+
+
+def test_torch_apply_registry_does_not_leak():
+    import gc
+    import mxnet_tpu.torch as mxth
+    from mxnet_tpu import operator as op_mod
+
+    lin = torch.nn.Linear(2, 2)
+    op_type = "_torch_apply_%x" % id(lin)
+    mxth.apply(lin, mx.nd.ones((1, 2)))
+    assert op_type in op_mod._CUSTOM_PROPS
+    del lin
+    gc.collect()
+    assert op_type not in op_mod._CUSTOM_PROPS

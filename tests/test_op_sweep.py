@@ -304,6 +304,14 @@ def _build_specs():
                "count_sketch"):
         s["_contrib_" + _n] = s[_n]
 
+    s["_slice_assign"] = s["_crop_assign"] = (
+        [_f(4, 4), _f(2, 2)], {"begin": (1, 1), "end": (3, 3)})
+    s["_slice_assign_scalar"] = s["_crop_assign_scalar"] = (
+        [_f(4, 4)], {"begin": (0, 0), "end": (2, 4), "scalar": 3.0})
+    s["_CrossDeviceCopy"] = ([_f(3, 3)], {})
+    s["khatri_rao"] = s["_contrib_khatri_rao"] = s["krprod"] = (
+        [_f(3, 2), _f(4, 2)], {})
+
     # -- optimizer updates -------------------------------------------------
     s["sgd_update"] = ([_f(4), _f(4)], {"lr": 0.1})
     s["sgd_mom_update"] = ([_f(4), _f(4), _f(4)], {"lr": 0.1,
