@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Imperative autograd walkthrough (reference ``example/autograd/``):
+tape recording, higher-level ``grad``, and a custom training loop without
+Module/Gluon.
+
+    python examples/autograd/autograd_basics.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def main():
+    # 1. basic tape
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x)
+    y.backward()
+    print("d(sum x^2)/dx =", x.grad.asnumpy())  # 2x
+
+    # 2. the old contrib surface
+    from mxnet_tpu.contrib import autograd as cag
+
+    @cag.grad_and_loss
+    def loss_fn(w):
+        return nd.sum(nd.exp(w))
+
+    grads, loss = loss_fn(nd.array([0.0, 1.0]))
+    print("contrib grad:", grads[0].asnumpy())
+
+    # 3. linear regression by hand
+    rs = np.random.RandomState(0)
+    xs = nd.array(rs.rand(128, 4).astype("float32"))
+    true_w = nd.array(rs.rand(4, 1).astype("float32"))
+    ys = nd.dot(xs, true_w)
+    w = nd.zeros((4, 1))
+    w.attach_grad()
+    for step in range(200):
+        with autograd.record():
+            err = nd.dot(xs, w) - ys
+            loss = nd.sum(err * err) / 128.0
+        loss.backward()
+        w[:] = w - 0.5 * w.grad
+    print("recovered |w - w*|:",
+          float(nd.max(nd.abs(w - true_w)).asnumpy()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Gluon imperative MNIST training (reference ``example/gluon/mnist.py``):
+``nn.Sequential`` + ``autograd.record`` + ``Trainer.step``.
+
+    python examples/gluon/mnist.py --epochs 5
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_net(hybridize):
+    net = nn.Sequential()
+    net.add(nn.Dense(128, activation="relu"))
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(10))
+    if hybridize:
+        net.hybridize()
+    return net
+
+
+def synthetic_mnist(n, rs):
+    x = rs.rand(n, 784).astype("float32") * 0.1
+    y = rs.randint(0, 10, n).astype("float32")
+    for i in range(n):
+        k = int(y[i])
+        x[i, 28 * k: 28 * k + 56] += 0.9
+    return x, y
+
+
+def evaluate(net, loader):
+    metric = mx.metric.Accuracy()
+    for data, label in loader:
+        metric.update([label], [net(data)])
+    return metric.get()[1]
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    xtr, ytr = synthetic_mnist(args.num_examples, rs)
+    xva, yva = synthetic_mnist(1024, rs)
+    train_data = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(xtr, ytr), batch_size=args.batch_size,
+        shuffle=True)
+    val_data = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(xva, yva), batch_size=args.batch_size)
+
+    net = make_net(args.hybridize)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total = 0.0
+        for data, label in train_data:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += float(loss.asnumpy().mean())
+        acc = evaluate(net, val_data)
+        print("epoch %d loss %.4f val-acc %.4f" % (epoch, total, acc))
+    return evaluate(net, val_data)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--num-examples", type=int, default=8192)
+    p.add_argument("--hybridize", action="store_true", default=True)
+    main(p.parse_args())

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Train ResNet on CIFAR-10 through the RecordIO pipeline (reference
+``example/image-classification/train_cifar10.py``).
+
+If ``--data-dir`` has no ``cifar10_train.rec``, a synthetic class-colored
+dataset is packed into RecordIO first (via ``mxnet_tpu.recordio`` +
+``tools/im2rec.py`` conventions), so the full pipeline — .rec file →
+``ImageRecordIter`` (threaded decode + augmenters + prefetch) →
+``Module.fit`` — runs hermetically.
+
+    python examples/image-classification/train_cifar10.py --num-layers 20
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def _pack_synthetic(rec_path, n, num_classes, rs):
+    """Pack class-colored 32x32 PNGs into a .rec (im2rec format)."""
+    from PIL import Image
+    import io as pyio
+
+    from mxnet_tpu import recordio
+
+    writer = recordio.MXRecordIO(rec_path, "w")
+    for i in range(n):
+        cls = int(rs.randint(num_classes))
+        img = (rs.rand(32, 32, 3) * 60).astype("uint8")
+        img[..., cls % 3] += np.uint8(120 + 10 * (cls // 3))
+        bio = pyio.BytesIO()
+        Image.fromarray(img).save(bio, format="PNG")
+        header = recordio.IRHeader(0, float(cls), i, 0)
+        writer.write(recordio.pack(header, bio.getvalue()))
+    writer.close()
+
+
+def get_cifar_iter(args, kv):
+    data_dir = args.data_dir or "/tmp/cifar10_synth"
+    os.makedirs(data_dir, exist_ok=True)
+    train_rec = os.path.join(data_dir, "cifar10_train.rec")
+    val_rec = os.path.join(data_dir, "cifar10_val.rec")
+    if not os.path.exists(train_rec):
+        rs = np.random.RandomState(0)
+        _pack_synthetic(train_rec, args.num_examples, args.num_classes, rs)
+        _pack_synthetic(val_rec, 512, args.num_classes, rs)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=train_rec,
+        data_shape=(3, 28, 28),
+        batch_size=args.batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        part_index=kv.rank, num_parts=kv.num_workers)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=val_rec,
+        data_shape=(3, 28, 28),
+        batch_size=args.batch_size)
+    return train, val
+
+
+def get_symbol(args):
+    from mxnet_tpu.models import resnet
+
+    return resnet.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers or 20,
+                             image_shape=(3, 28, 28))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=4096)
+    parser.add_argument("--data-dir", type=str, default=None)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="resnet", num_layers=20, num_epochs=10,
+                        batch_size=128, lr=0.05)
+    args = parser.parse_args()
+    fit.fit(args, get_symbol(args), get_cifar_iter)

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Train MLP/LeNet on MNIST (reference
+``example/image-classification/train_mnist.py``).
+
+Uses the real MNIST files when ``--data-dir`` points at the idx-format
+gz/ubyte files; otherwise falls back to a synthetic MNIST-shaped dataset
+so the script runs hermetically (this image has no network egress).
+
+    python examples/image-classification/train_mnist.py --network lenet \
+        --num-epochs 5
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def get_symbol(network, num_classes=10):
+    from mxnet_tpu.models import lenet, mlp
+
+    if network == "mlp":
+        return mlp.get_symbol(num_classes=num_classes)
+    if network == "lenet":
+        return lenet.get_symbol(num_classes=num_classes)
+    raise ValueError("unknown network %r" % network)
+
+
+def _synthetic_mnist(n):
+    """Class-separable 28x28 digit-ish data: class k lights a kxk block."""
+    rs = np.random.RandomState(7)
+    x = rs.rand(n, 1, 28, 28).astype("float32") * 0.1
+    y = rs.randint(0, 10, n).astype("float32")
+    for i in range(n):
+        k = int(y[i])
+        x[i, 0, 2:6 + k, 2:6 + k] += 0.9
+    return x, y
+
+
+def get_mnist_iter(args, kv):
+    data_dir = getattr(args, "data_dir", None)
+    if data_dir and os.path.exists(os.path.join(data_dir,
+                                                "train-images-idx3-ubyte")):
+        train = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size)
+        return train, val
+    xtr, ytr = _synthetic_mnist(args.num_examples)
+    xva, yva = _synthetic_mnist(1024)
+    return (mx.io.NDArrayIter(xtr, ytr, args.batch_size, shuffle=True),
+            mx.io.NDArrayIter(xva, yva, args.batch_size))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=8192)
+    parser.add_argument("--data-dir", type=str, default=None)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10, batch_size=128,
+                        lr=0.05)
+    args = parser.parse_args()
+
+    sym = get_symbol(args.network, args.num_classes)
+    fit.fit(args, sym, get_mnist_iter)

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Profile one ResNet train step to a chrome trace (reference
+``example/profiler/profiler_executor.py``; our profiler wraps
+``jax.profiler``, see ``mxnet_tpu/profiler.py``).
+
+    python examples/profiler/profile_resnet.py --out /tmp/mxnet_profile
+    # then open the trace in Perfetto / chrome://tracing
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def main(args):
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.fused import TrainStep
+
+    sym = resnet.get_symbol(num_classes=100, num_layers=args.num_layers,
+                            image_shape=(3, 32, 32))
+    step = TrainStep(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    shapes = {"data": (args.batch_size, 3, 32, 32),
+              "softmax_label": (args.batch_size,)}
+    params, aux, states = step.init_state(shapes)
+    import jax
+
+    rng = jax.random.PRNGKey(0)
+    batch = {"data": jax.numpy.asarray(
+                 np.random.rand(*shapes["data"]).astype("float32")),
+             "softmax_label": jax.numpy.zeros(shapes["softmax_label"],
+                                              "float32")}
+    # warm up (compile) outside the profile window
+    params, aux, states, _ = step(params, aux, states, batch, rng)
+
+    mx.profiler.profiler_set_config(mode="all", filename=args.out)
+    mx.profiler.profiler_set_state("run")
+    for _ in range(args.iters):
+        params, aux, states, out = step(params, aux, states, batch, rng)
+    float(np.asarray(out[0][0, 0]))  # drain the device
+    mx.profiler.profiler_set_state("stop")
+    print("trace written under", args.out)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-layers", type=int, default=20)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--out", type=str, default="/tmp/mxnet_profile")
+    main(p.parse_args())
