@@ -141,3 +141,39 @@ def test_rtc_pallas_kernel():
     dst = mx.nd.zeros((16, 128))
     k.push([mx.nd.array(x), mx.nd.array(y)], [dst])
     np.testing.assert_allclose(dst.asnumpy(), x * 2 + y, rtol=1e-6)
+
+
+def test_predictor_export_bundle_roundtrip(tmp_path):
+    prefix, X, mod = _train_tiny(tmp_path)
+    pred = mx.Predictor.load(prefix, 5, {"data": (10, 6)})
+    pred.set_input("data", X[:10])
+    ref = np.asarray(pred.forward()[0].asnumpy())
+
+    bundle = str(tmp_path / "tiny.mxtpu")
+    pred.export(bundle)
+    assert os.path.getsize(bundle) > 0
+
+    served = mx.Predictor.load_exported(bundle)
+    assert served.output_names == pred.output_names
+    out = served.forward(data=X[:10])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(served.get_output(0), ref, rtol=1e-5,
+                               atol=1e-6)
+    with pytest.raises(mx.base.MXNetError):
+        served.forward(bogus=X[:10])
+
+
+def test_export_model_cli(tmp_path):
+    prefix, X, mod = _train_tiny(tmp_path)
+    out = str(tmp_path / "cli.mxtpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "export_model.py"),
+         "--prefix", prefix, "--epoch", "5", "--data-shape", "10,6",
+         "--out", out],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert res.returncode == 0, res.stderr
+    served = mx.Predictor.load_exported(out)
+    assert served.forward(data=X[:10])[0].shape == (10, 3)

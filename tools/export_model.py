@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Package a checkpoint into a single AOT deployment bundle (the
+amalgamation analogue; reference ``amalgamation/`` +
+``c_predict_api.cc``).
+
+    python tools/export_model.py --prefix model --epoch 10 \
+        --data-shape 1,3,224,224 --out model.mxtpu
+
+The bundle holds serialized StableHLO + parameters + metadata; serve it
+with ``mxnet_tpu.predictor.Predictor.load_exported('model.mxtpu')`` (only
+``jax.export`` and numpy needed at serving time).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--prefix", required=True,
+                   help="checkpoint prefix (prefix-symbol.json + params)")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--data-shape", required=True,
+                   help="comma-separated input shape incl. batch")
+    p.add_argument("--data-name", default="data")
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    from mxnet_tpu.predictor import Predictor
+
+    shape = tuple(int(d) for d in args.data_shape.split(","))
+    pred = Predictor.load(args.prefix, args.epoch,
+                          {args.data_name: shape})
+    out = args.out or "%s-%04d.mxtpu" % (args.prefix, args.epoch)
+    pred.export(out)
+    print("wrote", out, "(%d bytes)" % os.path.getsize(out))
+
+
+if __name__ == "__main__":
+    main()
