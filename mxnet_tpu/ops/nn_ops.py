@@ -238,6 +238,10 @@ def _activation(attrs, x):
         return jax.nn.softplus(x)
     if act == "softsign":
         return jax.nn.soft_sign(x)
+    if act == "gelu":
+        # post-0.11 addition for the transformer family (tanh approx,
+        # the TPU-friendly form)
+        return jax.nn.gelu(x)
     raise MXNetError("unknown act_type %r" % act)
 
 
@@ -816,3 +820,36 @@ def _identity_kl(attrs, x):
 
 # The "Custom" op (Python-defined ops over host callbacks) registers from
 # mxnet_tpu/operator.py — reference src/operator/custom/custom.cc.
+
+
+@register("_contrib_MultiHeadAttention", aliases=("MultiHeadAttention",))
+def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
+                          out_bias):
+    """Fused causal multi-head self-attention.  Not in the 0.11 reference
+    (attention post-dates it) — added for the transformer model family,
+    shaped so every FLOP lands on the MXU: one (3C, C) input projection,
+    einsum score/value matmuls batched over (batch, heads), one (C, C)
+    output projection.  Softmax statistics run in fp32 regardless of the
+    compute dtype (bf16-safe).  Sequence-parallel execution of the same
+    contraction lives in ``parallel/sequence.py`` (ring attention).
+    """
+    num_heads = int(attrs["num_heads"])
+    causal = bool(attrs.get("causal", True))
+    n, t, c = data.shape
+    d = c // num_heads
+    qkv = jnp.einsum("ntc,fc->ntf", data, in_weight) + in_bias
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(x):
+        return x.reshape(n, t, num_heads, d).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32)
+    scores = scores / (d ** 0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
+    return jnp.einsum("ntc,oc->nto", ctx, out_weight) + out_bias

@@ -52,6 +52,8 @@ INPUT_NAMES = {
     "CTCLoss": (("data", "label"), ()),
     "Correlation": (("data1", "data2"), ()),
     "DeformablePSROIPooling": (("data", "rois", "trans"), ()),
+    "MultiHeadAttention": (("data", "in_weight", "in_bias", "out_weight",
+                            "out_bias"), ()),
     "quantize": (("data", "min_range", "max_range"), ()),
     "dequantize": (("data", "min_range", "max_range"), ()),
     "count_sketch": (("data", "h", "s"), ()),
@@ -60,7 +62,8 @@ INPUT_NAMES = {
 _CONTRIB = ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "Proposal", "MultiProposal", "PSROIPooling",
             "DeformableConvolution", "DeformablePSROIPooling", "CTCLoss",
-            "quantize", "dequantize", "count_sketch")
+            "quantize", "dequantize", "count_sketch",
+            "MultiHeadAttention")
 for _name in _CONTRIB:
     if _name in INPUT_NAMES:
         INPUT_NAMES["_contrib_" + _name] = INPUT_NAMES[_name]

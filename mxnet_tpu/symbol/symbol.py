@@ -357,6 +357,11 @@ def _infer_param_shapes(sym, known):
         if node.is_variable:
             if node.name in known and known[node.name] is not None:
                 shapes[node.name] = tuple(known[node.name])
+            elif node.attrs.get("__shape__"):
+                # Variable(shape=...) declared its own shape (reference
+                # simple_bind honors the __shape__ attr)
+                shapes[node.name] = tuple(
+                    int(d) for d in node.attrs["__shape__"])
             continue
         # try to fill parameter-variable input shapes from op semantics
         _fill_param_shapes(node, env, shapes)
@@ -482,6 +487,10 @@ def _fill_param_shapes(node, env, shapes):
         set_var(1, data)
     elif op == "softmax_cross_entropy":
         set_var(1, (data[0],))
+    elif op in ("MultiHeadAttention", "_contrib_MultiHeadAttention"):
+        c = data[2]
+        set_var(1, (3 * c, c)); set_var(2, (3 * c,))
+        set_var(3, (c, c)); set_var(4, (c,))
     elif op == "Custom":
         # the user's CustomOpProp.infer_shape derives every input shape
         # from the data shape (reference python/mxnet/operator.py
@@ -558,6 +567,7 @@ _PARAMETRIC_OPS = {
     # the reference Compose path auto-creates the missing ones just like
     # any layer op (python/mxnet/operator.py)
     "Custom",
+    "MultiHeadAttention", "_contrib_MultiHeadAttention",
 }
 
 

@@ -304,6 +304,10 @@ def _build_specs():
                "count_sketch"):
         s["_contrib_" + _n] = s[_n]
 
+    s["MultiHeadAttention"] = s["_contrib_MultiHeadAttention"] = (
+        [_f(2, 4, 8), _f(24, 8) * 0.2, _f(24) * 0.1, _f(8, 8) * 0.2,
+         _f(8) * 0.1],
+        {"num_heads": 2})
     s["_slice_assign"] = s["_crop_assign"] = (
         [_f(4, 4), _f(2, 2)], {"begin": (1, 1), "end": (3, 3)})
     s["_slice_assign_scalar"] = s["_crop_assign_scalar"] = (

@@ -1,0 +1,129 @@
+"""Transformer model family: the MultiHeadAttention op, causal masking,
+and end-to-end LM training (models/transformer.py — the post-reference
+flagship workload; bench_transformer.py measures its MFU)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer
+
+
+def _mha_numpy(x, in_w, in_b, out_w, out_b, heads, causal=True):
+    n, t, c = x.shape
+    d = c // heads
+    qkv = x @ in_w.T + in_b
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def to_heads(a):
+        return a.reshape(n, t, heads, d).transpose(0, 2, 1, 3)
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((t, t), bool))
+        s = np.where(mask, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ctx = (p @ v).transpose(0, 2, 1, 3).reshape(n, t, c)
+    return ctx @ out_w.T + out_b
+
+
+def test_mha_matches_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 5, 8).astype("float32")
+    in_w = rs.randn(24, 8).astype("float32") * 0.2
+    in_b = rs.randn(24).astype("float32") * 0.1
+    out_w = rs.randn(8, 8).astype("float32") * 0.2
+    out_b = rs.randn(8).astype("float32") * 0.1
+    out = mx.nd.MultiHeadAttention(
+        mx.nd.array(x), mx.nd.array(in_w), mx.nd.array(in_b),
+        mx.nd.array(out_w), mx.nd.array(out_b), num_heads=2)
+    ref = _mha_numpy(x, in_w, in_b, out_w, out_b, heads=2)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_causal_mask_blocks_future():
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 6, 8).astype("float32")
+    args = [rs.randn(24, 8).astype("float32") * 0.2,
+            np.zeros(24, "float32"),
+            rs.randn(8, 8).astype("float32") * 0.2,
+            np.zeros(8, "float32")]
+    base = mx.nd.MultiHeadAttention(
+        mx.nd.array(x), *[mx.nd.array(a) for a in args],
+        num_heads=2).asnumpy()
+    # perturb the FUTURE tokens: outputs at earlier positions unchanged
+    x2 = x.copy()
+    x2[0, 4:] += 10.0
+    pert = mx.nd.MultiHeadAttention(
+        mx.nd.array(x2), *[mx.nd.array(a) for a in args],
+        num_heads=2).asnumpy()
+    np.testing.assert_allclose(pert[0, :4], base[0, :4], rtol=1e-4,
+                               atol=1e-5)
+    assert np.abs(pert[0, 4:] - base[0, 4:]).max() > 1e-3
+
+
+def test_mha_gradient():
+    tu = mx.test_utils
+    rs = np.random.RandomState(2)
+    data = rs.randn(1, 3, 4).astype("float32")
+    in_w = rs.randn(12, 4).astype("float32") * 0.3
+    in_b = np.zeros(12, "float32")
+    out_w = rs.randn(4, 4).astype("float32") * 0.3
+    out_b = np.zeros(4, "float32")
+    sym = mx.sym.MultiHeadAttention(
+        mx.sym.Variable("data"), mx.sym.Variable("in_weight"),
+        mx.sym.Variable("in_bias"), mx.sym.Variable("out_weight"),
+        mx.sym.Variable("out_bias"), num_heads=2, name="mha")
+    tu.check_numeric_gradient(
+        sym, {"data": data, "in_weight": in_w, "in_bias": in_b,
+              "out_weight": out_w, "out_bias": out_b},
+        grad_nodes=["data", "in_weight"], numeric_eps=1e-2, rtol=5e-2,
+        atol=1e-2)
+
+
+def test_transformer_symbol_shapes():
+    sym = transformer.get_symbol(vocab_size=32, num_layers=2, d_model=16,
+                                 num_heads=2, seq_len=8)
+    args = sym.list_arguments()
+    assert "pos_embed" in args and "tok_embed_weight" in args
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(4, 8),
+                         softmax_label=(4, 8))
+    assert ex.arg_dict["pos_embed"].shape == (1, 8, 16)
+    assert ex.arg_dict["blk0_attn_in_weight"].shape == (48, 16)
+    ex.forward(is_train=False)
+    assert ex.outputs[0].shape == (32, 32)  # (N*T, vocab)
+
+
+def test_transformer_param_count_matches_bind():
+    cfg = dict(vocab_size=32, num_layers=2, d_model=16, num_heads=2,
+               seq_len=8)
+    sym = transformer.get_symbol(**cfg)
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(2, 8), softmax_label=(2, 8))
+    n_bound = sum(np.prod(a.shape) for n, a in ex.arg_dict.items()
+                  if n not in ("data", "softmax_label"))
+    assert int(n_bound) == transformer.count_params(**cfg)
+
+
+def test_transformer_lm_learns():
+    sym = transformer.get_symbol(vocab_size=16, num_layers=1, d_model=16,
+                                 num_heads=2, seq_len=8)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 16, (256, 8)).astype("float32")
+    labels = (3 * toks + 1) % 16  # deterministic successor
+    it = mx.io.NDArrayIter(toks, labels, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    metric = mx.metric.Perplexity(ignore_label=None)
+    mod.fit(it, num_epoch=10, eval_metric=metric, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.init.Xavier())
+    it_eval = mx.io.NDArrayIter(toks, labels, batch_size=32,
+                                label_name="softmax_label")
+    metric.reset()
+    for batch in it_eval:
+        mod.forward(batch, is_train=False)
+        preds = mod.get_outputs()
+        metric.update([mx.nd.array(b.reshape(-1))
+                       for b in [batch.label[0].asnumpy()]], preds)
+    assert metric.get()[1] < 3.0, metric.get()
