@@ -73,7 +73,7 @@ def _make_op_func(name, op):
 
     op_func.__name__ = name
     op_func.__qualname__ = name
-    op_func.__doc__ = op.doc
+    op_func.__doc__ = op.describe()
     return op_func
 
 

@@ -366,6 +366,7 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
 
     op = _registry.get(op_name)
     attrs = dict(attrs)
+    op.validate_attrs(attrs)
 
     if op.uses_train_mode and "__is_train__" not in attrs:
         attrs["__is_train__"] = autograd.is_training()

@@ -15,5 +15,9 @@ from . import optimizer_ops # noqa: F401
 from . import rnn_ops       # noqa: F401
 from . import contrib_ops   # noqa: F401
 
+# attach the dmlc::Parameter-style per-op parameter declarations
+from . import op_params     # noqa: E402
+op_params.attach_specs(get)
+
 __all__ = ["OpDef", "register", "get", "list_ops", "invoke", "FrozenAttrs",
            "registry"]

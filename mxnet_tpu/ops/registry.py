@@ -77,6 +77,56 @@ class OpDef:
         self.uses_train_mode = uses_train_mode
         self.aliases = tuple(aliases)
         self.doc = doc or (compute.__doc__ or "")
+        # (name, type, default, description) rows attached from
+        # ops/op_params.py — the dmlc::Parameter analogue
+        self.param_specs = None
+
+    def describe(self):
+        """Render the full docstring: op doc + declared parameters +
+        input names (the reference generates frontend docstrings from the
+        registry the same way, ``python/mxnet/ndarray/op.py:174-209``)."""
+        from .op_params import REQUIRED
+        from .op_names import INPUT_NAMES
+
+        parts = [self.doc.strip() or self.name]
+        if self.name in INPUT_NAMES:
+            args, aux = INPUT_NAMES[self.name]
+            parts.append("Inputs:\n" + "\n".join(
+                "    - %s" % a for a in args + aux))
+        if self.param_specs:
+            rows = []
+            for pname, ptype, default, desc in self.param_specs:
+                dflt = "required" if default is REQUIRED \
+                    else "optional, default=%r" % (default,)
+                rows.append("%s : %s (%s)\n    %s"
+                            % (pname, ptype, dflt, desc))
+            parts.append("Parameters\n----------\n" + "\n".join(rows))
+        return "\n\n".join(parts)
+
+    def validate_attrs(self, attrs):
+        """With ``MXNET_STRICT_OP_PARAMS=1``, reject attribute names not
+        declared in the op's parameter spec (reference dmlc::Parameter
+        ``Init`` kwargs checking).  No-op for ops without a spec."""
+        if not self.param_specs:
+            return
+        from ..base import get_env
+
+        if not get_env("MXNET_STRICT_OP_PARAMS", 0, int):
+            return
+        known = {p[0] for p in self.param_specs}
+        from .op_params import REQUIRED
+
+        unknown = [k for k in attrs
+                   if not k.startswith("__") and k not in known]
+        if unknown:
+            raise MXNetError(
+                "%s: unknown parameter(s) %s (declared: %s)"
+                % (self.name, sorted(unknown), sorted(known)))
+        missing = [p[0] for p in self.param_specs
+                   if p[2] is REQUIRED and p[0] not in attrs]
+        if missing:
+            raise MXNetError("%s: missing required parameter(s) %s"
+                             % (self.name, missing))
 
     def count_outputs(self, attrs):
         if callable(self.num_outputs):

@@ -39,6 +39,7 @@ def _make_sym_func(name):
         return _apply(name, sym_inputs, attrs, name=node_name)
 
     sym_func.__name__ = name
+    sym_func.__doc__ = _registry.get(name).describe()
     return sym_func
 
 

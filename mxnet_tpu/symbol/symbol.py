@@ -533,6 +533,7 @@ def _apply(op_name, input_syms, attrs, name=None):
     name = _name_mod.current().get(name or attrs.pop("name", None),
                                    op_name.lower().lstrip("_"))
     attrs.pop("name", None)
+    op.validate_attrs(attrs)
 
     arg_names, aux_names = expected_inputs(op_name, attrs)
     inputs = []

@@ -1,0 +1,58 @@
+"""Per-op parameter declarations (ops/op_params.py — the dmlc::Parameter
+analogue: docstring generation + strict kwargs validation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import get
+from mxnet_tpu.ops.op_params import PARAM_SPECS, REQUIRED
+
+
+def test_specs_attached_and_shared_with_aliases():
+    op = get("Convolution")
+    assert op.param_specs and op.param_specs[0][0] == "kernel"
+    # aliases share the OpDef, hence the spec
+    assert get("MultiHeadAttention").param_specs is \
+        get("_contrib_MultiHeadAttention").param_specs
+
+
+def test_docstrings_render_parameters():
+    doc = mx.nd.Convolution.__doc__
+    assert "Parameters" in doc and "num_filter" in doc and \
+        "required" in doc
+    assert "Inputs:" in doc and "weight" in doc
+    sdoc = mx.sym.FullyConnected.__doc__
+    assert "num_hidden" in sdoc
+
+
+def test_every_spec_names_a_registered_op():
+    for name in PARAM_SPECS:
+        assert get(name) is not None
+
+
+def test_strict_validation(monkeypatch):
+    monkeypatch.setenv("MXNET_STRICT_OP_PARAMS", "1")
+    x = mx.nd.ones((1, 4))
+    # unknown attribute rejected
+    with pytest.raises(mx.MXNetError, match="unknown parameter"):
+        mx.nd.FullyConnected(x, mx.nd.ones((2, 4)), mx.nd.ones((2,)),
+                             num_hidden=2, bogus_flag=1)
+    # missing required rejected
+    with pytest.raises(mx.MXNetError, match="missing required"):
+        mx.nd.FullyConnected(x, mx.nd.ones((2, 4)), mx.nd.ones((2,)))
+    # valid call passes
+    out = mx.nd.FullyConnected(x, mx.nd.ones((2, 4)), mx.nd.ones((2,)),
+                               num_hidden=2)
+    assert out.shape == (1, 2)
+    # symbol path validates too
+    with pytest.raises(mx.MXNetError, match="unknown parameter"):
+        mx.sym.Activation(mx.sym.Variable("d"), act_type="relu",
+                          not_a_param=3)
+
+
+def test_lenient_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_STRICT_OP_PARAMS", raising=False)
+    out = mx.nd.FullyConnected(mx.nd.ones((1, 4)), mx.nd.ones((2, 4)),
+                               mx.nd.ones((2,)), num_hidden=2,
+                               cudnn_off=True)  # ignored, not fatal
+    assert out.shape == (1, 2)
