@@ -1,0 +1,58 @@
+/* C predictor ABI — the deployment surface for C/C++ applications.
+ *
+ * Mirrors the reference include/mxnet/c_predict_api.h function surface
+ * (create from symbol-json + parameter blob, set inputs, forward, read
+ * outputs) so applications written against it port by relinking.  The
+ * implementation (src/c_predict_api.cc) embeds CPython and drives the
+ * XLA-compiled predictor; build it once via:
+ *
+ *   python -c "from mxnet_tpu import _native; _native._load('c_predict_api')"
+ *
+ * then link your program against mxnet_tpu/_build/c_predict_api.so with
+ * MXNET_TPU_HOME pointing at the framework checkout.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+/* Last error message for the calling thread (reference MXGetLastError). */
+const char *MXGetLastError();
+
+/* Create a predictor from a symbol JSON string and a parameter blob (the
+ * bytes of a prefix-%04d.params file).  input_shape_indptr partitions
+ * input_shape_data into one shape tuple per input key.  dev_type/dev_id
+ * accepted for parity; XLA owns placement. */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/* Copy a row-major float buffer into the named input. */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/* Run the compiled forward program. */
+int MXPredForward(PredictorHandle handle);
+
+/* Shape of output `index` (valid after MXPredForward; borrowed memory). */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy output `index` into a caller buffer of `size` floats. */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

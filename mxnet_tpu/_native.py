@@ -26,6 +26,24 @@ _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_build")
 
 
+def _python_embed_flags():
+    """Compiler/linker flags for embedding CPython (the c_predict_api
+    build); via python3-config --embed."""
+    import sysconfig
+
+    inc = "-I" + sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    return [inc], ["-L" + libdir, "-lpython" + ver]
+
+
+_EXTRA_FLAGS = {
+    # name -> (extra compile flags, extra link flags)
+    "c_predict_api": _python_embed_flags,
+}
+
+
 def _load(name):
     """Compile (if stale) and dlopen src/<name>.cc; returns CDLL or
     None."""
@@ -40,9 +58,12 @@ def _load(name):
                 if not os.path.exists(so) or \
                         os.path.getmtime(so) < os.path.getmtime(src):
                     os.makedirs(_BUILD_DIR, exist_ok=True)
+                    cflags, ldflags = ([], [])
+                    if name in _EXTRA_FLAGS:
+                        cflags, ldflags = _EXTRA_FLAGS[name]()
                     subprocess.run(
-                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                         "-o", so, src],
+                        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+                        + cflags + ["-o", so, src] + ldflags,
                         check=True, capture_output=True, timeout=120)
                 lib = ctypes.CDLL(so)
         except (OSError, subprocess.SubprocessError):

@@ -19,7 +19,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -350,6 +351,104 @@ class CSVIter(NDArrayIter):
             if label.shape[1:] == (1,):
                 label = label.ravel()
         super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format source yielding CSR data batches (reference
+    ``src/io/iter_libsvm.cc``): lines of ``label idx:val idx:val ...``.
+    ``data_libsvm`` may also carry sparse labels (``label_libsvm`` for a
+    separate label file).  Batches pad the tail like NDArrayIter
+    (``batch.pad`` rows repeated from the front)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, data_name="data",
+                 label_name="softmax_label", part_index=0, num_parts=1,
+                 **kwargs):
+        super().__init__(batch_size)
+        from .ndarray.sparse import csr_matrix
+
+        self._data_name = data_name
+        self._label_name = label_name
+        ncol = int(data_shape[-1] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        vals, cols, indptr, labels = self._parse(data_libsvm, ncol)
+        if label_libsvm is not None:
+            lcol = int(label_shape[-1] if isinstance(
+                label_shape, (tuple, list)) else (label_shape or 1))
+            lv, lc, lp, _ = self._parse(label_libsvm, lcol)
+            dense_lab = np.zeros((len(lp) - 1, lcol), "float32")
+            for r in range(len(lp) - 1):
+                dense_lab[r, lc[lp[r]:lp[r + 1]]] = lv[lp[r]:lp[r + 1]]
+            labels = dense_lab.squeeze()
+        n = len(indptr) - 1
+        if num_parts > 1:  # sharded reading, same contract as the C iter
+            per = n // num_parts
+            lo, hi = part_index * per, (part_index + 1) * per \
+                if part_index < num_parts - 1 else n
+            sel = range(lo, hi)
+            vals, cols, indptr, labels = self._take(vals, cols, indptr,
+                                                    labels, sel)
+            n = len(indptr) - 1
+        self._vals, self._cols, self._indptr = vals, cols, indptr
+        self._labels = np.asarray(labels, "float32")
+        self._ncol = ncol
+        self._num = n
+        self._csr = csr_matrix
+        self.reset()
+
+    @staticmethod
+    def _parse(path, ncol):
+        vals, cols, indptr, labels = [], [], [0], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    cols.append(int(i))
+                    vals.append(float(v))
+                indptr.append(len(cols))
+        return (np.asarray(vals, "float32"), np.asarray(cols, "int32"),
+                np.asarray(indptr, "int64"), np.asarray(labels, "float32"))
+
+    @staticmethod
+    def _take(vals, cols, indptr, labels, rows):
+        nv, nc, np_ = [], [], [0]
+        for r in rows:
+            nv.extend(vals[indptr[r]:indptr[r + 1]])
+            nc.extend(cols[indptr[r]:indptr[r + 1]])
+            np_.append(len(nc))
+        return (np.asarray(nv, "float32"), np.asarray(nc, "int32"),
+                np.asarray(np_, "int64"), labels[list(rows)])
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name, (self.batch_size, self._ncol))]
+
+    @property
+    def provide_label(self):
+        lshape = (self.batch_size,) + tuple(self._labels.shape[1:])
+        return [DataDesc(self._label_name, lshape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= self._num:
+            raise StopIteration
+        rows = [(self._cursor + i) % self._num
+                for i in range(self.batch_size)]
+        pad = max(0, self._cursor + self.batch_size - self._num)
+        vals, cols, indptr, labels = self._take(
+            self._vals, self._cols, self._indptr, self._labels, rows)
+        data = self._csr((vals, cols, indptr),
+                         shape=(self.batch_size, self._ncol))
+        from .ndarray import array
+
+        self._cursor += self.batch_size
+        return DataBatch(data=[data], label=[array(labels)], pad=pad)
 
 
 class MNISTIter(NDArrayIter):

@@ -1,0 +1,265 @@
+// C predictor ABI — native shared library for serving from C/C++.
+//
+// Reference contract: include/mxnet/c_predict_api.h + src/c_api/
+// c_predict_api.cc (the deployment-only surface the amalgamation build
+// ships to mobile): create a predictor from a symbol-JSON string and a
+// parameter blob, set named inputs, forward, read outputs.  Same function
+// names and calling shapes here, so C/C++ applications written against
+// the reference's predictor ABI port by relinking.
+//
+// TPU-native design: the compute path is XLA via the Python package (the
+// framework's executor already compiles the bound graph to one program),
+// so this library embeds CPython and drives mxnet_tpu.predictor through
+// the CPython C API — the inverse layering of the reference (Python over
+// C++), which is the right inversion for a stack whose runtime IS
+// jax/XLA.  No pybind11 (not in the image): plain Python C API.
+//
+// Build (see mxnet_tpu/_native.py): g++ -shared -fPIC c_predict_api.cc
+//   $(python3-config --includes) $(python3-config --ldflags --embed)
+//
+// Thread-safety: calls are serialized through the GIL.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+typedef void *PredictorHandle;
+
+static thread_local std::string g_last_error;
+
+struct MXPredictor {
+  PyObject *predictor;              // mxnet_tpu.predictor.Predictor
+  std::vector<std::vector<mx_uint>> out_shapes;
+};
+
+static void set_error(const char *msg) { g_last_error = msg ? msg : ""; }
+
+static void set_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  set_error(s ? PyUnicode_AsUTF8(s) : "unknown python error");
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+static bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  // make the framework importable: MXNET_TPU_HOME, else the cwd
+  PyGILState_STATE g = PyGILState_Ensure();
+  const char *home = std::getenv("MXNET_TPU_HOME");
+  std::string code = "import sys, os\n";
+  if (home) {
+    code += std::string("p = r'''") + home + "'''\n";
+  } else {
+    code += "p = os.getcwd()\n";
+  }
+  code +=
+      "if p not in sys.path:\n"
+      "    sys.path.insert(0, p)\n";
+  int rc = PyRun_SimpleString(code.c_str());
+  PyGILState_Release(g);
+  return rc == 0;
+}
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+// Reference signature: c_predict_api.h MXPredCreate.  input_shape_indptr
+// partitions input_shape_data into per-input shape tuples.
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  (void)dev_type;
+  (void)dev_id;  // device selection is the runtime's job under XLA
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ret = -1;
+  PyObject *mod = nullptr, *cls = nullptr, *shapes = nullptr,
+           *params = nullptr, *pred = nullptr, *json = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (!mod) break;
+    cls = PyObject_GetAttrString(mod, "Predictor");
+    if (!cls) break;
+    shapes = PyDict_New();
+    for (mx_uint i = 0; i < num_input_nodes; ++i) {
+      mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject *shp = PyTuple_New(hi - lo);
+      for (mx_uint j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(shp, j - lo,
+                         PyLong_FromUnsignedLong(input_shape_data[j]));
+      PyDict_SetItemString(shapes, input_keys[i], shp);
+      Py_DECREF(shp);
+    }
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char *>(param_bytes), param_size);
+    json = PyUnicode_FromString(symbol_json_str);
+    if (!params || !json) break;
+    pred = PyObject_CallFunctionObjArgs(cls, json, params, shapes, NULL);
+    if (!pred) break;
+    MXPredictor *h = new MXPredictor();
+    h->predictor = pred;
+    pred = nullptr;
+    *out = h;
+    ret = 0;
+  } while (false);
+  if (ret != 0) set_py_error();
+  Py_XDECREF(json);
+  Py_XDECREF(params);
+  Py_XDECREF(shapes);
+  Py_XDECREF(cls);
+  Py_XDECREF(mod);
+  Py_XDECREF(pred);
+  PyGILState_Release(g);
+  return ret;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const float *data, mx_uint size) {
+  MXPredictor *h = static_cast<MXPredictor *>(handle);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ret = -1;
+  // hand the flat buffer over as a python list -> numpy reshape happens
+  // inside Predictor.set_input via mx.nd.array
+  PyObject *lst = PyList_New(size);
+  for (mx_uint i = 0; i < size; ++i)
+    PyList_SET_ITEM(lst, i, PyFloat_FromDouble(data[i]));
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *arr = nullptr, *shaped = nullptr, *res = nullptr;
+  do {
+    if (!np) break;
+    arr = PyObject_CallMethod(np, "asarray", "Os", lst, "float32");
+    if (!arr) break;
+    // reshape to the declared input shape
+    PyObject *shapes =
+        PyObject_GetAttrString(h->predictor, "_input_shapes");
+    PyObject *shp = shapes ? PyDict_GetItemString(shapes, key) : nullptr;
+    if (shp) {
+      shaped = PyObject_CallMethod(arr, "reshape", "O", shp);
+    } else {
+      shaped = arr;
+      Py_INCREF(arr);
+    }
+    Py_XDECREF(shapes);
+    if (!shaped) break;
+    res = PyObject_CallMethod(h->predictor, "set_input", "sO", key,
+                              shaped);
+    if (!res) break;
+    ret = 0;
+  } while (false);
+  if (ret != 0) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(shaped);
+  Py_XDECREF(arr);
+  Py_XDECREF(np);
+  Py_XDECREF(lst);
+  PyGILState_Release(g);
+  return ret;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  MXPredictor *h = static_cast<MXPredictor *>(handle);
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject *res = PyObject_CallMethod(h->predictor, "forward", NULL);
+  int ret = res ? 0 : -1;
+  if (!res) set_py_error();
+  Py_XDECREF(res);
+  // refresh cached output shapes
+  if (ret == 0) {
+    h->out_shapes.clear();
+    PyObject *exec = PyObject_GetAttrString(h->predictor, "_exec");
+    PyObject *outs =
+        exec ? PyObject_GetAttrString(exec, "outputs") : nullptr;
+    if (outs) {
+      Py_ssize_t n = PyList_Size(outs);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *shape =
+            PyObject_GetAttrString(PyList_GetItem(outs, i), "shape");
+        std::vector<mx_uint> dims;
+        if (shape) {
+          Py_ssize_t nd = PyTuple_Size(shape);
+          for (Py_ssize_t d = 0; d < nd; ++d)
+            dims.push_back(static_cast<mx_uint>(
+                PyLong_AsUnsignedLong(PyTuple_GetItem(shape, d))));
+        }
+        Py_XDECREF(shape);
+        h->out_shapes.push_back(dims);
+      }
+    }
+    Py_XDECREF(outs);
+    Py_XDECREF(exec);
+  }
+  PyGILState_Release(g);
+  return ret;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  MXPredictor *h = static_cast<MXPredictor *>(handle);
+  if (index >= h->out_shapes.size()) {
+    set_error("output index out of range (call MXPredForward first)");
+    return -1;
+  }
+  *shape_data = h->out_shapes[index].data();
+  *shape_ndim = static_cast<mx_uint>(h->out_shapes[index].size());
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float *data,
+                    mx_uint size) {
+  MXPredictor *h = static_cast<MXPredictor *>(handle);
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ret = -1;
+  PyObject *out = nullptr, *flat = nullptr, *lst = nullptr;
+  do {
+    out = PyObject_CallMethod(h->predictor, "get_output", "I", index);
+    if (!out) break;
+    flat = PyObject_CallMethod(out, "ravel", NULL);
+    if (!flat) break;
+    lst = PyObject_CallMethod(flat, "tolist", NULL);
+    if (!lst) break;
+    Py_ssize_t n = PyList_Size(lst);
+    if (static_cast<mx_uint>(n) != size) {
+      set_error("output size mismatch");
+      break;
+    }
+    for (Py_ssize_t i = 0; i < n; ++i)
+      data[i] = static_cast<float>(
+          PyFloat_AsDouble(PyList_GetItem(lst, i)));
+    ret = 0;
+  } while (false);
+  if (ret != 0 && g_last_error.empty()) set_py_error();
+  Py_XDECREF(lst);
+  Py_XDECREF(flat);
+  Py_XDECREF(out);
+  PyGILState_Release(g);
+  return ret;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  MXPredictor *h = static_cast<MXPredictor *>(handle);
+  if (h) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_XDECREF(h->predictor);
+    PyGILState_Release(g);
+    delete h;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -177,3 +177,92 @@ def test_export_model_cli(tmp_path):
     assert res.returncode == 0, res.stderr
     served = mx.Predictor.load_exported(out)
     assert served.forward(data=X[:10])[0].shape == (10, 3)
+
+
+def test_c_predict_api(tmp_path):
+    """Build src/c_predict_api.cc, compile a C client against the shipped
+    header, and serve a checkpoint from C — the reference's
+    c_predict_api.cc contract (create/set-input/forward/get-output)."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    from mxnet_tpu import _native
+
+    lib = _native._load("c_predict_api")
+    if lib is None:
+        pytest.skip("c_predict_api did not build (no libpython?)")
+
+    prefix, X, mod = _train_tiny(tmp_path)
+    # reference clients read the raw files
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    ref = mx.Predictor.load(prefix, 5, {"data": (4, 6)})
+    ref.set_input("data", X[:4])
+    expected = ref.forward()[0].asnumpy()
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    c_src = tmp_path / "client.c"
+    c_src.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxnet_tpu/c_predict_api.h"
+
+int main(int argc, char** argv) {
+    FILE* f = fopen(argv[1], "r");           /* symbol json */
+    char* json = (char*)malloc(1 << 20);
+    size_t n = fread(json, 1, 1 << 20, f); json[n] = 0; fclose(f);
+    f = fopen(argv[2], "rb");                /* params blob */
+    char* params = (char*)malloc(1 << 24);
+    long psize = (long)fread(params, 1, 1 << 24, f); fclose(f);
+    f = fopen(argv[3], "rb");                /* input floats */
+    float in[24];
+    if (fread(in, sizeof(float), 24, f) != 24) return 9;
+    fclose(f);
+
+    const char* keys[] = {"data"};
+    mx_uint indptr[] = {0, 2};
+    mx_uint shape[] = {4, 6};
+    PredictorHandle h;
+    if (MXPredCreate(json, params, (int)psize, 1, 0, 1, keys, indptr,
+                     shape, &h)) {
+        fprintf(stderr, "create: %s\n", MXGetLastError()); return 1;
+    }
+    if (MXPredSetInput(h, "data", in, 24)) {
+        fprintf(stderr, "set: %s\n", MXGetLastError()); return 2;
+    }
+    if (MXPredForward(h)) {
+        fprintf(stderr, "fwd: %s\n", MXGetLastError()); return 3;
+    }
+    mx_uint *oshape, ondim;
+    if (MXPredGetOutputShape(h, 0, &oshape, &ondim)) return 4;
+    if (ondim != 2 || oshape[0] != 4 || oshape[1] != 3) return 5;
+    float out[12];
+    if (MXPredGetOutput(h, 0, out, 12)) {
+        fprintf(stderr, "get: %s\n", MXGetLastError()); return 6;
+    }
+    for (int i = 0; i < 12; i++) printf("%.6f\n", out[i]);
+    MXPredFree(h);
+    return 0;
+}
+''')
+    exe = tmp_path / "client"
+    so = os.path.join(repo, "mxnet_tpu", "_build", "c_predict_api.so")
+    res = subprocess.run(
+        ["g++", str(c_src), so, "-I", os.path.join(repo, "include"),
+         "-o", str(exe)], capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+
+    X[:4].astype("float32").tofile(tmp_path / "input.bin")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_HOME=os.path.abspath(repo),
+               LD_LIBRARY_PATH=os.path.dirname(so))
+    res = subprocess.run(
+        [str(exe), prefix + "-symbol.json", prefix + "-0005.params",
+         str(tmp_path / "input.bin")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert res.returncode == 0, (res.returncode, res.stderr)
+    got = np.array([float(x) for x in res.stdout.split()],
+                   "float32").reshape(4, 3)
+    # the C process runs with default matmul precision (no conftest)
+    np.testing.assert_allclose(got, expected, rtol=5e-3, atol=1e-3)

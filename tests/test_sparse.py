@@ -200,3 +200,69 @@ def test_matrix_factorization_with_sparse_grads():
                 (gv, vv), shape=(n_items, k)), stV)
     l1 = loss()
     assert l1 < 0.3 * l0, (l0, l1)
+
+
+def test_libsvm_iter(tmp_path):
+    # 5 rows, 6 features, libsvm format
+    path = tmp_path / "train.libsvm"
+    path.write_text(
+        "1 0:1.5 3:2.0\n"
+        "0 1:0.5\n"
+        "1 2:3.0 5:1.0\n"
+        "0 0:0.25 4:0.75\n"
+        "1 3:1.25\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(6,),
+                          batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert isinstance(b0.data[0], sparse.CSRNDArray)
+    dense = b0.data[0].asnumpy()
+    np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0, 0, 0])
+    np.testing.assert_allclose(np.asarray(b0.label[0].asnumpy()), [1, 0])
+    # tail batch pads by wrapping and reports pad count
+    assert batches[2].pad == 1
+    np.testing.assert_allclose(batches[2].data[0].asnumpy()[0],
+                               [0, 0, 0, 1.25, 0, 0])
+    # sharded reading
+    it0 = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(6,),
+                           batch_size=2, part_index=0, num_parts=2)
+    it1 = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(6,),
+                           batch_size=2, part_index=1, num_parts=2)
+    n0 = sum(b.data[0].shape[0] - (b.pad or 0) for b in it0)
+    n1 = sum(b.data[0].shape[0] - (b.pad or 0) for b in it1)
+    assert n0 + n1 == 5
+
+
+def test_libsvm_iter_trains_sparse_dot(tmp_path):
+    rs = np.random.RandomState(3)
+    lines = []
+    w_true = rs.randn(8)
+    for _ in range(64):
+        idx = rs.choice(8, 3, replace=False)
+        vals = rs.rand(3)
+        y = 1.0 if (np.sum(w_true[idx] * vals)) > 0 else 0.0
+        lines.append("%d %s" % (y, " ".join(
+            "%d:%.4f" % (i, v) for i, v in sorted(zip(idx, vals)))))
+    path = tmp_path / "t.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(8,),
+                          batch_size=16)
+    w = mx.nd.zeros((8, 1))
+    for _ in range(40):
+        it.reset()
+        for batch in it:
+            logits = sparse.dot(batch.data[0], w)
+            p = 1.0 / (1.0 + np.exp(-logits.asnumpy().ravel()))
+            g = batch.data[0].asnumpy().T @ (
+                p - batch.label[0].asnumpy()).reshape(-1, 1) / 16.0
+            w[:] = w - mx.nd.array(g.astype("float32"))
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        keep = batch.data[0].shape[0] - (batch.pad or 0)
+        p = sparse.dot(batch.data[0], w).asnumpy().ravel()[:keep]
+        correct += (((p > 0) == (batch.label[0].asnumpy()[:keep] > 0.5))
+                    .sum())
+        total += keep
+    assert correct / total > 0.9
