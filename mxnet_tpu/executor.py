@@ -137,6 +137,12 @@ class Executor:
         self._jit_fwd_vjp = jax.jit(fwd_vjp)
         self._jit_bwd = jax.jit(bwd)
         self._last_vjp = None  # (vjp Partial, new_aux dict)
+        # graphs holding a mesh-spanning program (shard_map, e.g.
+        # seq_parallel attention) need inputs replicated over the mesh
+        # rather than committed to this executor's single device
+        self._spans_mesh = any(
+            n.op is not None and n.op.spans_mesh is not None
+            and n.op.spans_mesh(n.attrs) for n in sym._topo())
 
     # ------------------------------------------------------------------
     @property
@@ -168,6 +174,17 @@ class Executor:
             tgt._set_data(buf)
         args = {n: a._data for n, a in self.arg_dict.items()}
         aux = {n: a._data for n, a in self.aux_dict.items()}
+        if self._spans_mesh:
+            from .parallel import current_mesh
+
+            mesh = current_mesh()
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(mesh, PartitionSpec())
+                args = {n: jax.device_put(a, repl)
+                        for n, a in args.items()}
+                aux = {n: jax.device_put(a, repl) for n, a in aux.items()}
         rng = _random.next_key()
         from .base import get_env
 
@@ -189,6 +206,12 @@ class Executor:
             outs, new_aux = fn(args, aux, rng)
             if is_train:
                 self._train_fwd_ran = True
+        if self._spans_mesh:
+            # bring results back to this executor's device so downstream
+            # imperative ops (metrics, updaters) see single-device arrays
+            outs = tuple(jax.device_put(o, dev) for o in outs)
+            new_aux = {n: jax.device_put(v, dev)
+                       for n, v in new_aux.items()}
         if is_train:
             for n, v in new_aux.items():
                 self.aux_dict[n]._set_data(v)
@@ -227,7 +250,24 @@ class Executor:
                 jnp.ones_like(o) if g is None else
                 (g._data if isinstance(g, NDArray) else jnp.asarray(g))
                 for o, g in zip(out_shapes, out_grads))
+        if self._spans_mesh:
+            from .parallel import current_mesh
+
+            mesh = current_mesh()
+            if mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(mesh, PartitionSpec())
+                heads = tuple(jax.device_put(h, repl) for h in heads)
+                new_aux = {n: jax.device_put(v, repl)
+                           for n, v in new_aux.items()}
         grads = self._jit_bwd(vjp, heads, new_aux)
+        if self._spans_mesh:
+            import jax
+
+            dev = self._ctx.jax_device
+            grads = {n: jax.device_put(g, dev) for n, g in grads.items()}
         for n, g in grads.items():
             tgt = self.grad_dict.get(n)
             if tgt is None:

@@ -15,10 +15,12 @@ from __future__ import annotations
 from .. import symbol as sym
 
 
-def transformer_block(x, idx, d_model, num_heads, d_ff):
+def transformer_block(x, idx, d_model, num_heads, d_ff,
+                      seq_parallel=False):
     """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
     h = sym.LayerNorm(x, name="blk%d_ln1" % idx)
     h = sym.MultiHeadAttention(h, num_heads=num_heads, causal=True,
+                               seq_parallel=seq_parallel,
                                name="blk%d_attn" % idx)
     x = x + h
     h = sym.LayerNorm(x, name="blk%d_ln2" % idx)
@@ -31,7 +33,10 @@ def transformer_block(x, idx, d_model, num_heads, d_ff):
 
 
 def get_symbol(vocab_size=32000, num_layers=12, d_model=768, num_heads=12,
-               d_ff=None, seq_len=1024, **kwargs):
+               d_ff=None, seq_len=1024, seq_parallel=False, **kwargs):
+    """``seq_parallel=True`` runs every attention via ring attention over
+    the active mesh's 'seq' axis (long-context training: T shards over
+    chips, K/V rotate on ICI)."""
     d_ff = d_ff or 4 * d_model
     data = sym.Variable("data")          # (N, T) token ids
     label = sym.Variable("softmax_label")
@@ -41,7 +46,8 @@ def get_symbol(vocab_size=32000, num_layers=12, d_model=768, num_heads=12,
                        init="normal")
     x = sym.broadcast_add(x, pos)
     for i in range(num_layers):
-        x = transformer_block(x, i, d_model, num_heads, d_ff)
+        x = transformer_block(x, i, d_model, num_heads, d_ff,
+                              seq_parallel=seq_parallel)
     x = sym.LayerNorm(x, name="final_ln")
     x = sym.Reshape(x, shape=(-1, d_model))
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,

@@ -373,6 +373,18 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
 
     in_arrays = [x._data if isinstance(x, NDArray) else _as_jax(x)
                  for x in inputs]
+    if op.spans_mesh is not None and op.spans_mesh(attrs):
+        # the compute holds a shard_map over the active mesh: inputs must
+        # live replicated on ALL mesh devices, not committed to one
+        from ..parallel import current_mesh
+
+        mesh = current_mesh()
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(mesh, PartitionSpec())
+            in_arrays = [jax.device_put(a, repl) for a in in_arrays]
     rng_key = None
     if op.needs_rng:
         rng_key = _random.next_key()

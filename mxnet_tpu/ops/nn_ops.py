@@ -822,7 +822,8 @@ def _identity_kl(attrs, x):
 # mxnet_tpu/operator.py — reference src/operator/custom/custom.cc.
 
 
-@register("_contrib_MultiHeadAttention", aliases=("MultiHeadAttention",))
+@register("_contrib_MultiHeadAttention", aliases=("MultiHeadAttention",),
+          spans_mesh=lambda attrs: bool(attrs.get("seq_parallel", False)))
 def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
                           out_bias):
     """Fused causal multi-head self-attention.  Not in the 0.11 reference
@@ -844,12 +845,27 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
         return x.reshape(n, t, num_heads, d).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32)
-    scores = scores / (d ** 0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        scores = jnp.where(mask, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    ctx = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+    if bool(attrs.get("seq_parallel", False)):
+        # long-context path: shard T over the active mesh's 'seq' axis
+        # and run ring attention (K/V rotate over ICI, O(T_local^2/ring)
+        # peak memory per chip) — parallel/sequence.py
+        from ..parallel import current_mesh
+        from ..parallel.sequence import sequence_parallel_attention
+
+        mesh = current_mesh()
+        if mesh is None or "seq" not in mesh.shape:
+            raise MXNetError(
+                "MultiHeadAttention(seq_parallel=True) needs an active "
+                "mesh with a 'seq' axis (parallel.mesh_scope)")
+        ctx = sequence_parallel_attention(q, k, v, causal=causal,
+                                          mesh=mesh)
+    else:
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32)
+        scores = scores / (d ** 0.5)
+        if causal:
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
     return jnp.einsum("ntc,oc->nto", ctx, out_weight) + out_bias

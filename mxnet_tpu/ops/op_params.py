@@ -145,6 +145,9 @@ PARAM_SPECS.update({
     "MultiHeadAttention": [
         ("num_heads", "int", REQUIRED, "Attention head count."),
         ("causal", "bool", True, "Apply the causal (autoregressive) mask."),
+        ("seq_parallel", "bool", False,
+         "Ring attention over the active mesh's 'seq' axis "
+         "(long-context: shard T over chips, rotate K/V on ICI)."),
     ],
     "LayerNorm": [
         ("eps", "float", 1e-5, "Variance epsilon."),

@@ -66,7 +66,7 @@ class OpDef:
 
     def __init__(self, name, compute, num_outputs=1, needs_rng=False,
                  mutable_inputs=(), uses_train_mode=False, aliases=(),
-                 doc=None):
+                 doc=None, spans_mesh=None):
         self.name = name
         self.compute = compute
         # int, or callable(attrs)->int for attr-dependent output counts
@@ -80,6 +80,10 @@ class OpDef:
         # (name, type, default, description) rows attached from
         # ops/op_params.py — the dmlc::Parameter analogue
         self.param_specs = None
+        # predicate(attrs) -> True when this op's compute contains a
+        # mesh-spanning program (shard_map): imperative inputs must be
+        # replicated over the active mesh, not committed to one device
+        self.spans_mesh = spans_mesh
 
     def describe(self):
         """Render the full docstring: op doc + declared parameters +
