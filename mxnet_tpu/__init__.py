@@ -51,6 +51,7 @@ from . import rnn
 from . import parallel
 from . import test_utils
 from .model import save_checkpoint, load_checkpoint
+from . import models
 from . import name
 from . import libinfo
 from . import executor_manager

@@ -8,7 +8,8 @@ from ...base import MXNetError
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "InstanceNorm", "LayerNorm", "Embedding", "Flatten", "Activation",
-           "LeakyReLU", "Lambda", "HybridLambda"]
+           "LeakyReLU", "Lambda", "HybridLambda", "MultiHeadAttention",
+           "TransformerBlock"]
 
 
 class Sequential(Block):
@@ -269,3 +270,73 @@ class Lambda(Block):
 
 
 HybridLambda = Lambda
+
+
+class MultiHeadAttention(HybridBlock):
+    """Causal multi-head self-attention over the fused
+    ``MultiHeadAttention`` op (the Gluon face of the transformer family;
+    ``seq_parallel=True`` rides ring attention over the mesh's 'seq'
+    axis — see ``parallel/sequence.py``)."""
+
+    def __init__(self, num_heads, causal=True, seq_parallel=False,
+                 in_units=0, weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._num_heads = num_heads
+        self._causal = causal
+        self._seq_parallel = seq_parallel
+        with self.name_scope():
+            self.in_weight = self.params.get(
+                "in_weight", shape=(3 * in_units, in_units),
+                init=weight_initializer, allow_deferred_init=True)
+            self.in_bias = self.params.get(
+                "in_bias", shape=(3 * in_units,), init="zeros",
+                allow_deferred_init=True)
+            self.out_weight = self.params.get(
+                "out_weight", shape=(in_units, in_units),
+                init=weight_initializer, allow_deferred_init=True)
+            self.out_bias = self.params.get(
+                "out_bias", shape=(in_units,), init="zeros",
+                allow_deferred_init=True)
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        c = x.shape[-1]
+        for p, shp in ((self.in_weight, (3 * c, c)),
+                       (self.in_bias, (3 * c,)),
+                       (self.out_weight, (c, c)),
+                       (self.out_bias, (c,))):
+            if p._data is None:
+                p._shape_from_data(shp)
+        return nd.MultiHeadAttention(
+            x, self.in_weight.data(), self.in_bias.data(),
+            self.out_weight.data(), self.out_bias.data(),
+            num_heads=self._num_heads, causal=self._causal,
+            seq_parallel=self._seq_parallel)
+
+
+class TransformerBlock(HybridBlock):
+    """Pre-norm decoder block: x + MHA(LN(x)); x + FFN(LN(x)) with GELU
+    (mirrors ``models/transformer.transformer_block`` on the Gluon
+    side)."""
+
+    def __init__(self, d_model, num_heads, d_ff=None, seq_parallel=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        d_ff = d_ff or 4 * d_model
+        with self.name_scope():
+            self.ln1 = LayerNorm()
+            self.attn = MultiHeadAttention(num_heads,
+                                           seq_parallel=seq_parallel)
+            self.ln2 = LayerNorm()
+            self.ffn1 = Dense(d_ff, flatten=False)
+            self.ffn2 = Dense(d_model, flatten=False)
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        h = self.attn(self.ln1(x))
+        x = x + h
+        h = self.ffn1(self.ln2(x))
+        h = nd.Activation(h, act_type="gelu")
+        return x + self.ffn2(h)

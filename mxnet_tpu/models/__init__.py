@@ -11,12 +11,15 @@ from . import alexnet
 from . import vgg
 from . import resnet
 from . import inception_bn
+from . import mobilenet
+from . import transformer
 
 __all__ = ["lenet", "mlp", "alexnet", "vgg", "resnet", "inception_bn",
-           "get_model"]
+           "mobilenet", "transformer", "get_model"]
 
 _MODELS = {m.__name__.rsplit(".", 1)[-1]: m.get_symbol
-           for m in (lenet, mlp, alexnet, vgg, resnet, inception_bn)}
+           for m in (lenet, mlp, alexnet, vgg, resnet, inception_bn,
+                     mobilenet, transformer)}
 
 
 def get_model(name, **kwargs):

@@ -219,3 +219,52 @@ def test_clip_global_norm():
     norm = gluon.utils.clip_global_norm(arrays, 1.0)
     total = sum(float((a * a).sum().asscalar()) for a in arrays)
     assert abs(total - 1.0) < 1e-4
+
+
+def test_gluon_transformer_block_trains():
+    """Gluon face of the transformer family (nn.MultiHeadAttention /
+    nn.TransformerBlock) trains a tiny LM with Trainer."""
+    from mxnet_tpu import autograd
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 16, (128, 8)).astype("float32")
+    labels = ((3 * toks + 1) % 16).astype("int64")
+
+    net = nn.Sequential()
+    net.add(nn.Embedding(16, 16))
+    net.add(nn.TransformerBlock(16, 2))
+    net.add(nn.Dense(16, flatten=False))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    first = last = None
+    for epoch in range(8):
+        total = 0.0
+        for i in range(0, 128, 32):
+            x = mx.nd.array(toks[i:i + 32])
+            y = mx.nd.array(labels[i:i + 32].reshape(-1))
+            with autograd.record():
+                out = net(x).reshape((-1, 16))
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(32)
+            total += float(loss.asnumpy().mean())
+        if first is None:
+            first = total
+        last = total
+    assert last < first * 0.5, (first, last)
+
+
+def test_gluon_mha_matches_symbolic_op():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 5, 8).astype("float32")
+    layer = nn.MultiHeadAttention(num_heads=2)
+    layer.initialize(mx.init.Xavier())
+    out = layer(mx.nd.array(x))
+    ref = mx.nd.MultiHeadAttention(
+        mx.nd.array(x), layer.in_weight.data(), layer.in_bias.data(),
+        layer.out_weight.data(), layer.out_bias.data(),
+        num_heads=2).asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)

@@ -67,3 +67,31 @@ def test_lenet_trains_on_synthetic_mnist():
             num_epoch=25, initializer=mx.initializer.Xavier())
     acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")[0][1]
     assert acc > 0.9, acc
+
+
+def test_mobilenet_forward_and_grad():
+    sym = mx.models.mobilenet.get_symbol(num_classes=10, multiplier=0.25)
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(2, 3, 224, 224),
+                         softmax_label=(2,))
+    for n, a in ex.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a[:] = mx.nd.array(
+                np.random.RandomState(0).uniform(
+                    -0.05, 0.05, a.shape).astype("float32"))
+    ex.arg_dict["data"][:] = mx.nd.ones((2, 3, 224, 224))
+    ex.forward(is_train=True)
+    assert ex.outputs[0].shape == (2, 10)
+    probs = ex.outputs[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+    ex.backward()
+    g = ex.grad_dict["conv2_dw_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_get_model_registry_covers_new_families():
+    assert mx.models.get_model("mobilenet", num_classes=10) is not None
+    assert mx.models.get_model("transformer", vocab_size=32,
+                               num_layers=1, d_model=16, num_heads=2,
+                               seq_len=8) is not None
+    with pytest.raises(mx.MXNetError):
+        mx.models.get_model("nope")
