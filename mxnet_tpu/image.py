@@ -514,3 +514,12 @@ class ImageIter(DataIter):
 
     def getpad(self):
         return self._next_batch.pad
+
+
+# detection pipeline (reference image/detection.py) — imported last to
+# avoid a circular import, re-exported here so the reference's
+# ``mx.image.ImageDetIter`` spelling works
+from .image_detection import (ImageDetIter, CreateDetAugmenter,  # noqa: E402
+                              DetAugmenter, DetBorrowAug,
+                              DetHorizontalFlipAug, DetRandomCropAug,
+                              DetRandomPadAug, DetRandomSelectAug)

@@ -1,0 +1,291 @@
+"""Detection data pipeline: box-aware augmenters + ``ImageDetIter``
+(reference ``python/mxnet/image/detection.py`` and the det augmenter
+chain ``src/io/image_det_aug_default.cc``).
+
+Labels are object lists ``(cls, x1, y1, x2, y2)`` with corner coordinates
+normalized to [0, 1].  Geometric augmenters transform the boxes with the
+pixels (flip mirrors x; crop re-normalizes into the crop window and drops
+objects whose center leaves it; pad re-normalizes outward).  Batches pad
+the object axis with ``-1`` rows to the iterator's ``max_objects`` —
+static shapes for XLA, the same padding contract the contrib MultiBox*
+ops consume.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from .base import MXNetError
+from .image import (Augmenter, CreateAugmenter, ImageIter, fixed_crop,
+                    imresize)
+from .io import DataBatch, DataDesc
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Base: ``__call__(src, label) -> (src, label)``; label (N, 5)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only augmenter (color jitter etc.) — boxes pass
+    through (reference ``DetBorrowAug``)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if random.random() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Sample a crop window satisfying the min-overlap constraint and
+    re-normalize surviving boxes (objects keep membership by center,
+    reference ``DetRandomCropAug``)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _overlap(self, box, crop):
+        ix1 = max(box[0], crop[0]); iy1 = max(box[1], crop[1])
+        ix2 = min(box[2], crop[2]); iy2 = min(box[3], crop[3])
+        iw = max(0.0, ix2 - ix1); ih = max(0.0, iy2 - iy1)
+        area = (box[2] - box[0]) * (box[3] - box[1])
+        return iw * ih / area if area > 0 else 0.0
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        valid = label[label[:, 0] >= 0]
+        for _ in range(self.max_attempts):
+            scale = random.uniform(*self.area_range)
+            ratio = random.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, np.sqrt(scale * ratio))
+            ch = min(1.0, np.sqrt(scale / ratio))
+            cx = random.uniform(0, 1 - cw)
+            cy = random.uniform(0, 1 - ch)
+            crop = (cx, cy, cx + cw, cy + ch)
+            if len(valid) and max(
+                    self._overlap(b[1:5], crop) for b in valid) \
+                    < self.min_object_covered:
+                continue
+            # keep objects whose center is inside the crop
+            out = []
+            for b in valid:
+                ctr_x = (b[1] + b[3]) / 2
+                ctr_y = (b[2] + b[4]) / 2
+                if not (crop[0] <= ctr_x <= crop[2]
+                        and crop[1] <= ctr_y <= crop[3]):
+                    continue
+                nb = b.copy()
+                nb[1] = (max(b[1], crop[0]) - cx) / cw
+                nb[2] = (max(b[2], crop[1]) - cy) / ch
+                nb[3] = (min(b[3], crop[2]) - cx) / cw
+                nb[4] = (min(b[4], crop[3]) - cy) / ch
+                out.append(nb)
+            if len(valid) and not out:
+                continue
+            x0, y0 = int(cx * w), int(cy * h)
+            cw_px, ch_px = max(1, int(cw * w)), max(1, int(ch * h))
+            src = fixed_crop(src, x0, y0, cw_px, ch_px)
+            label = np.asarray(out, np.float32).reshape(-1, 5) if out \
+                else np.zeros((0, 5), np.float32)
+            return src, label
+        return src, valid.reshape(-1, 5)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom out: place the image on a larger canvas and re-normalize
+    boxes inward (reference ``DetRandomPadAug``)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        scale = random.uniform(*self.area_range)
+        ratio = random.uniform(*self.aspect_ratio_range)
+        nw = max(1.0, np.sqrt(scale * ratio))
+        nh = max(1.0, np.sqrt(scale / ratio))
+        ox = random.uniform(0, nw - 1)
+        oy = random.uniform(0, nh - 1)
+        canvas = np.empty((int(h * nh), int(w * nw), src.shape[2]),
+                          src.dtype)
+        canvas[...] = np.asarray(self.pad_val, src.dtype)
+        x0, y0 = int(ox * w), int(oy * h)
+        canvas[y0:y0 + h, x0:x0 + w] = src
+        label = label.copy()
+        valid = label[:, 0] >= 0
+        label[valid, 1] = (label[valid, 1] + ox) / nw
+        label[valid, 3] = (label[valid, 3] + ox) / nw
+        label[valid, 2] = (label[valid, 2] + oy) / nh
+        label[valid, 4] = (label[valid, 4] + oy) / nh
+        return canvas, label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Pick one augmenter at random (or skip) — reference
+    ``DetRandomSelectAug``."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if random.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return random.choice(self.aug_list)(src, label)
+
+
+class _DetForceResize(DetAugmenter):
+    def __init__(self, w, h, interp=2):
+        self.w, self.h, self.interp = w, h, interp
+
+    def __call__(self, src, label):
+        return imresize(np.asarray(src, np.uint8), self.w, self.h,
+                        self.interp), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
+                       rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), pad_val=(127, 127, 127),
+                       **kwargs):
+    """The default det augmenter chain (reference
+    ``CreateDetAugmenter`` / ``image_det_aug_default.cc``): random
+    crop/pad (each taken with its probability), mirror, forced resize to
+    ``data_shape``, then the borrowed color/normalize augmenters."""
+    auglist = []
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])))
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])), pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(_DetForceResize(data_shape[2], data_shape[1],
+                                   inter_method))
+    for aug in CreateAugmenter(data_shape, brightness=brightness,
+                               contrast=contrast, saturation=saturation,
+                               mean=mean, std=std):
+        name = aug.__class__.__name__
+        if name in ("BrightnessJitterAug", "ContrastJitterAug",
+                    "SaturationJitterAug", "ColorNormalizeAug",
+                    "CastAug"):
+            auglist.append(DetBorrowAug(aug))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference ``ImageDetIter``): labels become a
+    fixed ``(batch, max_objects, 5)`` tensor, ``-1``-padded.
+
+    Record/list labels may be flat ``k*5`` floats, or the reference's
+    headed format ``[A, B, ...]`` (A = header length, B = object width)."""
+
+    def __init__(self, batch_size, data_shape, max_objects=16,
+                 aug_list=None, label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape)
+        self.max_objects = max_objects
+        self._det_augs = aug_list
+        super().__init__(batch_size, data_shape, label_width=5,
+                         aug_list=[], label_name=label_name, **kwargs)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objects, 5),
+                         np.float32)]
+
+    @staticmethod
+    def _parse_label(raw):
+        raw = np.asarray(raw, np.float32).ravel()
+        if raw.size >= 2 and raw.size % 5 != 0:
+            # headed format: [header_width A, object_width B, header...,
+            # objects...]
+            a, b = int(raw[0]), int(raw[1])
+            body = raw[a:]
+            n = body.size // b
+            return body[:n * b].reshape(n, b)[:, :5]
+        return raw.reshape(-1, 5)
+
+    def _load_one(self, key):
+        import mxnet_tpu.recordio as recordio
+
+        if self.record is not None:
+            with self._rec_lock:
+                raw = self.record.read_idx(key)
+            header, img = recordio.unpack_img(raw)
+            label = header.label
+        else:
+            label, fname = self.imglist[key]
+            from .image import imread
+
+            img = imread(fname)
+        boxes = self._parse_label(label)
+        for aug in self._det_augs:
+            img, boxes = aug(img, boxes)
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.data_shape
+        if img.shape[:2] != (h, w):
+            img = imresize(img.astype(np.uint8), w, h)
+            img = np.asarray(img, np.float32).reshape(h, w, c)
+        padded = np.full((self.max_objects, 5), -1.0, np.float32)
+        n = min(len(boxes), self.max_objects)
+        if n:
+            padded[:n] = boxes[:n]
+        return img.transpose(2, 0, 1), padded
+
+    def next(self):
+        batch = super().next()
+        # parent stacked the (max_objects, 5) labels already; just make
+        # sure the declared shape holds
+        lab = batch.label[0]
+        if lab.shape != (self.batch_size, self.max_objects, 5):
+            from .ndarray import array
+
+            batch = DataBatch(
+                data=batch.data,
+                label=[array(np.asarray(
+                    lab.asnumpy()).reshape(
+                    self.batch_size, self.max_objects, 5))],
+                pad=batch.pad, index=batch.index,
+                provide_data=self.provide_data,
+                provide_label=self.provide_label)
+        return batch

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Kill stray training jobs on this host (reference
+``tools/kill-mxnet.py``): finds python processes whose command line
+mentions the given script (default: any mxnet_tpu entry point) and
+SIGTERMs them, SIGKILL after a grace period.
+
+    python tools/kill_mxnet.py [script_name] [--force]
+"""
+import os
+import signal
+import sys
+import time
+
+
+def find_procs(needle):
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace")
+        except OSError:
+            continue
+        if "python" in cmd and needle in cmd:
+            out.append((int(pid), cmd.strip()))
+    return out
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    needle = args[0] if args else "mxnet_tpu"
+    force = "--force" in sys.argv
+    procs = find_procs(needle)
+    if not procs:
+        print("no matching processes")
+        return
+    for pid, cmd in procs:
+        print("killing %d: %s" % (pid, cmd[:100]))
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    if force:
+        time.sleep(2)
+        for pid, _ in procs:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+if __name__ == "__main__":
+    main()
