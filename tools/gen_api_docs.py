@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Generate the operator API reference from the registry (the reference
+builds its docs/api pages from the same registry that generates the
+frontends; docs/mxdoc.py).
+
+    python tools/gen_api_docs.py [--out docs/api]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "docs", "api"))
+    args = p.parse_args()
+
+    from mxnet_tpu.ops import registry
+    from mxnet_tpu.ops.op_names import INPUT_NAMES
+
+    os.makedirs(args.out, exist_ok=True)
+    seen = {}
+    for name in registry.list_ops():
+        op = registry.get(name)
+        seen.setdefault(id(op), (op, []))[1].append(name)
+
+    groups = {"nn": [], "tensor": [], "contrib": [], "optimizer": [],
+              "random": [], "internal": []}
+    for op, names in seen.values():
+        primary = op.name
+        if primary.startswith("_contrib_"):
+            key = "contrib"
+        elif primary.endswith("_update"):
+            key = "optimizer"
+        elif primary.startswith(("random_", "sample_", "_random")):
+            key = "random"
+        elif primary in INPUT_NAMES or primary[:1].isupper():
+            key = "nn"
+        elif primary.startswith("_"):
+            key = "internal"
+        else:
+            key = "tensor"
+        groups[key].append((primary, sorted(set(names) - {primary}), op))
+
+    index = ["# Operator API reference",
+             "",
+             "Generated from the op registry by `tools/gen_api_docs.py` "
+             "— the same registry that generates the `mx.nd.*` and "
+             "`mx.sym.*` frontends.", ""]
+    for key in ("nn", "tensor", "contrib", "random", "optimizer",
+                "internal"):
+        ops = sorted(groups[key])
+        if not ops:
+            continue
+        lines = ["# %s operators" % key, ""]
+        index.append("- [%s](%s.md) — %d ops" % (key, key, len(ops)))
+        for primary, aliases, op in ops:
+            lines.append("## `%s`" % primary)
+            if aliases:
+                lines.append("*aliases: %s*" %
+                             ", ".join("`%s`" % a for a in aliases))
+            lines.append("")
+            lines.append(op.describe())
+            lines.append("")
+        with open(os.path.join(args.out, key + ".md"), "w") as f:
+            f.write("\n".join(lines))
+    with open(os.path.join(args.out, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    total = sum(len(v) for v in groups.values())
+    print("wrote %d ops across %d pages to %s"
+          % (total, len([g for g in groups.values() if g]), args.out))
+
+
+if __name__ == "__main__":
+    main()
