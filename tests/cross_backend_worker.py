@@ -1,0 +1,94 @@
+"""Worker for the TPU-vs-CPU consistency tier (run WITHOUT the conftest
+CPU pin, so the default platform — the real TPU when tunneled — is one
+of the compared backends).  Prints one line per case: ``name maxdiff``.
+
+The reference validates every GPU kernel against the CPU kernel this way
+(``tests/python/gpu/test_operator_gpu.py`` + ``check_consistency``); here
+the XLA TPU lowering is validated against the XLA CPU lowering.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    # validate the LOWERING, not the matmul precision default: TPU
+    # matmuls default to bf16 passes, which is a precision policy rather
+    # than a kernel property
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import mxnet_tpu as mx
+
+    kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    if "TPU" not in kind.upper() and jax.devices()[0].platform == "cpu":
+        print("SKIP no accelerator")
+        return
+
+    rs = np.random.RandomState(0)
+
+    def run(name, sym, shapes, rtol=2e-2, atol=2e-3):
+        inputs = {n: rs.normal(size=s).astype("float32")
+                  for n, s in shapes.items()}
+        outs = {}
+        for ctx in (mx.cpu(), mx.tpu()):
+            ex = sym.simple_bind(ctx, grad_req="write", **shapes)
+            for n, v in inputs.items():
+                ex.arg_dict[n][:] = mx.nd.array(v, ctx=ctx)
+            ex.forward(is_train=True)
+            ex.backward(out_grads=[mx.nd.ones(ex.outputs[0].shape,
+                                              ctx=ctx)])
+            outs[ctx.device_type] = (
+                ex.outputs[0].asnumpy(),
+                {n: g.asnumpy() for n, g in ex.grad_dict.items()
+                 if g is not None})
+        (o_cpu, g_cpu), (o_tpu, g_tpu) = outs["cpu"], outs["tpu"]
+        diff = float(np.max(np.abs(o_cpu - o_tpu)))
+        np.testing.assert_allclose(o_tpu, o_cpu, rtol=rtol, atol=atol,
+                                   err_msg=name)
+        for n in g_cpu:
+            np.testing.assert_allclose(
+                g_tpu[n], g_cpu[n], rtol=rtol, atol=5e-3,
+                err_msg="%s grad %s" % (name, n))
+        print("OK %s maxdiff=%.2e" % (name, diff))
+
+    d = mx.sym.Variable("data")
+    run("FullyConnected",
+        mx.sym.FullyConnected(d, num_hidden=8, name="fc"),
+        {"data": (4, 16)})
+    run("Convolution+BN+relu",
+        mx.sym.Activation(mx.sym.BatchNorm(
+            mx.sym.Convolution(d, kernel=(3, 3), pad=(1, 1),
+                               num_filter=8, name="cv"),
+            fix_gamma=False, name="bn"), act_type="relu"),
+        {"data": (2, 3, 8, 8)})
+    run("Pooling", mx.sym.Pooling(d, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="max"),
+        {"data": (2, 3, 8, 8)})
+    run("softmax+dot",
+        mx.sym.softmax(mx.sym.dot(d, mx.sym.Variable("w"))),
+        {"data": (4, 8), "w": (8, 8)})
+    run("MultiHeadAttention",
+        mx.sym.MultiHeadAttention(d, num_heads=2, name="mha"),
+        {"data": (2, 8, 16), "mha_in_weight": (48, 16),
+         "mha_in_bias": (48,), "mha_out_weight": (16, 16),
+         "mha_out_bias": (16,)})
+    run("RNN-lstm",
+        mx.sym.RNN(d, mx.sym.Variable("p"), mx.sym.Variable("s0"),
+                   mx.sym.Variable("c0"), state_size=8, num_layers=1,
+                   mode="lstm", name="rnn"),
+        {"data": (5, 2, 4),
+         "p": (4 * ((4 + 8) * 8 + 2 * 8),),
+         "s0": (1, 2, 8), "c0": (1, 2, 8)})
+    run("LayerNorm+gelu",
+        mx.sym.Activation(mx.sym.LayerNorm(d, name="ln"),
+                          act_type="gelu"),
+        {"data": (4, 16), "ln_gamma": (16,), "ln_beta": (16,)})
+    print("ALL_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""TPU-vs-CPU consistency tier (reference: the GPU suite's
+check_consistency pattern, tests/python/gpu/test_operator_gpu.py).
+
+Runs cross_backend_worker.py in a clean subprocess (no conftest CPU pin)
+so the real accelerator is available; skipped when the environment has
+no accelerator (pure-CPU CI)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_tpu_cpu_consistency():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    res = subprocess.run(
+        [sys.executable, os.path.join("tests", "cross_backend_worker.py")],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=560)
+    if "SKIP no accelerator" in res.stdout:
+        pytest.skip("no accelerator in this environment")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ALL_OK" in res.stdout, res.stdout
