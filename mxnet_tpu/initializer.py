@@ -88,6 +88,13 @@ class Initializer:
             self._init_weight(desc, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(desc, arr)
+        elif name.endswith("parameters"):
+            # packed fused-RNN parameter blob (reference init.FusedRNN
+            # unpacks per-matrix; here one small-uniform draw — same
+            # divergence FusedRNNCell documents)
+            self._init_rnn_parameters(desc, arr)
+        elif name.endswith("state") or name.endswith("state_cell"):
+            self._init_zero(desc, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):
             self._init_one(desc, arr)
         elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
@@ -109,6 +116,12 @@ class Initializer:
 
     def _init_beta(self, _, arr):
         arr[:] = 0.0
+
+    def _init_rnn_parameters(self, _, arr):
+        import numpy as _np
+
+        arr[:] = _np.random.uniform(-0.07, 0.07,
+                                    arr.shape).astype("float32")
 
     def _init_bilinear(self, _, arr):
         weight = np.zeros(arr.size, dtype="float32")

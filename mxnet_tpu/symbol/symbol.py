@@ -569,6 +569,10 @@ _PARAMETRIC_OPS = {
     # any layer op (python/mxnet/operator.py)
     "Custom",
     "MultiHeadAttention", "_contrib_MultiHeadAttention",
+    # sym.RNN(data, state_size=..) auto-creates parameters/state like the
+    # reference Compose path; shapes from the RNN branch of
+    # _fill_param_shapes
+    "RNN",
 }
 
 

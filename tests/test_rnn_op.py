@@ -157,3 +157,30 @@ def test_rnn_gradient_flows():
     for name in ("data", "parameters", "state"):
         g = ex.grad_dict[name].asnumpy()
         assert np.abs(g).sum() > 0, "zero gradient wrt %s" % name
+
+
+def test_sym_rnn_auto_creates_params():
+    """sym.RNN(data, ...) auto-creates parameters/state variables with
+    inferred shapes (reference Compose behavior) and binds/trains."""
+    data = mx.sym.Variable("data")
+    rnn = mx.sym.RNN(data, state_size=8, num_layers=1, mode="lstm",
+                     name="lstm")
+    args = rnn.list_arguments()
+    assert "lstm_parameters" in args and "lstm_state" in args \
+        and "lstm_state_cell" in args
+    ex = rnn.simple_bind(ctx=mx.cpu(), data=(5, 2, 4))
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    assert ex.arg_dict["lstm_parameters"].shape == \
+        (rnn_param_size(4, 8, 1, "lstm"),)
+    assert ex.arg_dict["lstm_state"].shape == (1, 2, 8)
+    # default initializer handles the packed blob and zero states
+    mx.init.Xavier()("lstm_parameters", ex.arg_dict["lstm_parameters"])
+    mx.init.Xavier()("lstm_state", ex.arg_dict["lstm_state"])
+    assert float(mx.nd.sum(mx.nd.abs(
+        ex.arg_dict["lstm_parameters"])).asnumpy()) > 0
+    assert float(mx.nd.sum(mx.nd.abs(
+        ex.arg_dict["lstm_state"])).asnumpy()) == 0
+    ex.forward(is_train=True)
+    ex.backward(out_grads=[mx.nd.ones(ex.outputs[0].shape)])
+    assert np.abs(ex.grad_dict["lstm_parameters"].asnumpy()).sum() > 0
