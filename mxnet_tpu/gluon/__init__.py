@@ -3,7 +3,7 @@ new in the 0.11 reference)."""
 from . import parameter
 from .parameter import Parameter, ParameterDict
 from . import block
-from .block import Block, HybridBlock
+from .block import Block, HybridBlock, SymbolBlock
 from . import nn
 from . import loss
 from .trainer import Trainer
@@ -11,5 +11,6 @@ from . import utils
 from . import data
 from . import rnn
 
-__all__ = ["Parameter", "ParameterDict", "Block", "HybridBlock", "nn",
+__all__ = ["Parameter", "ParameterDict", "Block", "HybridBlock",
+           "SymbolBlock", "nn",
            "loss", "Trainer", "utils", "data", "rnn"]

@@ -265,3 +265,103 @@ class HybridBlock(Block):
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+
+class SymbolBlock(Block):
+    """Wrap a Symbol graph as a Block (reference ``gluon.SymbolBlock``):
+    symbolic checkpoints become Gluon layers.
+
+    The graph replays through the imperative op path node by node, so it
+    records on the autograd tape — training with ``Trainer`` works like
+    any other Block.  Auxiliary states (BatchNorm moving stats) update in
+    place via the ops' ``mutable_inputs`` contract.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from ..symbol.symbol import Group, Symbol
+
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [s.name for s in inputs]
+        aux_names = set(outputs.list_auxiliary_states())
+        # label variables of loss heads are not parameters: when not
+        # listed as inputs they are fed zeros at forward (loss heads
+        # ignore labels outside training; reference users slice the head
+        # off with get_internals — this keeps full checkpoints loadable)
+        self._label_names = [
+            n for n in outputs.list_arguments()
+            if n.endswith("_label") and n not in self._input_names]
+        for name in outputs.list_arguments() + list(aux_names):
+            if name in self._input_names or name in self._label_names:
+                continue
+            self.params.get(
+                name, allow_deferred_init=True,
+                grad_req="null" if name in aux_names else "write")
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Load ``prefix-symbol.json`` (+ params file) into a block
+        (reference ``SymbolBlock.imports``)."""
+        from .. import symbol as sym_mod
+        from ..ndarray import load as nd_load
+        from ..symbol.symbol import Variable
+
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        block = SymbolBlock(sym, [Variable(n) for n in input_names])
+        if param_file:
+            loaded = nd_load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                if name in block.params:
+                    block.params[name].set_data(v)
+        return block
+
+    def forward(self, *args):
+        from ..base import MXNetError
+        from ..ndarray.ndarray import imperative_invoke
+
+        if len(args) != len(self._input_names):
+            raise MXNetError("SymbolBlock expects %d inputs (%s), got %d"
+                             % (len(self._input_names),
+                                self._input_names, len(args)))
+        feeds = dict(zip(self._input_names, args))
+        # deferred shapes: infer from the input shapes once
+        needs_shape = [p for p in self.params.values() if p._data is None]
+        if needs_shape:
+            from ..symbol.symbol import _infer_param_shapes
+
+            shapes = _infer_param_shapes(
+                self._symbol, {n: tuple(a.shape)
+                               for n, a in feeds.items()})
+            for p in needs_shape:
+                if p.name in shapes:
+                    p._shape_from_data(tuple(shapes[p.name]))
+                else:
+                    raise MXNetError(
+                        "cannot infer shape for parameter %r" % p.name)
+
+        env = {}
+        from ..ndarray import zeros as nd_zeros
+
+        batch = args[0].shape[0] if args else 1
+        for node in self._symbol._topo():
+            if node.is_variable:
+                if node.name in feeds:
+                    env[(id(node), 0)] = feeds[node.name]
+                elif node.name in self._label_names:
+                    env[(id(node), 0)] = nd_zeros((batch,))
+                else:
+                    env[(id(node), 0)] = self.params[node.name].data()
+                continue
+            ins = [env[(id(src), i)] for (src, i) in node.inputs]
+            outs = imperative_invoke(node.op.name, ins, dict(node.attrs))
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        results = [env[(id(n), i)] for (n, i) in self._symbol._outputs]
+        return results[0] if len(results) == 1 else results

@@ -121,6 +121,12 @@ class Parameter:
         if self._data is None:
             self.shape = tuple(data.shape)
             self._data = data.copy() if isinstance(data, NDArray) else data
+            if self.grad_req != "null":
+                # keep parity with _finish_init: directly-set parameters
+                # (SymbolBlock.imports, load_params) are trainable too
+                self._grad = zeros(self.shape, dtype=self.dtype)
+                autograd.mark_variables([self._data], [self._grad],
+                                        self.grad_req)
         else:
             data.copyto(self._data)
 

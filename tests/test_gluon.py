@@ -268,3 +268,49 @@ def test_gluon_mha_matches_symbolic_op():
         layer.out_weight.data(), layer.out_bias.data(),
         num_heads=2).asnumpy()
     np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_block_from_checkpoint(tmp_path):
+    """SymbolBlock wraps a symbolic checkpoint as a Gluon layer and is
+    trainable through the tape."""
+    # train + save a symbolic net
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 8).astype("float32")
+    W = rs.rand(8, 3).astype("float32")
+    y = (X @ W).argmax(1).astype("float32")
+    data = mx.sym.Variable("data")
+    net_sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(mx.sym.FullyConnected(
+                data, num_hidden=16, name="fc1"), act_type="relu"),
+            num_hidden=3, name="fc2"), name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(net_sym, context=mx.cpu())
+    mod.fit(it, num_epoch=3, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "sb")
+    mod.save_checkpoint(prefix, 3)
+
+    # import WITHOUT the loss head: take the fc2 output
+    feat_sym = net_sym.get_internals()["fc2_output"] \
+        if hasattr(net_sym, "get_internals") else None
+    if feat_sym is None:
+        feat_sym = net_sym
+    block = gluon.SymbolBlock.imports(prefix + "-symbol.json", "data",
+                                      prefix + "-0003.params")
+    out = block(mx.nd.array(X[:8]))
+    # matches the module's forward
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(X[:8])],
+                                label=[mx.nd.zeros((8,))]),
+                is_train=False)
+    np.testing.assert_allclose(out.asnumpy(),
+                               mod.get_outputs()[0].asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+    # trainable through the tape (set_data marked the params)
+    with autograd.record():
+        o = block(mx.nd.array(X[:8]))
+        loss = nd.sum(o * o)
+    loss.backward()
+    g = block.params["fc1_weight"].grad()
+    assert np.abs(g.asnumpy()).sum() > 0
