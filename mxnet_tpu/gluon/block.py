@@ -285,6 +285,16 @@ class SymbolBlock(Block):
             outputs = Group(list(outputs))
         if isinstance(inputs, Symbol):
             inputs = [inputs]
+        from ..base import MXNetError
+
+        for s in inputs:
+            node, _ = s._outputs[0]
+            if not node.is_variable:
+                raise MXNetError(
+                    "SymbolBlock inputs must be Variables; %r is an op "
+                    "output — slice the graph so its inputs are "
+                    "Variables (sym.get_internals()) before wrapping"
+                    % s.name)
         self._symbol = outputs
         self._input_names = [s.name for s in inputs]
         aux_names = set(outputs.list_auxiliary_states())
@@ -331,20 +341,29 @@ class SymbolBlock(Block):
                              % (len(self._input_names),
                                 self._input_names, len(args)))
         feeds = dict(zip(self._input_names, args))
-        # deferred shapes: infer from the input shapes once
-        needs_shape = [p for p in self.params.values() if p._data is None]
-        if needs_shape:
-            from ..symbol.symbol import _infer_param_shapes
+        # shape inference fills deferred parameter shapes AND the label
+        # placeholder shapes from the input shapes
+        from ..symbol.symbol import _infer_param_shapes
 
-            shapes = _infer_param_shapes(
-                self._symbol, {n: tuple(a.shape)
-                               for n, a in feeds.items()})
-            for p in needs_shape:
+        shapes = _infer_param_shapes(
+            self._symbol, {n: tuple(a.shape) for n, a in feeds.items()})
+        for p in self.params.values():
+            if p._data is None:
                 if p.name in shapes:
                     p._shape_from_data(tuple(shapes[p.name]))
                 else:
                     raise MXNetError(
                         "cannot infer shape for parameter %r" % p.name)
+        if self._label_names:
+            from .. import autograd as _ag
+
+            if _ag.is_recording():
+                # zero-fed labels would yield gradients against
+                # fabricated targets — refuse instead of training wrong
+                raise MXNetError(
+                    "SymbolBlock holds loss-head label inputs %s: slice "
+                    "the head off (sym.get_internals()) or list them as "
+                    "inputs before training" % self._label_names)
 
         env = {}
         from ..ndarray import zeros as nd_zeros
@@ -355,7 +374,8 @@ class SymbolBlock(Block):
                 if node.name in feeds:
                     env[(id(node), 0)] = feeds[node.name]
                 elif node.name in self._label_names:
-                    env[(id(node), 0)] = nd_zeros((batch,))
+                    env[(id(node), 0)] = nd_zeros(
+                        tuple(shapes.get(node.name, (batch,))))
                 else:
                     env[(id(node), 0)] = self.params[node.name].data()
                 continue

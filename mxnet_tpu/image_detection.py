@@ -234,13 +234,19 @@ class ImageDetIter(ImageIter):
     @staticmethod
     def _parse_label(raw):
         raw = np.asarray(raw, np.float32).ravel()
-        if raw.size >= 2 and raw.size % 5 != 0:
-            # headed format: [header_width A, object_width B, header...,
-            # objects...]
+        # positive detection of the headed format [A, B, header..., objs]:
+        # A = header width (>=2), B = object width (>=5).  A flat k*5 list
+        # can't masquerade as headed: its second value is a normalized x1
+        # in [0, 1], so int(raw[1]) < 5 there.
+        if raw.size >= 2:
             a, b = int(raw[0]), int(raw[1])
-            body = raw[a:]
-            n = body.size // b
-            return body[:n * b].reshape(n, b)[:, :5]
+            if a >= 2 and b >= 5 and raw.size > a \
+                    and (raw.size - a) % b == 0:
+                return raw[a:].reshape(-1, b)[:, :5]
+        if raw.size % 5 != 0:
+            raise MXNetError(
+                "detection label of length %d is neither flat k*5 nor "
+                "headed [A, B, ...]" % raw.size)
         return raw.reshape(-1, 5)
 
     def _load_one(self, key):

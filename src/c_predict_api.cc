@@ -50,8 +50,10 @@ static void set_py_error() {
 }
 
 static bool ensure_python() {
+  bool we_initialized = false;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    we_initialized = true;
   }
   // make the framework importable: MXNET_TPU_HOME, else the cwd
   PyGILState_STATE g = PyGILState_Ensure();
@@ -67,6 +69,12 @@ static bool ensure_python() {
       "    sys.path.insert(0, p)\n";
   int rc = PyRun_SimpleString(code.c_str());
   PyGILState_Release(g);
+  if (we_initialized) {
+    // Py_InitializeEx leaves the calling thread owning the GIL; detach
+    // so other threads' PyGILState_Ensure can acquire it (without this,
+    // a second serving thread deadlocks forever)
+    PyEval_SaveThread();
+  }
   return rc == 0;
 }
 
@@ -81,6 +89,7 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  mx_uint num_input_nodes, const char **input_keys,
                  const mx_uint *input_shape_indptr,
                  const mx_uint *input_shape_data, PredictorHandle *out) {
+  g_last_error.clear();
   (void)dev_type;
   (void)dev_id;  // device selection is the runtime's job under XLA
   if (!ensure_python()) {
@@ -131,19 +140,24 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
 
 int MXPredSetInput(PredictorHandle handle, const char *key,
                    const float *data, mx_uint size) {
+  g_last_error.clear();
   MXPredictor *h = static_cast<MXPredictor *>(handle);
   PyGILState_STATE g = PyGILState_Ensure();
   int ret = -1;
-  // hand the flat buffer over as a python list -> numpy reshape happens
-  // inside Predictor.set_input via mx.nd.array
-  PyObject *lst = PyList_New(size);
-  for (mx_uint i = 0; i < size; ++i)
-    PyList_SET_ITEM(lst, i, PyFloat_FromDouble(data[i]));
+  // zero-boxing path: wrap the caller's buffer in a memoryview and copy
+  // once via numpy.frombuffer (the copy detaches from caller memory)
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      static_cast<Py_ssize_t>(size) * 4, PyBUF_READ);
   PyObject *np = PyImport_ImportModule("numpy");
   PyObject *arr = nullptr, *shaped = nullptr, *res = nullptr;
   do {
-    if (!np) break;
-    arr = PyObject_CallMethod(np, "asarray", "Os", lst, "float32");
+    if (!np || !mv) break;
+    PyObject *view = PyObject_CallMethod(np, "frombuffer", "Os", mv,
+                                         "float32");
+    if (!view) break;
+    arr = PyObject_CallMethod(view, "copy", NULL);
+    Py_DECREF(view);
     if (!arr) break;
     // reshape to the declared input shape
     PyObject *shapes =
@@ -167,12 +181,13 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
   Py_XDECREF(shaped);
   Py_XDECREF(arr);
   Py_XDECREF(np);
-  Py_XDECREF(lst);
+  Py_XDECREF(mv);
   PyGILState_Release(g);
   return ret;
 }
 
 int MXPredForward(PredictorHandle handle) {
+  g_last_error.clear();
   MXPredictor *h = static_cast<MXPredictor *>(handle);
   PyGILState_STATE g = PyGILState_Ensure();
   PyObject *res = PyObject_CallMethod(h->predictor, "forward", NULL);
@@ -222,29 +237,34 @@ int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
 
 int MXPredGetOutput(PredictorHandle handle, mx_uint index, float *data,
                     mx_uint size) {
+  g_last_error.clear();
   MXPredictor *h = static_cast<MXPredictor *>(handle);
   PyGILState_STATE g = PyGILState_Ensure();
   int ret = -1;
-  PyObject *out = nullptr, *flat = nullptr, *lst = nullptr;
+  PyObject *out = nullptr, *flat = nullptr, *bytes = nullptr;
   do {
     out = PyObject_CallMethod(h->predictor, "get_output", "I", index);
     if (!out) break;
+    // one contiguous float32 copy out: ravel().astype('float32').tobytes()
     flat = PyObject_CallMethod(out, "ravel", NULL);
     if (!flat) break;
-    lst = PyObject_CallMethod(flat, "tolist", NULL);
-    if (!lst) break;
-    Py_ssize_t n = PyList_Size(lst);
-    if (static_cast<mx_uint>(n) != size) {
+    PyObject *f32 = PyObject_CallMethod(flat, "astype", "s", "float32");
+    if (!f32) break;
+    bytes = PyObject_CallMethod(f32, "tobytes", NULL);
+    Py_DECREF(f32);
+    if (!bytes) break;
+    char *buf = nullptr;
+    Py_ssize_t blen = 0;
+    if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0) break;
+    if (static_cast<mx_uint>(blen) != size * 4) {
       set_error("output size mismatch");
       break;
     }
-    for (Py_ssize_t i = 0; i < n; ++i)
-      data[i] = static_cast<float>(
-          PyFloat_AsDouble(PyList_GetItem(lst, i)));
+    std::memcpy(data, buf, blen);
     ret = 0;
   } while (false);
-  if (ret != 0 && g_last_error.empty()) set_py_error();
-  Py_XDECREF(lst);
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(bytes);
   Py_XDECREF(flat);
   Py_XDECREF(out);
   PyGILState_Release(g);

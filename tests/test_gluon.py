@@ -307,10 +307,27 @@ def test_symbol_block_from_checkpoint(tmp_path):
                                mod.get_outputs()[0].asnumpy(),
                                rtol=1e-4, atol=1e-5)
 
-    # trainable through the tape (set_data marked the params)
+    # training THROUGH a zero-fed loss head must refuse (wrong grads)
+    with pytest.raises(mx.MXNetError, match="label"):
+        with autograd.record():
+            block(mx.nd.array(X[:8]))
+
+    # headless import (reference style: get_internals) trains on the tape
+    head = mx.sym.load(prefix + "-symbol.json")
+    feat = head.get_internals()["fc2_output"]
+    fblock = gluon.SymbolBlock(feat, mx.sym.Variable("data"))
+    loaded = mx.nd.load(prefix + "-0003.params")
+    for k, v in loaded.items():
+        name = k.split(":", 1)[1]
+        if name in fblock.params:
+            fblock.params[name].set_data(v)
     with autograd.record():
-        o = block(mx.nd.array(X[:8]))
+        o = fblock(mx.nd.array(X[:8]))
         loss = nd.sum(o * o)
     loss.backward()
-    g = block.params["fc1_weight"].grad()
+    g = fblock.params["fc1_weight"].grad()
     assert np.abs(g.asnumpy()).sum() > 0
+
+    # non-Variable inputs are rejected with a clear error
+    with pytest.raises(mx.MXNetError, match="Variables"):
+        gluon.SymbolBlock(feat, head.get_internals()["fc1_output"])
