@@ -126,6 +126,7 @@ class TrainStep:
         elif remat_policy is not None:
             fwd_fn = jax.checkpoint(fwd_fn, policy=remat_policy)
         cdtype = compute_dtype
+        self._compute_dtype = compute_dtype
         frozen = fixed
 
         def cast_compute(x):

@@ -141,7 +141,7 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, param_sharding=None):
+            monitor=None, param_sharding=None, compute_dtype=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``)."""
         from ..initializer import Uniform
@@ -163,6 +163,8 @@ class BaseModule:
             # only Module.init_optimizer knows this kwarg; BucketingModule
             # and PythonModule keep the base signature
             opt_kwargs["param_sharding"] = param_sharding
+        if compute_dtype is not None:
+            opt_kwargs["compute_dtype"] = compute_dtype
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, **opt_kwargs)
 

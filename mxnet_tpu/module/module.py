@@ -169,7 +169,8 @@ class Module(BaseModule):
     # -- optimizer ------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False, param_sharding=None):
+                       force_init=False, param_sharding=None,
+                       compute_dtype=None):
         """``param_sharding``: 'replicated' (default), 'fsdp', 'tp', or a
         rule list (see ``parallel.sharding.param_sharding_rules``) —
         applied to the fused step's parameter/optimizer-state layouts
@@ -188,6 +189,11 @@ class Module(BaseModule):
             param_sharding = get_env("MXNET_PARAM_SHARDING", "", str) \
                 or None
         self._param_sharding = param_sharding
+        # mixed precision for the fused step: bf16 activations over fp32
+        # master weights (also via MXNET_COMPUTE_DTYPE=bfloat16)
+        if compute_dtype is None:
+            compute_dtype = get_env("MXNET_COMPUTE_DTYPE", "", str) or None
+        self._compute_dtype = compute_dtype
         kvstore_inst, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._exec.arg_dict)
 
@@ -307,7 +313,8 @@ class Module(BaseModule):
                 self._symbol, optimizer=o, mesh=self._mesh,
                 data_names=self._data_names, label_names=self._label_names,
                 fixed_param_names=self._fixed_param_names, remat=remat,
-                param_sharding=getattr(self, "_param_sharding", None))
+                param_sharding=getattr(self, "_param_sharding", None),
+                compute_dtype=getattr(self, "_compute_dtype", None))
         except Exception as e:  # fall back to the split path
             if getattr(self, "_param_sharding", None) not in (
                     None, "replicated"):

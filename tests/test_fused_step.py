@@ -292,3 +292,29 @@ def test_fused_multi_output_symbol():
                                    atol=1e-5,
                                    err_msg="multi-output diverges on %s"
                                    % k)
+
+
+def test_bf16_training_converges_via_module():
+    """Mixed precision is reachable from the public Module.fit API and
+    converges (the reference test_dtype fp16 tier, bf16 on TPU)."""
+    rs = np.random.RandomState(0)
+    X = rs.rand(256, 16).astype("float32")
+    W = rs.rand(16, 3).astype("float32")
+    y = (X @ W).argmax(1).astype("float32")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=60, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), compute_dtype="bfloat16")
+    assert mod._fused is not None and \
+        mod._fused._compute_dtype is not None
+    score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=64),
+                           mx.metric.create("acc")))
+    assert score["accuracy"] > 0.9, score
+    # master weights stayed fp32
+    params, _ = mod.get_params()
+    assert params["fc1_weight"].asnumpy().dtype == np.float32
