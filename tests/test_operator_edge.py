@@ -1,0 +1,226 @@
+"""Numerical edge cases against numpy ground truth — the depth tier of
+the reference's ``tests/python/unittest/test_operator.py`` (3.8k LoC):
+broadcast shapes, degenerate axes, negative indices, padding modes,
+ordering ops, and loss-op semantics."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _a(x):
+    return mx.nd.array(np.asarray(x, "float32"))
+
+
+def test_broadcast_binary_shapes():
+    a = np.random.RandomState(0).rand(2, 1, 4).astype("float32")
+    b = np.random.RandomState(1).rand(1, 3, 1).astype("float32")
+    np.testing.assert_allclose(
+        nd.broadcast_add(_a(a), _a(b)).asnumpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.broadcast_maximum(_a(a), _a(b)).asnumpy(),
+        np.maximum(a, b), rtol=1e-6)
+
+
+def test_broadcast_to_and_axis():
+    a = np.arange(3, dtype="float32").reshape(1, 3, 1)
+    out = nd.broadcast_to(_a(a), shape=(2, 3, 4)).asnumpy()
+    np.testing.assert_array_equal(out, np.broadcast_to(a, (2, 3, 4)))
+    out = nd.broadcast_axis(_a(a), axis=(0, 2), size=(2, 4)).asnumpy()
+    np.testing.assert_array_equal(out, np.broadcast_to(a, (2, 3, 4)))
+
+
+def test_reductions_axis_variants():
+    x = np.random.RandomState(2).randn(2, 3, 4).astype("float32")
+    for op, ref in [("sum", np.sum), ("mean", np.mean), ("max", np.max),
+                    ("min", np.min), ("prod", np.prod)]:
+        fn = getattr(nd, op)
+        np.testing.assert_allclose(
+            fn(_a(x), axis=1).asnumpy(), ref(x, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            fn(_a(x), axis=(0, 2)).asnumpy(), ref(x, axis=(0, 2)),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            fn(_a(x), axis=1, keepdims=True).asnumpy(),
+            ref(x, axis=1, keepdims=True), rtol=1e-5)
+
+
+def test_nan_reductions():
+    x = np.array([[1.0, np.nan, 3.0], [np.nan, 2.0, np.nan]], "float32")
+    np.testing.assert_allclose(nd.nansum(_a(x), axis=1).asnumpy(),
+                               np.nansum(x, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(nd.nanprod(_a(x), axis=0).asnumpy(),
+                               np.nanprod(x, axis=0), rtol=1e-6)
+
+
+def test_slice_negative_and_step():
+    x = np.arange(24, dtype="float32").reshape(4, 6)
+    out = nd.slice(_a(x), begin=(1, 0), end=(4, 6), step=(2, 3)).asnumpy()
+    np.testing.assert_array_equal(out, x[1:4:2, 0:6:3])
+    out = nd.slice_axis(_a(x), axis=-1, begin=2, end=5).asnumpy()
+    np.testing.assert_array_equal(out, x[:, 2:5])
+    out = nd.reverse(_a(x), axis=1).asnumpy()
+    np.testing.assert_array_equal(out, x[:, ::-1])
+
+
+def test_take_modes_and_batch_take():
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    idx = _a([1, 3, 0])
+    np.testing.assert_array_equal(nd.take(_a(x), idx).asnumpy(),
+                                  x[[1, 3, 0]])
+    bt = nd.batch_take(_a(x), _a([2, 0, 1, 2])).asnumpy()
+    np.testing.assert_array_equal(bt, x[np.arange(4), [2, 0, 1, 2]])
+
+
+def test_one_hot_and_pick():
+    oh = nd.one_hot(_a([0, 2, 1]), depth=4).asnumpy()
+    np.testing.assert_array_equal(oh, np.eye(4, dtype="float32")[[0, 2, 1]])
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    pk = nd.pick(_a(x), _a([0, 1, 2, 0]), axis=1).asnumpy()
+    np.testing.assert_array_equal(pk, x[np.arange(4), [0, 1, 2, 0]])
+
+
+def test_ordering_ops():
+    x = np.random.RandomState(3).permutation(24).astype(
+        "float32").reshape(4, 6)
+    np.testing.assert_array_equal(nd.sort(_a(x), axis=1).asnumpy(),
+                                  np.sort(x, axis=1))
+    np.testing.assert_array_equal(
+        nd.argsort(_a(x), axis=1).asnumpy(), np.argsort(x, axis=1))
+    top = nd.topk(_a(x), k=2, axis=1, ret_typ="value").asnumpy()
+    np.testing.assert_array_equal(top, -np.sort(-x, axis=1)[:, :2])
+    np.testing.assert_array_equal(nd.argmax(_a(x), axis=1).asnumpy(),
+                                  np.argmax(x, axis=1))
+
+
+def test_pad_modes():
+    x = np.random.RandomState(4).rand(1, 1, 3, 3).astype("float32")
+    const = nd.Pad(_a(x), mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                   constant_value=7.0).asnumpy()
+    ref = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)), "constant",
+                 constant_values=7.0)
+    np.testing.assert_allclose(const, ref, rtol=1e-6)
+    edge = nd.Pad(_a(x), mode="edge",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1)).asnumpy()
+    np.testing.assert_allclose(
+        edge, np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), "edge"),
+        rtol=1e-6)
+
+
+def test_where_and_clip():
+    c = np.array([1, 0, 1], "float32")
+    a = np.array([1, 2, 3], "float32")
+    b = np.array([9, 8, 7], "float32")
+    np.testing.assert_array_equal(
+        nd.where(_a(c), _a(a), _a(b)).asnumpy(), np.where(c > 0, a, b))
+    x = np.array([-2, 0.5, 3], "float32")
+    np.testing.assert_array_equal(
+        nd.clip(_a(x), a_min=-1, a_max=1).asnumpy(), np.clip(x, -1, 1))
+
+
+def test_dot_transpose_combinations():
+    rs = np.random.RandomState(5)
+    a = rs.rand(3, 4).astype("float32")
+    b = rs.rand(4, 5).astype("float32")
+    np.testing.assert_allclose(nd.dot(_a(a), _a(b)).asnumpy(), a @ b,
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.dot(_a(a.T), _a(b), transpose_a=True).asnumpy(), a @ b,
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.dot(_a(a), _a(b.T), transpose_b=True).asnumpy(), a @ b,
+        rtol=1e-4)
+    # batch_dot
+    x = rs.rand(2, 3, 4).astype("float32")
+    y = rs.rand(2, 4, 5).astype("float32")
+    np.testing.assert_allclose(nd.batch_dot(_a(x), _a(y)).asnumpy(),
+                               np.einsum("bij,bjk->bik", x, y), rtol=1e-4)
+
+
+def test_softmax_axes_and_log():
+    x = np.random.RandomState(6).randn(2, 3, 4).astype("float32")
+
+    def np_softmax(v, axis):
+        e = np.exp(v - v.max(axis, keepdims=True))
+        return e / e.sum(axis, keepdims=True)
+
+    np.testing.assert_allclose(nd.softmax(_a(x), axis=1).asnumpy(),
+                               np_softmax(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.log_softmax(_a(x), axis=-1).asnumpy(),
+        np.log(np_softmax(x, -1)), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_cross_entropy_matches_manual():
+    rs = np.random.RandomState(7)
+    logits = rs.randn(4, 5).astype("float32")
+    labels = np.array([0, 3, 2, 4], "float32")
+    out = nd.softmax_cross_entropy(_a(logits), _a(labels)).asnumpy()
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels.astype(int)]).sum()
+    np.testing.assert_allclose(out.ravel()[0], ref, rtol=1e-4)
+
+
+def test_sequence_ops_respect_lengths():
+    x = np.arange(2 * 3 * 4, dtype="float32").reshape(2, 3, 4)  # TNC
+    lengths = np.array([1, 2, 2], "float32")
+    masked = nd.SequenceMask(_a(x), _a(lengths), use_sequence_length=True,
+                             value=-1.0).asnumpy()
+    assert (masked[1, 0] == -1).all()          # seq 0 len 1: t=1 masked
+    assert (masked[1, 1] == x[1, 1]).all()     # seq 1 len 2: t=1 kept
+    last = nd.SequenceLast(_a(x), _a(lengths),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_array_equal(last[0], x[0, 0])
+    np.testing.assert_array_equal(last[1], x[1, 1])
+    rev = nd.SequenceReverse(_a(x), _a(lengths),
+                             use_sequence_length=True).asnumpy()
+    np.testing.assert_array_equal(rev[0, 0], x[0, 0])  # len-1: unchanged
+    np.testing.assert_array_equal(rev[0, 1], x[1, 1])  # len-2: swapped
+
+
+def test_embedding_gradient_is_row_scatter():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    emb = mx.sym.Embedding(data, w, input_dim=5, output_dim=3)
+    ex = emb.simple_bind(ctx=mx.cpu(), data=(4,), w=(5, 3),
+                         grad_req={"w": "write", "data": "null"})
+    ex.arg_dict["data"][:] = mx.nd.array([1, 3, 1, 0])
+    ex.arg_dict["w"][:] = mx.nd.ones((5, 3))
+    ex.forward(is_train=True)
+    ex.backward(out_grads=[mx.nd.ones((4, 3))])
+    g = ex.grad_dict["w"].asnumpy()
+    np.testing.assert_array_equal(g[:, 0], [1, 2, 0, 1, 0])  # row counts
+
+
+def test_upsampling_nearest():
+    x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+    out = nd.UpSampling(_a(x), scale=2, sample_type="nearest").asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_array_equal(
+        out[0, 0], np.repeat(np.repeat(x[0, 0], 2, 0), 2, 1))
+
+
+def test_l2_normalization():
+    x = np.random.RandomState(8).randn(2, 4).astype("float32")
+    out = nd.L2Normalization(_a(x), mode="instance").asnumpy()
+    ref = x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_expand_and_squeeze_negative_axes():
+    x = np.random.RandomState(9).rand(2, 3).astype("float32")
+    e = nd.expand_dims(_a(x), axis=-1).asnumpy()
+    assert e.shape == (2, 3, 1)
+    s = nd.squeeze(nd.expand_dims(_a(x), axis=0), axis=0).asnumpy()
+    np.testing.assert_array_equal(s, x)
+
+
+def test_arange_and_linspace_like():
+    np.testing.assert_allclose(
+        nd.arange(2, 10, 2).asnumpy(), np.arange(2, 10, 2, "float32"))
+    np.testing.assert_allclose(
+        nd.arange(5, repeat=2).asnumpy(),
+        np.repeat(np.arange(5, dtype="float32"), 2))
