@@ -20,7 +20,7 @@ from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter"]
+           "LibSVMIter", "ImageDetRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -522,3 +522,23 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         data_name=data_name, label_name=label_name,
         num_threads=preprocess_threads, **kwargs)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
+
+
+def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
+                       max_objects=16, **kwargs):
+    """Detection RecordIO iterator (reference C iterator
+    ``ImageDetRecordIter``, ``src/io/iter_image_det_recordio.cc``):
+    factory over :class:`mxnet_tpu.image_detection.ImageDetIter` with the
+    det augmenter chain."""
+    from .image_detection import CreateDetAugmenter, ImageDetIter
+
+    aug_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
+                  if k in ("resize", "rand_crop", "rand_pad",
+                           "rand_mirror", "mean", "std", "brightness",
+                           "contrast", "saturation", "inter_method",
+                           "min_object_covered", "aspect_ratio_range",
+                           "area_range", "pad_val")}
+    aug_list = CreateDetAugmenter(data_shape, **aug_kwargs)
+    return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
+                        path_imgrec=path_imgrec, max_objects=max_objects,
+                        aug_list=aug_list, **kwargs)

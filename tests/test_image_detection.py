@@ -91,3 +91,26 @@ def test_headed_label_format():
     assert boxes.shape == (2, 5)
     np.testing.assert_allclose(boxes[0], [1, 0.1, 0.2, 0.3, 0.4],
                                rtol=1e-6)
+
+
+def test_image_det_record_iter_factory(tmp_path):
+    from PIL import Image
+    import io as pyio
+
+    rec = str(tmp_path / "d2.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rs = np.random.RandomState(1)
+    for i in range(4):
+        img = (rs.rand(24, 24, 3) * 255).astype("uint8")
+        bio = pyio.BytesIO()
+        Image.fromarray(img).save(bio, format="PNG")
+        label = np.array([0, 0.2, 0.2, 0.8, 0.8], np.float32)
+        w.write(recordio.pack(recordio.IRHeader(0, label, i, 0),
+                              bio.getvalue()))
+    w.close()
+    it = mx.io.ImageDetRecordIter(path_imgrec=rec, data_shape=(3, 20, 20),
+                                  batch_size=2, rand_mirror=True,
+                                  max_objects=3)
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3, 20, 20)
+    assert b.label[0].shape == (2, 3, 5)
