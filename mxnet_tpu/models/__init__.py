@@ -1,9 +1,10 @@
 """Model zoo: Symbol generators for the reference's example networks.
 
-Mirrors the coverage of ``example/image-classification/symbols/`` (lenet,
-mlp, alexnet, vgg, resnet, inception-bn) plus the RNN family from
-``example/rnn``.  Each returns a Symbol ending in SoftmaxOutput, ready for
-``Module``.
+Covers ``example/image-classification/symbols/`` (lenet, mlp, alexnet,
+vgg, resnet, inception-bn, mobilenet) plus the post-reference
+transformer LM family (``transformer.py`` — see ``bench_transformer.py``
+for its MFU numbers).  Each returns a Symbol ending in SoftmaxOutput,
+ready for ``Module``.
 """
 from . import lenet
 from . import mlp

@@ -1,0 +1,51 @@
+"""Smoke the examples/ scripts end-to-end (tiny configs, CPU) so they
+cannot rot — the role of the reference's tests/python/train tier +
+example CI."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *args],
+        capture_output=True, text=True, env=env, cwd=REPO,
+        timeout=timeout)
+    assert res.returncode == 0, (script, res.stdout[-2000:],
+                                 res.stderr[-2000:])
+    return res.stdout + res.stderr
+
+
+def test_example_autograd_basics():
+    out = _run("examples/autograd/autograd_basics.py")
+    assert "recovered" in out
+
+
+def test_example_train_mnist():
+    out = _run("examples/image-classification/train_mnist.py",
+               "--num-epochs", "2", "--num-examples", "512",
+               "--network", "mlp")
+    assert "Validation-accuracy" in out
+
+
+def test_example_gluon_mnist():
+    out = _run("examples/gluon/mnist.py", "--epochs", "2",
+               "--num-examples", "512")
+    assert "val-acc" in out
+
+
+def test_example_sparse_linear():
+    out = _run("examples/sparse/linear_classification.py",
+               "--num-epochs", "3", "--num-examples", "512")
+    assert "train-acc" in out
+
+
+def test_example_ssd():
+    out = _run("examples/ssd/train_ssd.py", "--num-epochs", "2",
+               "--num-examples", "128")
+    assert "loss first->last" in out
