@@ -525,11 +525,13 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
 
 
 def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
-                       max_objects=16, **kwargs):
+                       max_objects=16, preprocess_threads=4,
+                       prefetch_buffer=2, **kwargs):
     """Detection RecordIO iterator (reference C iterator
     ``ImageDetRecordIter``, ``src/io/iter_image_det_recordio.cc``):
     factory over :class:`mxnet_tpu.image_detection.ImageDetIter` with the
-    det augmenter chain."""
+    det augmenter chain, threaded decode, and background prefetch —
+    same pipeline contract as :func:`ImageRecordIter`."""
     from .image_detection import CreateDetAugmenter, ImageDetIter
 
     aug_kwargs = {k: kwargs.pop(k) for k in list(kwargs)
@@ -539,6 +541,8 @@ def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
                            "min_object_covered", "aspect_ratio_range",
                            "area_range", "pad_val")}
     aug_list = CreateDetAugmenter(data_shape, **aug_kwargs)
-    return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
-                        path_imgrec=path_imgrec, max_objects=max_objects,
-                        aug_list=aug_list, **kwargs)
+    inner = ImageDetIter(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec,
+                         max_objects=max_objects, aug_list=aug_list,
+                         num_threads=preprocess_threads, **kwargs)
+    return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)

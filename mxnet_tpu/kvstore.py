@@ -59,6 +59,15 @@ class KVStore:
         self._optimizer = None
         self._is_dist = "dist" in kv_type
         self._mesh = None
+        if self._is_dist:
+            # join the multi-process job when launched by tools/launch.py
+            # (MXNET_COORDINATOR & co.); no-op single-process.  This is
+            # what makes the documented quick-start actually synchronize
+            # — without it each worker would silently train a separate
+            # replica (jax.process_count() == 1 everywhere).
+            from .parallel import init_distributed
+
+            init_distributed()
         if "async" in kv_type:
             # In the reference, dist_async servers apply each worker's
             # gradient immediately without a merge barrier

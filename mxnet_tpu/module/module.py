@@ -284,16 +284,28 @@ class Module(BaseModule):
         self._fused = None
         self._fused_states = None
         self._fused_ran = False
+
+        def _bail(reason):
+            # an EXPLICIT mixed-precision request must not silently train
+            # fp32 through the split path (same stance as param_sharding)
+            if getattr(self, "_compute_dtype", None) is not None:
+                raise MXNetError(
+                    "compute_dtype=%r was requested but the fused step is "
+                    "unavailable: %s" % (self._compute_dtype, reason))
+
         if not get_env("MXNET_FUSED_STEP", True, bool):
+            _bail("MXNET_FUSED_STEP=0")
             return
         if self.inputs_need_grad:
             # the fused step does not populate grad_dict for data inputs;
             # get_input_grads needs the split executor path
+            _bail("inputs_need_grad requires the split executor")
             return
         o = self._optimizer
         if not o.supports_fused:
             self.logger.debug("optimizer %s has no fused form; using the "
                               "split update path", type(o).__name__)
+            _bail("optimizer %s has no fused form" % type(o).__name__)
             return
         req = self._grad_req
         if isinstance(req, str):
@@ -303,6 +315,7 @@ class Module(BaseModule):
                                       v == "null")
                      for k, v in req.items())
         if not ok:
+            _bail("grad_req %r is not fusable" % (req,))
             return
         try:
             from ..fused import TrainStep
@@ -316,6 +329,11 @@ class Module(BaseModule):
                 param_sharding=getattr(self, "_param_sharding", None),
                 compute_dtype=getattr(self, "_compute_dtype", None))
         except Exception as e:  # fall back to the split path
+            if getattr(self, "_compute_dtype", None) is not None:
+                raise MXNetError(
+                    "compute_dtype=%r was requested but the fused step "
+                    "could not be built: %s"
+                    % (self._compute_dtype, e)) from e
             if getattr(self, "_param_sharding", None) not in (
                     None, "replicated"):
                 # an EXPLICIT sharding request must not silently train

@@ -119,7 +119,13 @@ def init_distributed(coordinator=None, num_processes=None,
         num_processes = int(os.environ.get("MXNET_NUM_WORKERS", "1"))
     if process_id is None:
         process_id = int(os.environ.get("MXNET_WORKER_ID", "0"))
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        # idempotent: callers (kvstore.create for dist types, user
+        # scripts, the CI dist worker) may race to initialize
+        if "already" not in str(e).lower():
+            raise
     return True

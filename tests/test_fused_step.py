@@ -318,3 +318,19 @@ def test_bf16_training_converges_via_module():
     # master weights stayed fp32
     params, _ = mod.get_params()
     assert params["fc1_weight"].asnumpy().dtype == np.float32
+
+
+def test_explicit_compute_dtype_refuses_split_fallback(monkeypatch):
+    """An explicit mixed-precision request must not silently train fp32
+    through the split path (same stance as param_sharding)."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2), name="softmax")
+    it = mx.io.NDArrayIter(np.random.rand(8, 4).astype("float32"),
+                           np.zeros(8, "float32"), batch_size=4)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    with pytest.raises(mx.MXNetError, match="compute_dtype"):
+        mod.init_optimizer(compute_dtype="bfloat16")

@@ -17,6 +17,9 @@ import numpy as np
 import pytest
 
 
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -107,3 +110,29 @@ np.savez(os.path.join(outdir, "params_single.npz"),
          **{k: v.asnumpy() for k, v in params.items()})
 print("SINGLE DONE")
 """
+
+
+def test_launcher_quickstart_synchronizes(tmp_path):
+    """The documented quick-start: tools/launch.py --launcher local must
+    yield workers that actually see each other (kvstore creation joins
+    the jax.distributed job from the injected env — without that each
+    process silently trains an independent replica)."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import sys; sys.path.insert(0, %r)\n"
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_tpu_sync')\n"
+        "assert kv.num_workers == 2, kv.num_workers\n"
+        "print('WORKER_OK rank=%%d' %% kv.rank)\n" % REPO)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "-s", "1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert res.stdout.count("WORKER_OK") == 2, res.stdout + res.stderr
+    assert "no parameter servers" in res.stderr  # -s parity warning

@@ -82,9 +82,17 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--launcher", choices=("local", "ssh"),
                     default="local")
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; dist_tpu_sync"
+                         " has no parameter servers (ignored with a"
+                         " warning)")
     ap.add_argument("-H", "--hostfile", default=None)
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if getattr(args, "num_servers", 0):
+        print("WARNING: -s/--num-servers ignored: dist_tpu_sync is SPMD "
+              "(no parameter servers); launching workers only",
+              file=sys.stderr)
     if not args.command:
         raise SystemExit("no command given")
     env = dict(os.environ)
