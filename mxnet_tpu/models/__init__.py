@@ -13,14 +13,15 @@ from . import vgg
 from . import resnet
 from . import inception_bn
 from . import mobilenet
+from . import inception_v3
 from . import transformer
 
 __all__ = ["lenet", "mlp", "alexnet", "vgg", "resnet", "inception_bn",
-           "mobilenet", "transformer", "get_model"]
+           "mobilenet", "inception_v3", "transformer", "get_model"]
 
 _MODELS = {m.__name__.rsplit(".", 1)[-1]: m.get_symbol
            for m in (lenet, mlp, alexnet, vgg, resnet, inception_bn,
-                     mobilenet, transformer)}
+                     mobilenet, inception_v3, transformer)}
 
 
 def get_model(name, **kwargs):

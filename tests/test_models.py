@@ -95,3 +95,22 @@ def test_get_model_registry_covers_new_families():
                                seq_len=8) is not None
     with pytest.raises(mx.MXNetError):
         mx.models.get_model("nope")
+
+
+def test_inception_v3_forward():
+    sym = mx.models.inception_v3.get_symbol(num_classes=10)
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(1, 3, 299, 299),
+                         softmax_label=(1,))
+    rs = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            a[:] = mx.nd.array(rs.uniform(-0.05, 0.05,
+                                          a.shape).astype("float32"))
+    ex.arg_dict["data"][:] = mx.nd.array(
+        rs.rand(1, 3, 299, 299).astype("float32"))
+    # train mode: batch statistics (an untrained eval pass would divide
+    # by the zero-initialized moving_var 17 BN layers deep)
+    ex.forward(is_train=True)
+    probs = ex.outputs[0].asnumpy()
+    assert probs.shape == (1, 10)
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
