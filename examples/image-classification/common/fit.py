@@ -82,6 +82,7 @@ def fit(args, network, data_loader):
 
     mod.fit(train,
             param_sharding=args.param_sharding,
+            compute_dtype=getattr(args, "compute_dtype", None),
             eval_data=val,
             eval_metric=["accuracy"],
             kvstore=kv,

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""ImageNet training (reference
+``example/image-classification/train_imagenet.py`` — the BASELINE.json
+flagship configs: resnet-50 / inception-v3 over ``ImageRecordIter``).
+
+Point ``--data-train``/``--data-val`` at ImageNet ``.rec`` files packed
+with ``tools/im2rec.py``.  Without them, a synthetic class-colored .rec
+set is packed at a reduced resolution so the full pipeline — sharded
+RecordIO read, threaded decode + augmenters, background prefetch,
+fused bf16 train step — runs hermetically.
+
+    python examples/image-classification/train_imagenet.py \
+        --network resnet --num-layers 50 --batch-size 256 \
+        --compute-dtype bfloat16
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def get_symbol(args):
+    from mxnet_tpu import models
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    kwargs = {"num_classes": args.num_classes}
+    if args.network == "resnet":
+        kwargs.update(num_layers=args.num_layers or 50,
+                      image_shape=shape)
+    return models.get_model(args.network, **kwargs)
+
+
+def _pack_synthetic(rec_path, n, num_classes, size, rs):
+    from PIL import Image
+    import io as pyio
+
+    from mxnet_tpu import recordio
+
+    w = recordio.MXRecordIO(rec_path, "w")
+    for i in range(n):
+        cls = int(rs.randint(num_classes))
+        img = (rs.rand(size, size, 3) * 50).astype("uint8")
+        img[..., cls % 3] += np.uint8(100 + 8 * (cls // 3))
+        bio = pyio.BytesIO()
+        Image.fromarray(img).save(bio, format="JPEG", quality=90)
+        w.write(recordio.pack(recordio.IRHeader(0, float(cls), i, 0),
+                              bio.getvalue()))
+    w.close()
+
+
+def get_imagenet_iter(args, kv):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    train_rec, val_rec = args.data_train, args.data_val
+    if not (train_rec and os.path.exists(train_rec)):
+        data_dir = "/tmp/imagenet_synth_%dpx" % shape[-1]
+        os.makedirs(data_dir, exist_ok=True)
+        train_rec = os.path.join(data_dir, "train.rec")
+        val_rec = os.path.join(data_dir, "val.rec")
+        if not os.path.exists(train_rec):
+            rs = np.random.RandomState(0)
+            side = shape[-1] + shape[-1] // 8
+            _pack_synthetic(train_rec, args.num_examples,
+                            args.num_classes, side, rs)
+            _pack_synthetic(val_rec, max(256, args.batch_size),
+                            args.num_classes, side, rs)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=train_rec, data_shape=shape,
+        batch_size=args.batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        preprocess_threads=args.data_nthreads,
+        part_index=kv.rank, num_parts=kv.num_workers)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=val_rec, data_shape=shape,
+        batch_size=args.batch_size,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        preprocess_threads=args.data_nthreads)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-examples", type=int, default=4096,
+                        help="synthetic-set size when no --data-train")
+    parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--data-nthreads", type=int, default=8)
+    parser.add_argument("--compute-dtype", type=str, default=None)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="resnet", num_layers=50, num_epochs=2,
+                        batch_size=128, lr=0.1,
+                        lr_step_epochs="30,60", num_examples=4096)
+    args = parser.parse_args()
+    args.num_examples = args.num_examples  # used by fit's epoch_size
+    fit.fit(args, get_symbol(args), get_imagenet_iter)
