@@ -439,3 +439,17 @@ def test_check_consistency_dtype():
         [{"ctx": mx.cpu(), "data": (3, 5)},
          {"ctx": mx.cpu(), "data": (3, 5)}],
         rtol=1e-4)
+
+
+def test_grad_slice_assign():
+    _grad_check("_slice_assign",
+                [mx.nd.array(np.random.rand(4, 4).astype("float32")),
+                 mx.nd.array(np.random.rand(2, 2).astype("float32"))],
+                {"begin": (1, 1), "end": (3, 3)})
+
+
+def test_grad_khatri_rao():
+    _grad_check("khatri_rao",
+                [mx.nd.array(np.random.rand(3, 2).astype("float32")),
+                 mx.nd.array(np.random.rand(4, 2).astype("float32"))],
+                {})
