@@ -79,5 +79,6 @@ if __name__ == "__main__":
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--num-examples", type=int, default=8192)
-    p.add_argument("--hybridize", action="store_true", default=True)
+    p.add_argument("--hybridize", action=argparse.BooleanOptionalAction,
+                   default=True)
     main(p.parse_args())

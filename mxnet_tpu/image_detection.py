@@ -242,7 +242,22 @@ class ImageDetIter(ImageIter):
             a, b = int(raw[0]), int(raw[1])
             if a >= 2 and b >= 5 and raw.size > a \
                     and (raw.size - a) % b == 0:
-                return raw[a:].reshape(-1, b)[:, :5]
+                boxes = raw[a:].reshape(-1, b)[:, :5]
+                # the headed heuristic can false-positive on a flat k*5
+                # list with unnormalized pixel coords (x1 >= 5); headed
+                # labels carry normalized coords, so when BOTH parses are
+                # shape-possible and the headed coords fall outside
+                # [0, 1], refuse rather than return corrupted boxes
+                coords = boxes[:, 1:]
+                ambiguous = raw.size % 5 == 0
+                if ambiguous and coords.size and (
+                        coords.min() < -1e-3 or coords.max() > 1 + 1e-3):
+                    raise MXNetError(
+                        "detection label matches the headed [A, B, ...] "
+                        "pattern but parsed coordinates fall outside "
+                        "[0, 1] — if this is a flat k*5 label, normalize "
+                        "the box coordinates to [0, 1]")
+                return boxes
         if raw.size % 5 != 0:
             raise MXNetError(
                 "detection label of length %d is neither flat k*5 nor "
@@ -263,12 +278,19 @@ class ImageDetIter(ImageIter):
 
             img = imread(fname)
         boxes = self._parse_label(label)
+        # det augmenters index src.shape[2] / assume HWC; normalize a
+        # grayscale decode to a 1-channel HWC array BEFORE the chain
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
         for aug in self._det_augs:
             img, boxes = aug(img, boxes)
         img = np.asarray(img, np.float32)
         if img.ndim == 2:
             img = img[:, :, None]
         c, h, w = self.data_shape
+        if img.shape[2] == 1 and c > 1:
+            img = np.repeat(img, c, axis=2)
         if img.shape[:2] != (h, w):
             img = imresize(img.astype(np.uint8), w, h)
             img = np.asarray(img, np.float32).reshape(h, w, c)

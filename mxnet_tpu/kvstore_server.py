@@ -70,6 +70,7 @@ def _init_kvstore_server_module():
     # workers and schedulers fall through to a normal import
 
 
-if os.environ.get("DMLC_ROLE") == "server" and \
+if os.environ.get("DMLC_ROLE", os.environ.get("MXNET_ROLE", "")) \
+        == "server" and \
         os.environ.get("MXNET_KVSTORE_SERVER_AUTORUN", "1") == "1":
     _init_kvstore_server_module()

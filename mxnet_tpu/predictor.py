@@ -162,6 +162,11 @@ class Predictor:
             "outputs": self._symbol.list_outputs(),
             "label_inputs": [n for n in args if n.endswith("_label")],
         }
+        # input/label buffers hold whatever batch was last fed through
+        # forward(); store zeros so the bundle never bakes in user data
+        data_keys = set(meta["inputs"]) | set(meta["label_inputs"])
+        args = {k: (jax.numpy.zeros_like(v) if k in data_keys else v)
+                for k, v in args.items()}
         with zipfile.ZipFile(path, "w") as zf:
             zf.writestr("model.stablehlo", bytes(exp.serialize()))
             zf.writestr("meta.json", json.dumps(meta))
