@@ -201,11 +201,17 @@ class TrainStep:
         """
         import jax
 
-        from .parallel.sharding import named_sharding, replicated
+        from .parallel.sharding import (batch_axes, named_sharding,
+                                        replicated)
 
         mesh = self.mesh
         repl = replicated(mesh)
-        bshard = named_sharding(mesh, self._batch_sharding_axis)
+        # batch sharding mirrors shard_batch exactly (data axis plus
+        # fsdp when present); pure SP/EP/pipe meshes carry no batch
+        # axis, so the batch stays replicated and the mesh axes are
+        # consumed inside the ops (ring attention, MoE all_to_all)
+        baxes = batch_axes(mesh, self._batch_sharding_axis)
+        bshard = named_sharding(mesh, baxes) if baxes else repl
         if pshard is None:
             pshard = repl
         if sshard is None:

@@ -9,6 +9,7 @@ from ...base import MXNetError
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "InstanceNorm", "LayerNorm", "Embedding", "Flatten", "Activation",
            "LeakyReLU", "Lambda", "HybridLambda", "MultiHeadAttention",
+           "MoE",
            "TransformerBlock"]
 
 
@@ -313,6 +314,55 @@ class MultiHeadAttention(HybridBlock):
             self.out_weight.data(), self.out_bias.data(),
             num_heads=self._num_heads, causal=self._causal,
             seq_parallel=self._seq_parallel)
+
+
+class MoE(HybridBlock):
+    """Top-k routed mixture-of-experts feed-forward (the Gluon face of
+    the ``MoE`` op; routing/dispatch in ``parallel/expert.py``).
+
+    ``forward(x)`` returns ``(out, aux_loss)``: scale ``aux_loss`` (the
+    Switch-style load-balancing term, 1.0 at perfect balance) and add it
+    to the training objective.  With ``expert_parallel=True`` tokens and
+    experts shard over the active mesh's 'expert' axis and the
+    dispatch/return hops ride ``all_to_all`` on ICI."""
+
+    def __init__(self, num_experts, hidden_size=0, top_k=2,
+                 capacity_factor=1.25, expert_parallel=False, in_units=0,
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._num_experts = num_experts
+        self._hidden_size = hidden_size
+        self._top_k = top_k
+        self._capacity_factor = capacity_factor
+        self._expert_parallel = expert_parallel
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(in_units, num_experts),
+                init=weight_initializer, allow_deferred_init=True)
+            self.w1_weight = self.params.get(
+                "w1_weight", shape=(num_experts, in_units, hidden_size),
+                init=weight_initializer, allow_deferred_init=True)
+            self.w2_weight = self.params.get(
+                "w2_weight", shape=(num_experts, hidden_size, in_units),
+                init=weight_initializer, allow_deferred_init=True)
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        d = x.shape[-1]
+        h = self._hidden_size or 4 * d
+        e = self._num_experts
+        for p, shp in ((self.gate_weight, (d, e)),
+                       (self.w1_weight, (e, d, h)),
+                       (self.w2_weight, (e, h, d))):
+            if p._data is None:
+                p._shape_from_data(shp)
+        out, aux = nd.MoE(
+            x, self.gate_weight.data(), self.w1_weight.data(),
+            self.w2_weight.data(), num_experts=e, top_k=self._top_k,
+            hidden_size=h, capacity_factor=self._capacity_factor,
+            expert_parallel=self._expert_parallel)
+        return out, aux
 
 
 class TransformerBlock(HybridBlock):

@@ -16,27 +16,51 @@ from .. import symbol as sym
 
 
 def transformer_block(x, idx, d_model, num_heads, d_ff,
-                      seq_parallel=False):
-    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+                      seq_parallel=False, moe_experts=0, moe_top_k=2,
+                      expert_parallel=False, moe_capacity_factor=1.25):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)).
+
+    With ``moe_experts > 0`` the MLP is a top-k routed
+    mixture-of-experts (``MoE`` op); returns ``(x, aux_loss_sym)``."""
     h = sym.LayerNorm(x, name="blk%d_ln1" % idx)
     h = sym.MultiHeadAttention(h, num_heads=num_heads, causal=True,
                                seq_parallel=seq_parallel,
                                name="blk%d_attn" % idx)
     x = x + h
     h = sym.LayerNorm(x, name="blk%d_ln2" % idx)
-    h = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
-                           name="blk%d_ffn1" % idx)
-    h = sym.Activation(h, act_type="gelu", name="blk%d_gelu" % idx)
-    h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
-                           name="blk%d_ffn2" % idx)
-    return x + h
+    aux = None
+    if moe_experts:
+        moe = sym.MoE(h, num_experts=moe_experts, top_k=moe_top_k,
+                      hidden_size=d_ff, expert_parallel=expert_parallel,
+                      capacity_factor=moe_capacity_factor,
+                      name="blk%d_moe" % idx)
+        h, aux = moe[0], moe[1]
+    else:
+        h = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
+                               name="blk%d_ffn1" % idx)
+        h = sym.Activation(h, act_type="gelu", name="blk%d_gelu" % idx)
+        h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
+                               name="blk%d_ffn2" % idx)
+    return x + h, aux
 
 
 def get_symbol(vocab_size=32000, num_layers=12, d_model=768, num_heads=12,
-               d_ff=None, seq_len=1024, seq_parallel=False, **kwargs):
+               d_ff=None, seq_len=1024, seq_parallel=False,
+               moe_experts=0, moe_top_k=2, moe_aux_coef=0.01,
+               expert_parallel=False, moe_capacity_factor=1.25,
+               **kwargs):
     """``seq_parallel=True`` runs every attention via ring attention over
     the active mesh's 'seq' axis (long-context training: T shards over
-    chips, K/V rotate on ICI)."""
+    chips, K/V rotate on ICI).
+
+    ``moe_experts=E`` swaps every block's MLP for a top-k routed
+    mixture-of-experts; the per-block load-balancing losses are
+    AVERAGED over blocks (so ``moe_aux_coef`` keeps the same meaning at
+    any depth), scaled by ``moe_aux_coef``, and attached as a
+    ``MakeLoss`` head next to the LM loss (so ``Module.fit`` trains
+    both).
+    ``expert_parallel=True`` additionally shards tokens + experts over
+    the active mesh's 'expert' axis (dispatch on ICI all_to_all)."""
     d_ff = d_ff or 4 * d_model
     data = sym.Variable("data")          # (N, T) token ids
     label = sym.Variable("softmax_label")
@@ -45,16 +69,32 @@ def get_symbol(vocab_size=32000, num_layers=12, d_model=768, num_heads=12,
     pos = sym.Variable("pos_embed", shape=(1, seq_len, d_model),
                        init="normal")
     x = sym.broadcast_add(x, pos)
+    # MoE aux losses accumulate as a RUNNING sum so the live set at any
+    # block boundary stays {activations, scalar} — the fixed-width
+    # boundary contract parallel.pipeline.split_symbol cuts at
+    aux_total, n_aux = None, 0
     for i in range(num_layers):
-        x = transformer_block(x, i, d_model, num_heads, d_ff,
-                              seq_parallel=seq_parallel)
+        x, aux = transformer_block(x, i, d_model, num_heads, d_ff,
+                                   seq_parallel=seq_parallel,
+                                   moe_experts=moe_experts,
+                                   moe_top_k=moe_top_k,
+                                   expert_parallel=expert_parallel,
+                                   moe_capacity_factor=moe_capacity_factor)
+        if aux is not None:
+            aux_total = aux if aux_total is None else aux_total + aux
+            n_aux += 1
     x = sym.LayerNorm(x, name="final_ln")
     x = sym.Reshape(x, shape=(-1, d_model))
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
                                 name="lm_head")
     label_f = sym.Reshape(label, shape=(-1,))
-    return sym.SoftmaxOutput(logits, label_f, name="softmax",
-                             normalization="batch")
+    lm = sym.SoftmaxOutput(logits, label_f, name="softmax",
+                           normalization="batch")
+    if aux_total is None:
+        return lm
+    balance = sym.MakeLoss(aux_total * (moe_aux_coef / n_aux),
+                           name="moe_balance")
+    return sym.Group([lm, balance])
 
 
 def count_params(vocab_size=32000, num_layers=12, d_model=768,

@@ -30,7 +30,15 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, pipeline_stages=0,
+                 pipeline_microbatches=None, pipeline_schedule="1f1b"):
+        """``pipeline_stages=S`` trains through pipeline parallelism:
+        the symbol is cut into S heterogeneous stages
+        (``parallel.pipeline.split_symbol``), parameters/optimizer
+        states shard over the active mesh's 'pipe' axis, and ``fit``
+        runs the ``pipeline_schedule`` ('1f1b' or 'gpipe') microbatch
+        wave — requires a mesh with ``{'pipe': S}`` and a dist kvstore.
+        """
         super().__init__(logger=logger)
         from ..context import current_context
 
@@ -40,6 +48,10 @@ class Module(BaseModule):
             context = [context]
         self._context = list(context)
         self._symbol = symbol
+        self._pipeline_stages = int(pipeline_stages)
+        self._pipeline_microbatches = pipeline_microbatches
+        self._pipeline_schedule = pipeline_schedule
+        self._pipeline_stale = False
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
@@ -158,8 +170,22 @@ class Module(BaseModule):
                 initializer(desc, arr)
         self.params_initialized = True
 
+    def _sync_pipeline(self):
+        """Gather live packed pipeline params/states back into the
+        executor dicts (lazy sync point for the stage-sharded step)."""
+        if not getattr(self, "_pipeline_stale", False):
+            return
+        import jax.numpy as jnp
+
+        live = self._fused.unpack_params()
+        for n, v in live.items():
+            self._exec.arg_dict[n]._set_data(jnp.asarray(v))
+        self._fused_states = self._fused.unpack_states()
+        self._pipeline_stale = False
+
     def get_params(self):
         assert self.binded and self.params_initialized
+        self._sync_pipeline()
         arg_params = {n: self._exec.arg_dict[n].copy()
                       for n in self._param_names}
         aux_params = {n: self._exec.aux_dict[n].copy()
@@ -293,6 +319,24 @@ class Module(BaseModule):
                     "compute_dtype=%r was requested but the fused step is "
                     "unavailable: %s" % (self._compute_dtype, reason))
 
+        if self._pipeline_stages > 1:
+            # an EXPLICIT pipeline request never falls back silently
+            from ..parallel.pipeline import PipelineTrainStep
+
+            if self._mesh is None or \
+                    self._mesh.shape.get("pipe") != self._pipeline_stages:
+                raise MXNetError(
+                    "pipeline_stages=%d needs a dist kvstore under an "
+                    "active mesh with {'pipe': %d} (parallel.mesh_scope)"
+                    % (self._pipeline_stages, self._pipeline_stages))
+            self._fused = PipelineTrainStep(
+                self._symbol, optimizer=self._optimizer, mesh=self._mesh,
+                n_microbatches=self._pipeline_microbatches,
+                data_names=self._data_names,
+                label_names=self._label_names,
+                schedule=self._pipeline_schedule,
+                fixed_param_names=self._fixed_param_names)
+            return
         if not get_env("MXNET_FUSED_STEP", True, bool):
             _bail("MXNET_FUSED_STEP=0")
             return
@@ -404,16 +448,25 @@ class Module(BaseModule):
         t = o.num_update
         new_params, new_aux, self._fused_states, outs = self._fused(
             params, aux, self._fused_states, batch, _rnd.next_key(), lr, t)
-        for n, v in new_params.items():
-            self._exec.arg_dict[n]._set_data(v)
-        for n, v in new_aux.items():
-            self._exec.aux_dict[n]._set_data(v)
+        from ..parallel.pipeline import PipelineTrainStep
+
+        if isinstance(self._fused, PipelineTrainStep):
+            # params/states live as packed stage-sharded buffers inside
+            # the step; arg_dict is synced lazily (_sync_pipeline) when
+            # something reads it (eval forward, get_params, checkpoint)
+            self._pipeline_stale = True
+        else:
+            for n, v in new_params.items():
+                self._exec.arg_dict[n]._set_data(v)
+            for n, v in new_aux.items():
+                self._exec.aux_dict[n]._set_data(v)
         self._exec.outputs = [NDArray(o, self._context[0]) for o in outs]
         self._fused_ran = True
 
     # -- compute --------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._sync_pipeline()
         if is_train is None:
             is_train = self.for_training
         inputs = {}
@@ -481,7 +534,21 @@ class Module(BaseModule):
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self._exec.outputs)
+        outputs = self._exec.outputs
+        if len(outputs) > len(labels):
+            # extra loss-only heads (MakeLoss aux terms, e.g. the MoE
+            # load-balance loss) train but are not predictions: pair
+            # each label with its like-named output (softmax_label ->
+            # softmax_output), falling back to position
+            names = self._symbol.list_outputs()
+            picked = []
+            for i, ln in enumerate(self._label_names[:len(labels)]):
+                stem = ln[:-6] if ln.endswith("_label") else ln
+                match = [o for n, o in zip(names, outputs)
+                         if n.startswith(stem)]
+                picked.append(match[0] if match else outputs[i])
+            outputs = picked
+        eval_metric.update(labels, outputs)
 
     def install_monitor(self, monitor):
         assert self.binded

@@ -869,3 +869,42 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
         ctx = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
     return jnp.einsum("ntc,oc->nto", ctx, out_weight) + out_bias
+
+
+@register("_contrib_MoE", aliases=("MoE",), num_outputs=2,
+          spans_mesh=lambda attrs: bool(attrs.get("expert_parallel",
+                                                  False)))
+def _moe(attrs, data, gate_weight, w1_weight, w2_weight):
+    """Top-k routed mixture-of-experts feed-forward (two outputs:
+    ``out`` shaped like ``data`` and the scalar load-balancing aux
+    loss).  Not in the 0.11 reference (MoE post-dates it; SURVEY.md
+    §2.3 mandates expert parallelism as a fresh first-class design).
+    Tokens route to their ``top_k`` experts under a capacity bound;
+    with ``expert_parallel=True`` tokens shard over the active mesh's
+    'expert' axis and dispatch/return ride two ``all_to_all`` hops on
+    ICI (``parallel/expert.py``).  Add the aux output (scaled) to the
+    objective via ``MakeLoss`` to keep experts load-balanced.
+    """
+    from ..parallel.expert import routed_moe_ffn
+
+    top_k = int(attrs.get("top_k", 2))
+    cf = float(attrs.get("capacity_factor", 1.25))
+    n_exp = int(attrs.get("num_experts", gate_weight.shape[1]))
+    if n_exp != w1_weight.shape[0]:
+        raise MXNetError(
+            "MoE: num_experts=%d but w1_weight carries %d experts"
+            % (n_exp, w1_weight.shape[0]))
+    mesh = False  # force the single-device path unless expert_parallel
+    if bool(attrs.get("expert_parallel", False)):
+        from ..parallel import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None or "expert" not in mesh.shape:
+            raise MXNetError(
+                "MoE(expert_parallel=True) needs an active mesh with an "
+                "'expert' axis (parallel.mesh_scope)")
+    shape = data.shape
+    tokens = data.reshape(-1, shape[-1])
+    out, aux = routed_moe_ffn(tokens, gate_weight, w1_weight, w2_weight,
+                              top_k=top_k, capacity_factor=cf, mesh=mesh)
+    return out.reshape(shape), aux

@@ -54,6 +54,7 @@ INPUT_NAMES = {
     "DeformablePSROIPooling": (("data", "rois", "trans"), ()),
     "MultiHeadAttention": (("data", "in_weight", "in_bias", "out_weight",
                             "out_bias"), ()),
+    "MoE": (("data", "gate_weight", "w1_weight", "w2_weight"), ()),
     "quantize": (("data", "min_range", "max_range"), ()),
     "dequantize": (("data", "min_range", "max_range"), ()),
     "count_sketch": (("data", "h", "s"), ()),
@@ -63,7 +64,7 @@ _CONTRIB = ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "Proposal", "MultiProposal", "PSROIPooling",
             "DeformableConvolution", "DeformablePSROIPooling", "CTCLoss",
             "quantize", "dequantize", "count_sketch",
-            "MultiHeadAttention")
+            "MultiHeadAttention", "MoE")
 for _name in _CONTRIB:
     if _name in INPUT_NAMES:
         INPUT_NAMES["_contrib_" + _name] = INPUT_NAMES[_name]

@@ -149,6 +149,19 @@ PARAM_SPECS.update({
          "Ring attention over the active mesh's 'seq' axis "
          "(long-context: shard T over chips, rotate K/V on ICI)."),
     ],
+    "MoE": [
+        ("num_experts", "int", REQUIRED, "Expert count E."),
+        ("top_k", "int", 2, "Experts routed per token."),
+        ("hidden_size", "int", None,
+         "Expert FFN hidden width H (default 4*D; used for parameter "
+         "shape inference)."),
+        ("capacity_factor", "float", 1.25,
+         "Per-expert buffer = ceil(cf * top_k * tokens / E); overflow "
+         "tokens are dropped from that expert."),
+        ("expert_parallel", "bool", False,
+         "Shard tokens + experts over the active mesh's 'expert' axis; "
+         "dispatch/return ride all_to_all on ICI."),
+    ],
     "LayerNorm": [
         ("eps", "float", 1e-5, "Variance epsilon."),
         ("axis", "int", -1, "Normalized axis."),

@@ -17,10 +17,11 @@ from . import expert
 from .mesh import (create_mesh, current_mesh, set_mesh, mesh_scope,
                    init_distributed)
 from .sequence import ring_attention, sequence_parallel_attention
-from .pipeline import pipeline_apply
-from .expert import moe_ffn
+from .pipeline import pipeline_apply, split_symbol, PipelineTrainStep
+from .expert import moe_ffn, routed_moe_ffn
 
 __all__ = ["mesh", "collectives", "sharding", "sequence", "create_mesh",
            "current_mesh", "set_mesh", "mesh_scope", "init_distributed", "ring_attention",
            "sequence_parallel_attention", "pipeline", "expert",
-           "pipeline_apply", "moe_ffn"]
+           "pipeline_apply", "split_symbol", "PipelineTrainStep",
+           "moe_ffn", "routed_moe_ffn"]

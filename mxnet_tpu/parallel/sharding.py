@@ -30,15 +30,24 @@ def replicated(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
+def batch_axes(mesh, axis="data"):
+    """Mesh axes the batch dimension shards over: the data axis (plus
+    'fsdp' when present), or empty for meshes with no batch axis (pure
+    seq/expert/pipe parallelism — the batch replicates and the mesh
+    axes are consumed inside the ops).  Single source of truth for
+    ``shard_batch`` and the fused step's in_shardings."""
+    return tuple(a for a in (axis, "fsdp") if a in mesh.shape)
+
+
 def shard_batch(mesh, x, axis="data"):
     """Device-put a host batch sharded along the batch dimension over the
-    mesh's data axis (the input side of data parallelism)."""
+    mesh's batch axes (the input side of data parallelism)."""
     import jax
 
-    names = [axis]
-    if "fsdp" in mesh.shape:
-        names.append("fsdp")
-    return jax.device_put(x, named_sharding(mesh, tuple(names)))
+    names = batch_axes(mesh, axis)
+    if not names:
+        return jax.device_put(x, replicated(mesh))
+    return jax.device_put(x, named_sharding(mesh, names))
 
 
 def constraint(x, *spec):

@@ -491,6 +491,11 @@ def _fill_param_shapes(node, env, shapes):
         c = data[2]
         set_var(1, (3 * c, c)); set_var(2, (3 * c,))
         set_var(3, (c, c)); set_var(4, (c,))
+    elif op in ("MoE", "_contrib_MoE"):
+        d = data[-1]
+        e = int(a["num_experts"])
+        h = int(a.get("hidden_size", 4 * d))
+        set_var(1, (d, e)); set_var(2, (e, d, h)); set_var(3, (e, h, d))
     elif op == "Custom":
         # the user's CustomOpProp.infer_shape derives every input shape
         # from the data shape (reference python/mxnet/operator.py
@@ -569,6 +574,7 @@ _PARAMETRIC_OPS = {
     # any layer op (python/mxnet/operator.py)
     "Custom",
     "MultiHeadAttention", "_contrib_MultiHeadAttention",
+    "MoE", "_contrib_MoE",
     # sym.RNN(data, state_size=..) auto-creates parameters/state like the
     # reference Compose path; shapes from the RNN branch of
     # _fill_param_shapes

@@ -308,6 +308,10 @@ def _build_specs():
         [_f(2, 4, 8), _f(24, 8) * 0.2, _f(24) * 0.1, _f(8, 8) * 0.2,
          _f(8) * 0.1],
         {"num_heads": 2})
+    s["MoE"] = s["_contrib_MoE"] = (
+        [_f(2, 4, 8), _f(8, 4) * 0.3, _f(4, 8, 16) * 0.3,
+         _f(4, 16, 8) * 0.3],
+        {"num_experts": 4, "top_k": 2, "hidden_size": 16})
     s["_slice_assign"] = s["_crop_assign"] = (
         [_f(4, 4), _f(2, 2)], {"begin": (1, 1), "end": (3, 3)})
     s["_slice_assign_scalar"] = s["_crop_assign_scalar"] = (

@@ -105,3 +105,358 @@ def test_moe_gate_expert_mismatch_raises():
         moe_ffn(x, np.zeros((4, 16), "float32"),
                 np.zeros((8, 4, 8), "float32"),
                 np.zeros((8, 8, 4), "float32"), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# routed top-k MoE (all-to-all dispatch — the first-class training form)
+# ---------------------------------------------------------------------------
+
+def _moe_weights(rs, d, h, e):
+    return (rs.randn(d, e).astype("float32"),
+            (rs.randn(e, d, h) * 0.3).astype("float32"),
+            (rs.randn(e, h, d) * 0.3).astype("float32"))
+
+
+def test_routed_moe_matches_dense_with_ample_capacity():
+    """With capacity >= all tokens, routed dispatch computes exactly the
+    dense top-k mixture (same masked-softmax combine weights)."""
+    from mxnet_tpu.parallel import routed_moe_ffn
+
+    rs = np.random.RandomState(3)
+    b, d, h, e, k = 16, 8, 12, 8, 2
+    x = rs.randn(b, d).astype("float32")
+    gate_w, w1, w2 = _moe_weights(rs, d, h, e)
+    y, aux = routed_moe_ffn(x, gate_w, w1, w2, top_k=k,
+                            capacity_factor=float(e), mesh=False)
+    ref = _ref_moe(x.astype("float64"), gate_w, w1, w2, k)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+    assert 1.0 <= float(aux) < e  # balanced=1, worst=E
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_routed_moe_sharded_matches_local(ep):
+    """Token-sharded all-to-all dispatch over the 'expert' axis equals
+    the single-device routed path (capacity per source group scales so
+    the same tokens survive)."""
+    import jax
+
+    from mxnet_tpu.parallel import routed_moe_ffn
+
+    rs = np.random.RandomState(4)
+    b, d, h, e, k = 16, 8, 12, 8, 2
+    x = rs.randn(b, d).astype("float32")
+    gate_w, w1, w2 = _moe_weights(rs, d, h, e)
+    y_loc, aux_loc = routed_moe_ffn(x, gate_w, w1, w2, top_k=k,
+                                    capacity_factor=float(e), mesh=False)
+    mesh = create_mesh({"expert": ep}, devices=jax.devices()[:ep])
+    with mesh_scope(mesh):
+        y_sh, aux_sh = routed_moe_ffn(x, gate_w, w1, w2, top_k=k,
+                                      capacity_factor=float(e))
+    np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_loc),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux_sh), float(aux_loc), rtol=1e-5)
+
+
+def test_routed_moe_capacity_drops_tokens():
+    from mxnet_tpu.parallel import routed_moe_ffn
+
+    rs = np.random.RandomState(5)
+    x = rs.randn(16, 8).astype("float32")
+    gate_w, w1, w2 = _moe_weights(rs, 8, 12, 8)
+    y_full, _ = routed_moe_ffn(x, gate_w, w1, w2, top_k=2,
+                               capacity_factor=8.0, mesh=False)
+    y_tight, _ = routed_moe_ffn(x, gate_w, w1, w2, top_k=2,
+                                capacity_factor=0.25, mesh=False)
+    assert np.isfinite(np.asarray(y_tight)).all()
+    assert not np.allclose(np.asarray(y_tight), np.asarray(y_full))
+
+
+def test_routed_moe_gradients_flow():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import routed_moe_ffn
+
+    rs = np.random.RandomState(6)
+    x = rs.randn(8, 8).astype("float32")
+    gate_w, w1, w2 = _moe_weights(rs, 8, 12, 4)
+
+    def loss(x, gw, w1, w2):
+        y, aux = routed_moe_ffn(x, gw, w1, w2, top_k=2,
+                                capacity_factor=2.0, mesh=False)
+        return (y ** 2).sum() + 0.01 * aux
+
+    grads = jax.grad(loss, argnums=(0, 1, 2, 3))(
+        jnp.asarray(x), jnp.asarray(gate_w), jnp.asarray(w1),
+        jnp.asarray(w2))
+    for name, g in zip(("x", "gate", "w1", "w2"), grads):
+        assert np.isfinite(np.asarray(g)).all(), name
+        assert float(jnp.abs(g).sum()) > 0, name
+
+
+def test_moe_op_symbol_and_imperative():
+    """The MoE op surfaces through nd./sym. with auto-created weights,
+    shape inference, and a trainable simple_bind executor."""
+    import mxnet_tpu.ndarray as nd
+
+    rs = np.random.RandomState(7)
+    n, t, d, e, h = 2, 4, 8, 4, 16
+    data = nd.array(rs.randn(n, t, d).astype("float32"))
+    gw = nd.array(rs.randn(d, e).astype("float32"))
+    w1 = nd.array((rs.randn(e, d, h) * 0.3).astype("float32"))
+    w2 = nd.array((rs.randn(e, h, d) * 0.3).astype("float32"))
+    out, aux = nd.MoE(data, gw, w1, w2, num_experts=e, top_k=2,
+                      hidden_size=h)
+    assert out.shape == (n, t, d) and aux.shape == ()
+
+    s = mx.sym.MoE(mx.sym.Variable("data"), num_experts=e, top_k=2,
+                   hidden_size=h, name="moe0")
+    assert s.list_arguments() == ["data", "moe0_gate_weight",
+                                  "moe0_w1_weight", "moe0_w2_weight"]
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(n, t, d))
+    assert dict(zip(s.list_arguments(), arg_shapes))["moe0_w1_weight"] \
+        == (e, d, h)
+    assert out_shapes == [(n, t, d), ()]
+    exe = s.simple_bind(mx.cpu(), data=(n, t, d))
+    exe.arg_dict["moe0_gate_weight"][:] = np.asarray(gw.asnumpy())
+    exe.arg_dict["moe0_w1_weight"][:] = np.asarray(w1.asnumpy())
+    exe.arg_dict["moe0_w2_weight"][:] = np.asarray(w2.asnumpy())
+    exe.forward(is_train=True, data=data.asnumpy())
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), out.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    exe.backward()
+    assert abs(exe.grad_dict["moe0_w1_weight"].asnumpy()).sum() > 0
+
+
+def test_gluon_moe_block_trains():
+    """gluon.nn.MoE returns (out, aux); both backprop under autograd."""
+    from mxnet_tpu import autograd, gluon
+    import mxnet_tpu.ndarray as nd
+
+    rs = np.random.RandomState(8)
+    net = gluon.nn.MoE(num_experts=4, hidden_size=16, top_k=2)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.randn(8, 8).astype("float32"))
+    with autograd.record():
+        out, aux = net(x)
+        loss = (out ** 2).sum() + 0.01 * aux
+    loss.backward()
+    g = net.w1_weight.grad()
+    assert abs(g.asnumpy()).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous pipeline (split_symbol + PipelineTrainStep)
+# ---------------------------------------------------------------------------
+
+def _tiny_lm(moe=0, layers=4):
+    from mxnet_tpu.models import transformer
+
+    return transformer.get_symbol(
+        vocab_size=16, num_layers=layers, d_model=16, num_heads=2,
+        seq_len=8, moe_experts=moe, moe_top_k=2,
+        moe_capacity_factor=float(max(moe, 1)))
+
+
+def _lm_batch(n=8, seed=0):
+    rs = np.random.RandomState(seed)
+    data = rs.randint(0, 16, (n, 8)).astype("float32")
+    return data, (3 * data + 1) % 16
+
+
+def test_split_symbol_chained_equals_full():
+    """Stage symbols composed in sequence compute exactly the full
+    graph (embed -> blocks -> head decomposition, heterogeneous
+    per-stage params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import _trace_fn
+    from mxnet_tpu.parallel import split_symbol
+    from mxnet_tpu.symbol.symbol import _infer_param_shapes
+
+    sym = _tiny_lm()
+    stages = split_symbol(sym, 4)
+    assert len(stages) == 4
+    # params partition exactly (no sharing, nothing lost)
+    feed = {"data", "softmax_label"}
+    all_params = [a for a in sym.list_arguments() if a not in feed]
+    staged = []
+    for s in stages:
+        staged += [a for a in s.list_arguments() if a not in feed
+                   and not a.startswith("pipe_in")]
+    assert sorted(staged) == sorted(all_params)
+
+    full_fn, full_args, _ = _trace_fn(sym, is_train=True)
+    shapes = _infer_param_shapes(sym, {"data": (2, 8),
+                                       "softmax_label": (2, 8)})
+    rs = np.random.RandomState(0)
+    data, label = _lm_batch(2)
+    vals = {"data": jnp.asarray(data), "softmax_label": jnp.asarray(label)}
+    for n in full_args:
+        if n not in vals:
+            vals[n] = jnp.asarray(
+                rs.randn(*shapes[n]).astype("float32") * 0.1)
+    rng = jax.random.PRNGKey(0)
+    ref_outs, _ = full_fn(vals, {}, rng)
+    carry = None
+    for s in stages:
+        fn, anames, _ = _trace_fn(s, is_train=True)
+        args = {n: (carry[int(n[7:])] if n.startswith("pipe_in")
+                    else vals[n]) for n in anames}
+        carry, _ = fn(args, {}, rng)
+    for r, c in zip(ref_outs, carry):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(c),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_split_symbol_rejects_single_stage():
+    from mxnet_tpu.parallel import split_symbol
+
+    with pytest.raises(mx.base.MXNetError):
+        split_symbol(_tiny_lm(), 1)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+@pytest.mark.parametrize("moe", [0, 4])
+def test_pipeline_train_step_matches_dense(schedule, moe):
+    """The pipelined step (heterogeneous stages over the 'pipe' axis)
+    produces the SAME outputs and SAME updated parameters as the dense
+    single-program fused step — for both schedules, with and without
+    routed-MoE FFNs."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.fused import TrainStep
+    from mxnet_tpu.parallel import PipelineTrainStep
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    sym = _tiny_lm(moe=moe)
+    data, label = _lm_batch(8)
+    batch = {"data": jnp.asarray(data),
+             "softmax_label": jnp.asarray(label)}
+    rng = jax.random.PRNGKey(0)
+    dense = TrainStep(sym, optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    params0, aux0, states0 = dense.init_state(
+        {"data": (8, 8), "softmax_label": (8, 8)})
+    dp, _, _, douts = dense(jax.tree.map(jnp.array, params0), dict(aux0),
+                            jax.tree.map(jnp.array, states0), batch, rng)
+
+    mesh = create_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    with mesh_scope(mesh):
+        pstep = PipelineTrainStep(
+            sym, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, mesh=mesh,
+            n_microbatches=4, schedule=schedule)
+        _, _, _, pouts = pstep(dict(params0), {},
+                               jax.tree.map(jnp.array, states0), batch,
+                               rng)
+        new_params = pstep.unpack_params()
+        # packed params are stage-sharded on device
+        shard = next(iter(pstep._packed_params.addressable_shards))
+        assert shard.data.shape[0] * 4 == pstep._packed_params.shape[0]
+    # MoE parity is approximate by design: the balance loss is
+    # nonlinear in the batch, so computing it per microbatch (GShard
+    # groups) differs from the dense full-batch value; with the small
+    # default moe_aux_coef the parameter drift stays tiny.  Pure-matmul
+    # stages match to float noise.
+    rtol, atol = (1e-3, 1e-4) if moe else (1e-4, 1e-5)
+    np.testing.assert_allclose(np.asarray(pouts[0]),
+                               np.asarray(douts[0]), rtol=rtol,
+                               atol=atol)
+    for name in ("lm_head_weight", "tok_embed_weight"):
+        np.testing.assert_allclose(np.asarray(new_params[name]),
+                                   np.asarray(dp[name]), rtol=rtol,
+                                   atol=atol, err_msg=name)
+
+
+def test_pipeline_module_fit_trains_lm():
+    """Module.fit(pipeline_stages=4) trains the MoE transformer LM over
+    a 'pipe' mesh — the first-class Module entry (VERDICT round-3 next
+    item 1); eval/score syncs the stage-sharded params lazily."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    sym = _tiny_lm(moe=4)
+    data, label = _lm_batch(64)
+    it = mx.io.NDArrayIter(data, label, batch_size=16)
+    mesh = create_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    with mesh_scope(mesh):
+        mod = mx.mod.Module(sym, context=mx.tpu(0), pipeline_stages=4,
+                            pipeline_microbatches=4)
+        mod.fit(it, num_epoch=15, optimizer="adam",
+                kvstore="dist_tpu_sync",
+                optimizer_params={"learning_rate": 0.02},
+                initializer=mx.init.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None))
+        from mxnet_tpu.parallel import PipelineTrainStep
+
+        assert isinstance(mod._fused, PipelineTrainStep)
+        score = dict(mod.score(it,
+                               mx.metric.Perplexity(ignore_label=None)))
+    assert score["perplexity"] < 3.0, score
+
+
+def test_pipeline_requires_pipe_mesh():
+    sym = _tiny_lm()
+    data, label = _lm_batch(16)
+    it = mx.io.NDArrayIter(data, label, batch_size=16)
+    mod = mx.mod.Module(sym, context=mx.cpu(), pipeline_stages=4)
+    with pytest.raises(mx.base.MXNetError, match="pipe"):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                initializer=mx.init.Xavier())
+
+
+def test_pipeline_rejects_rng_and_aux_ops():
+    from mxnet_tpu.parallel import PipelineTrainStep
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    mesh = create_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    d = mx.sym.Variable("data")
+    drop = mx.sym.FullyConnected(d, num_hidden=8, name="fc0")
+    drop = mx.sym.Dropout(drop, p=0.5)
+    drop = mx.sym.SoftmaxOutput(drop, name="softmax")
+    with pytest.raises(mx.base.MXNetError, match="rng|Dropout"):
+        PipelineTrainStep(drop, mesh=mesh)
+    bn = mx.sym.FullyConnected(d, num_hidden=8, name="fc0")
+    bn = mx.sym.BatchNorm(bn, name="bn0")
+    bn = mx.sym.SoftmaxOutput(bn, name="softmax")
+    with pytest.raises(mx.base.MXNetError, match="aux|BatchNorm"):
+        PipelineTrainStep(bn, mesh=mesh)
+
+
+def test_moe_transformer_trains_expert_parallel():
+    """Flagship: a transformer LM with routed-MoE FFNs trains through
+    Module.fit over an 'expert' mesh with the fused SPMD step engaged,
+    aux balance loss attached via MakeLoss."""
+    import jax
+
+    from mxnet_tpu.models import transformer
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    v, t, n = 16, 8, 8
+    sym = transformer.get_symbol(vocab_size=v, num_layers=2, d_model=16,
+                                 num_heads=2, seq_len=t, moe_experts=4,
+                                 moe_top_k=2, expert_parallel=True)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, v, (64, t)).astype("float32")
+    labels = (3 * toks + 1) % v
+    it = mx.io.NDArrayIter(toks, labels, batch_size=n)
+    mesh = create_mesh({"expert": 4}, devices=jax.devices()[:4])
+    with mesh_scope(mesh):
+        mod = mx.mod.Module(sym, context=mx.tpu(0))
+        mod.fit(it, num_epoch=12, optimizer="adam",
+                kvstore="dist_tpu_sync",
+                optimizer_params={"learning_rate": 0.02},
+                initializer=mx.init.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None))
+        assert mod._fused is not None, "fused SPMD step did not engage"
+        score = dict(mod.score(it,
+                               mx.metric.Perplexity(ignore_label=None)))
+    assert score["perplexity"] < 3.0, score
