@@ -68,19 +68,26 @@ class KVStore:
             from .parallel import init_distributed
 
             init_distributed()
-        if "async" in kv_type:
-            # In the reference, dist_async servers apply each worker's
-            # gradient immediately without a merge barrier
-            # (kvstore_dist_server.h sync_mode_=false).  The SPMD design
-            # has no servers and every replica steps in lockstep, so
-            # async degenerates to synchronous updates.  This is a
-            # documented alias, not silent: warn once.
-            import logging
+        self._is_async = "async" in kv_type
+        if self._is_async:
+            # The reference's dist_async servers apply each worker's
+            # gradient immediately, no merge barrier
+            # (kvstore_dist_server.h sync_mode_=false at :226).  The
+            # TPU-native equivalent of "workers progress without
+            # per-step coordination" is bounded-staleness LOCAL
+            # updates: each host applies its own gradients immediately
+            # (sync over ICI within its slice, zero DCN traffic per
+            # step) and hosts meet only at parameter-AVERAGING rounds —
+            # every epoch, plus every MXNET_ASYNC_SYNC_PERIOD local
+            # updates when set (>0 requires all hosts to run the same
+            # number of steps per epoch, since averaging is a
+            # collective).  Staleness is bounded by the averaging
+            # window; see docs/distributed.md.
+            from .base import get_env
 
-            logging.getLogger(__name__).warning(
-                "kvstore %r: asynchronous server semantics do not exist "
-                "under single-controller SPMD; updates are synchronous "
-                "(equivalent to dist_tpu_sync)", kv_type)
+            self._async_period = get_env("MXNET_ASYNC_SYNC_PERIOD", 0,
+                                         int)
+            self._async_steps = 0
 
     # -- identity -------------------------------------------------------
     @property
@@ -121,7 +128,7 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %r not initialized" % k)
             merged = self._reduce(vs)
-            if self._is_dist:
+            if self._is_dist and not self._is_async:
                 if isinstance(merged, BaseSparseNDArray):
                     import jax
 
@@ -251,6 +258,33 @@ class KVStore:
     @property
     def updater(self):
         return self._updater
+
+    # -- async (bounded-staleness) parameter averaging ------------------
+    def sync_params(self, arrays):
+        """Average parameter arrays across processes (one blocking DCN
+        collective per array) — the dist_async averaging round.  Every
+        process must call this the same number of times.  No-op
+        single-process."""
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        for arr in arrays:
+            gathered = multihost_utils.process_allgather(arr._data)
+            arr._set_data(jax.device_put(gathered.mean(axis=0)))
+
+    def _async_tick(self, arrays):
+        """Count one local update; run an averaging round every
+        ``MXNET_ASYNC_SYNC_PERIOD`` updates (0 = epoch-end rounds only,
+        driven by the trainer)."""
+        if not self._is_async:
+            return
+        self._async_steps += 1
+        if self._async_period > 0 and \
+                self._async_steps % self._async_period == 0:
+            self.sync_params(arrays)
 
     # -- barriers / control --------------------------------------------
     def barrier(self):

@@ -41,6 +41,10 @@ class BaseModule:
     def update(self):
         raise NotImplementedError
 
+    def _epoch_end_sync(self):
+        """Epoch-boundary synchronization hook (dist_async averaging
+        round); default no-op."""
+
     def get_outputs(self, merge_multi_context=True):
         raise NotImplementedError
 
@@ -204,6 +208,7 @@ class BaseModule:
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
                              time.time() - tic)
 
+            self._epoch_end_sync()
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)
 

@@ -169,6 +169,20 @@ class Module(BaseModule):
                 desc = InitDesc(name, self._symbol.attr_dict().get(name, {}))
                 initializer(desc, arr)
         self.params_initialized = True
+        # a live pipelined step caches params/states in packed
+        # stage-sharded buffers; newly set params must invalidate them
+        # (optimizer states carry over) or the next step trains on
+        # stale weights
+        fused = getattr(self, "_fused", None)
+        if fused is not None and \
+                getattr(fused, "_packed_params", None) is not None:
+            from ..parallel.pipeline import PipelineTrainStep
+
+            if isinstance(fused, PipelineTrainStep):
+                self._fused_states = fused.unpack_states()
+                fused._packed_params = None
+                fused._packed_states = None
+                self._pipeline_stale = False
 
     def _sync_pipeline(self):
         """Gather live packed pipeline params/states back into the
@@ -253,6 +267,11 @@ class Module(BaseModule):
                 kvstore_inst.init(i, self._exec.arg_dict[name])
             if update_on_kvstore:
                 kvstore_inst.set_optimizer(optimizer)
+            if getattr(kvstore_inst, "_is_async", False):
+                # hosts must start from one common point; one averaging
+                # round over the (identically- or differently-) seeded
+                # initial params establishes it
+                kvstore_inst.sync_params(self._async_params())
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)
 
@@ -277,8 +296,12 @@ class Module(BaseModule):
 
         mesh = current_mesh()
         if mesh is None:
+            # meshes stay process-LOCAL: in-jit collectives ride ICI
+            # within this host's slice; cross-process traffic goes
+            # through the kvstore DCN branch (sync) or the averaging
+            # rounds (async)
             devices = [c.jax_device for c in self._context] \
-                if len(self._context) > 1 else list(jax.devices())
+                if len(self._context) > 1 else list(jax.local_devices())
             if len(devices) <= 1:
                 return None
             mesh = create_mesh({"data": len(devices)}, devices=devices)
@@ -323,6 +346,17 @@ class Module(BaseModule):
             # an EXPLICIT pipeline request never falls back silently
             from ..parallel.pipeline import PipelineTrainStep
 
+            if self._kvstore is not None and \
+                    getattr(self._kvstore, "_is_async", False):
+                raise MXNetError(
+                    "pipeline_stages cannot combine with dist_async "
+                    "(packed stage-sharded params have no averaging "
+                    "round); use a sync kvstore")
+            if self.inputs_need_grad:
+                raise MXNetError(
+                    "pipeline_stages cannot serve inputs_need_grad "
+                    "(the pipelined step does not populate data input "
+                    "gradients); use the non-pipelined module")
             if self._mesh is None or \
                     self._mesh.shape.get("pipe") != self._pipeline_stages:
                 raise MXNetError(
@@ -339,6 +373,18 @@ class Module(BaseModule):
             return
         if not get_env("MXNET_FUSED_STEP", True, bool):
             _bail("MXNET_FUSED_STEP=0")
+            return
+        import jax
+
+        if jax.process_count() > 1 and self._kvstore is not None and \
+                "dist" in self._kvstore.type and \
+                not getattr(self._kvstore, "_is_async", False):
+            # multi-process SYNC training reduces gradients over DCN in
+            # the kvstore push path; the fused in-jit step only covers
+            # this host's mesh, so it would silently skip the
+            # cross-process merge — use the split path
+            _bail("multi-process sync kvstore uses the split push/pull "
+                  "path for the DCN gradient merge")
             return
         if self.inputs_need_grad:
             # the fused step does not populate grad_dict for data inputs;
@@ -504,6 +550,7 @@ class Module(BaseModule):
             self.optimizer_initialized
         if getattr(self, "_fused_ran", False):
             self._fused_ran = False  # fused step already applied the update
+            self._async_tick()
             return
         if self._kvstore:
             for i, name in enumerate(self._param_names):
@@ -524,6 +571,22 @@ class Module(BaseModule):
                 g = self._exec.grad_dict.get(name)
                 if g is not None:
                     self._updater(i, g, w)
+        self._async_tick()
+
+    def _async_params(self):
+        return [self._exec.arg_dict[n] for n in self._param_names]
+
+    def _async_tick(self):
+        kv = self._kvstore
+        if kv is not None and getattr(kv, "_is_async", False):
+            kv._async_tick(self._async_params())
+
+    def _epoch_end_sync(self):
+        """dist_async: epoch-boundary parameter-averaging round (the
+        always-on bounded-staleness sync point)."""
+        kv = self._kvstore
+        if kv is not None and getattr(kv, "_is_async", False):
+            kv.sync_params(self._async_params())
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

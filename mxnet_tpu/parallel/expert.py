@@ -149,7 +149,10 @@ def routed_moe_ffn(x, gate_w, w1, w2, top_k=2, capacity_factor=1.25,
     else:
         n_dev = 1
         b_group = x.shape[0]
-    capacity = max(1, -(-int(capacity_factor * top_k * b_group) // n_exp))
+    import math
+
+    capacity = max(1, math.ceil(capacity_factor * top_k * b_group
+                                / n_exp))
     if top_k > n_exp:
         raise MXNetError("top_k=%d exceeds num experts %d"
                          % (top_k, n_exp))

@@ -341,6 +341,23 @@ def split_symbol(sym, n_stages, data_names=("data",),
         stage_syms.append(Symbol(outs))
         in_entries = out_keys if k < n_stages - 1 else []
         prev_cut = end
+
+    # a parameter Variable consumed by more than one stage (weight
+    # tying) would pack as independent per-stage copies with partial
+    # gradients — silently wrong; refuse
+    feed = set(data_names) | set(label_names)
+    seen_params = {}
+    for k, ssym in enumerate(stage_syms):
+        for a in ssym.list_arguments():
+            if a in feed or a.startswith("pipe_in"):
+                continue
+            if a in seen_params:
+                raise MXNetError(
+                    "parameter %r is shared between pipeline stages %d "
+                    "and %d (weight tying); tied weights cannot shard "
+                    "over stages — untie them or use the dense fused "
+                    "step" % (a, seen_params[a], k))
+            seen_params[a] = k
     return stage_syms
 
 
@@ -499,12 +516,21 @@ class PipelineTrainStep:
         # normalized heads (grad ~ 1/mb per micro) need 1/M for parity
         # with the dense full-batch step
         if grad_scale is None:
-            batchnorm_heads = [
-                n for n in symbol._topo()
-                if not n.is_variable and n.op.name in
-                ("SoftmaxOutput", "Softmax")
-                and n.attrs.get("normalization") == "batch"]
-            grad_scale = 1.0 / self.n_micro if batchnorm_heads else 1.0
+            loss_heads = [n for n in symbol._topo() if not n.is_variable
+                          and n.op.name in
+                          ("SoftmaxOutput", "Softmax", "SVMOutput",
+                           "LinearRegressionOutput",
+                           "LogisticRegressionOutput",
+                           "MAERegressionOutput")]
+            batch_heads = [n for n in loss_heads
+                           if n.attrs.get("normalization") == "batch"]
+            sum_heads = [n for n in loss_heads if n not in batch_heads]
+            if batch_heads and sum_heads:
+                raise MXNetError(
+                    "symbol mixes batch-normalized and sum-normalized "
+                    "loss heads; one grad scale cannot match both under "
+                    "microbatching — pass grad_scale explicitly")
+            grad_scale = 1.0 / self.n_micro if batch_heads else 1.0
         self.grad_scale = float(grad_scale)
 
         self._built = None      # lazy: needs concrete batch shapes

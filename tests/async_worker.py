@@ -1,0 +1,73 @@
+"""Worker for the two-process dist_async test.
+
+Usage: async_worker.py <coordinator> <num_procs> <rank> <outdir>
+
+Each rank trains on a DIFFERENT-SIZED shard of a separable toy task
+through ``Module.fit(kvstore='dist_async')`` — per-host local updates
+with zero per-step DCN traffic, meeting only at the epoch-boundary
+parameter-averaging rounds (the TPU-native bounded-staleness answer to
+the reference's serverside immediate-apply,
+``src/kvstore/kvstore_dist_server.h:226``).  The ranks therefore run
+DIFFERENT numbers of optimizer updates (asserted by the runner) yet end
+with identical, converged parameters.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    coordinator, num_procs, rank, outdir = sys.argv[1:5]
+    num_procs, rank = int(num_procs), int(rank)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_procs,
+                               process_id=rank)
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    # different shard sizes -> different local step counts per epoch
+    shard = 48 if rank == 0 else 80
+    rs = np.random.RandomState(100 + rank)   # different data AND seed
+    w_true = np.random.RandomState(7).randn(8, 3).astype("float32")
+    X = rs.randn(shard, 8).astype("float32")
+    y = (X @ w_true).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=8, kvstore="dist_async", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(
+                rnd_type="gaussian", magnitude=2.0),
+            eval_metric=metric)
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+
+    params, _ = mod.get_params()
+    np.savez(os.path.join(outdir, "async_params_rank%d.npz" % rank),
+             **{k: v.asnumpy() for k, v in params.items()})
+    with open(os.path.join(outdir,
+                           "async_result_rank%d.json" % rank), "w") as f:
+        json.dump({"num_update": mod._optimizer.num_update,
+                   "accuracy": float(acc)}, f)
+    print("ASYNC WORKER %d DONE updates=%d acc=%.3f"
+          % (rank, mod._optimizer.num_update, acc))
+
+
+if __name__ == "__main__":
+    main()
