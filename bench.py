@@ -81,6 +81,21 @@ def _measure(step, shapes, batch, iters=20):
     return batch * iters / (time.perf_counter() - t0), xla_flops
 
 
+def _bench_model(sym, batch, compute_dtype, image_shape=(3, 224, 224),
+                 iters=20):
+    """img/s for one model config on the current chip."""
+    from mxnet_tpu.fused import TrainStep
+
+    step = TrainStep(
+        sym, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "rescale_grad": 1.0 / batch},
+        compute_dtype=compute_dtype)
+    shapes = {"data": (batch,) + tuple(image_shape),
+              "softmax_label": (batch,)}
+    return _measure(step, shapes, batch, iters=iters)
+
+
 def main():
     import jax
 
@@ -132,6 +147,28 @@ def main():
         "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
+    # the BASELINE distributed-scaling flagships (docs/how_to/
+    # perf.md:157-167: alexnet bs256 483.37 img/s, inception-v3 bs32
+    # 29.62 img/s on K80) — single-chip rows so BENCH anchors more than
+    # one model family.  Skipped under --fp32/--resnet-only.
+    if not fp32 and "--resnet-only" not in sys.argv:
+        try:
+            from mxnet_tpu.models import alexnet, inception_v3
+
+            alex_s, _ = _bench_model(alexnet.get_symbol(1000), 512,
+                                     compute_dtype)
+            result["alexnet_train_images_per_sec_per_chip"] = \
+                round(alex_s, 2)
+            result["alexnet_vs_baseline"] = round(alex_s / 483.37, 2)
+            inc_s, _ = _bench_model(inception_v3.get_symbol(1000), 128,
+                                    compute_dtype,
+                                    image_shape=(3, 299, 299), iters=10)
+            result["inception_v3_train_images_per_sec_per_chip"] = \
+                round(inc_s, 2)
+            result["inception_v3_vs_baseline"] = round(inc_s / 29.62, 2)
+        except Exception as exc:  # keep the primary metric robust
+            result["secondary_model_error"] = str(exc)[:200]
+
     # secondary metric: the MXU-bound transformer workload, where the
     # framework's compute ceiling shows (ResNet-50@224 is HBM-bound on
     # this hardware generation — see README).  Skipped under --fp32.

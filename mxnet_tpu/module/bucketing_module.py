@@ -235,6 +235,9 @@ class BucketingModule(BaseModule):
         self._params_dirty = True
         self._curr_module.update()
 
+    def _epoch_end_sync(self):
+        self._curr_module._epoch_end_sync()
+
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
         return self._curr_module.get_outputs(merge_multi_context)

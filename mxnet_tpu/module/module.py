@@ -172,14 +172,20 @@ class Module(BaseModule):
         # a live pipelined step caches params/states in packed
         # stage-sharded buffers; newly set params must invalidate them
         # (optimizer states carry over) or the next step trains on
-        # stale weights
+        # stale weights.  When arg_dict is already in sync
+        # (_pipeline_stale False — e.g. fit's per-epoch
+        # get_params/set_params round-trip just ran _sync_pipeline),
+        # the states dict is current too and the device unpack is
+        # skipped; the one repack on the next step is the price of
+        # honoring a potential external write.
         fused = getattr(self, "_fused", None)
         if fused is not None and \
                 getattr(fused, "_packed_params", None) is not None:
             from ..parallel.pipeline import PipelineTrainStep
 
             if isinstance(fused, PipelineTrainStep):
-                self._fused_states = fused.unpack_states()
+                if getattr(self, "_pipeline_stale", False):
+                    self._fused_states = fused.unpack_states()
                 fused._packed_params = None
                 fused._packed_states = None
                 self._pipeline_stale = False
@@ -574,7 +580,10 @@ class Module(BaseModule):
         self._async_tick()
 
     def _async_params(self):
-        return [self._exec.arg_dict[n] for n in self._param_names]
+        # aux states (BN moving stats) average too — per-shard moving
+        # stats would diverge without bound otherwise
+        return [self._exec.arg_dict[n] for n in self._param_names] + \
+               [self._exec.aux_dict[n] for n in self._aux_names]
 
     def _async_tick(self):
         kv = self._kvstore
@@ -717,4 +726,9 @@ def _create_kvstore(kvstore, num_device, arg_params):
     from ..base import get_env
 
     update_on_kvstore = get_env("MXNET_UPDATE_ON_KVSTORE", True, bool)
+    if getattr(kv, "_is_async", False):
+        # dist_async updates are LOCAL by design; pulling weights from
+        # the store's private copies would undo the averaging rounds
+        # (sync_params rewrites the executor arrays, not the store)
+        update_on_kvstore = False
     return kv, update_on_kvstore

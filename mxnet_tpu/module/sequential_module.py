@@ -164,6 +164,10 @@ class SequentialModule(BaseModule):
         for mod in self._modules:
             mod.update()
 
+    def _epoch_end_sync(self):
+        for mod in self._modules:
+            mod._epoch_end_sync()
+
     def get_outputs(self, merge_multi_context=True):
         return self._modules[-1].get_outputs(merge_multi_context)
 
