@@ -1,0 +1,126 @@
+/*
+ * C ABI for mxnet_tpu — NDArray / imperative invoke / Symbol / Executor
+ * groups, following the reference surface in include/mxnet/c_api.h
+ * (NDArray :241-640, imperative invoke c_api_ndarray.cc:548, Symbol
+ * :841-1260, Executor :1270-1400) so C/C++ frontends written against the
+ * reference port by relinking.  The deployment-only predictor surface
+ * lives in c_predict_api.h.
+ *
+ * Design: the compute path is XLA via the Python package (the executor
+ * compiles bound graphs to single XLA programs); this library embeds
+ * CPython and drives the package — the documented layering inversion of
+ * this framework (the runtime IS jax/XLA).  Handles own Python object
+ * references; every call is GIL-serialized and sets MXGetLastError on
+ * failure (return -1).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef const void *AtomicSymbolCreator;
+
+/* dtype codes (reference mshadow convention) */
+#define MXNET_TPU_DTYPE_FLOAT32 0
+#define MXNET_TPU_DTYPE_FLOAT64 1
+#define MXNET_TPU_DTYPE_FLOAT16 2
+#define MXNET_TPU_DTYPE_UINT8 3
+#define MXNET_TPU_DTYPE_INT32 4
+#define MXNET_TPU_DTYPE_INT8 5
+#define MXNET_TPU_DTYPE_INT64 6
+
+const char *MXGetLastError();
+
+/* ---- NDArray ---------------------------------------------------------- */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+
+/* ---- op registry + imperative invoke ---------------------------------- */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+/* invoke one op imperatively (reference MXImperativeInvoke,
+ * src/c_api/c_api_ndarray.cc:548).  *num_outputs must be 0 on entry;
+ * *outputs receives a library-owned array valid until the next invoke
+ * on the same thread.  Param values are parsed as Python literals
+ * (ints/floats/tuples/bools), falling back to strings. */
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+
+/* ---- Symbol ----------------------------------------------------------- */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* atomic symbol = op + attrs, inputs bound later via Compose
+ * (reference MXSymbolCreateAtomicSymbol + MXSymbolCompose) */
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array);
+/* infer shapes from named input shapes (reference MXSymbolInferShape;
+ * the CSR (ind_ptr, shape_data) encoding is the reference's).  Output
+ * arrays are handle-owned, valid until the next call on the handle. */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolFree(SymbolHandle sym);
+
+/* ---- Executor --------------------------------------------------------- */
+/* reference MXExecutorBind (c_api.h:1270+): grad_req codes
+ * 0=null, 1=write, 3=add */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   mx_uint num_args, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store,
+                   const mx_uint *grad_req_type, mx_uint num_aux,
+                   NDArrayHandle *aux_states, ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint num_head_grads,
+                       NDArrayHandle *head_grads);
+/* library-owned handle array, valid until the next call on the handle */
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

@@ -1,0 +1,282 @@
+// C++ convenience layer over the mxnet_tpu C ABI — the cpp-package
+// analogue (reference cpp-package/include/mxnet-cpp/: Symbol, NDArray,
+// Operator, Executor wrappers over include/mxnet/c_api.h).  Header-only;
+// link against _build/c_api.so.  Ops are surfaced both through the
+// generic Operator builder (reference op.h Operator("name").SetParam(...)
+// .CreateSymbol()) and through the registry-generated functions in
+// op.h (tools/gen_cpp_package.py — the same generated-frontend story as
+// the Python nd/sym modules).
+#ifndef MXNET_TPU_CPP_MXNET_CPP_H_
+#define MXNET_TPU_CPP_MXNET_CPP_H_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../c_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  // own=false wraps a library-owned handle (e.g. MXImperativeInvoke
+  // outputs, which the library recycles on the next invoke) without
+  // freeing it — owning such a handle would double-free
+  explicit NDArray(NDArrayHandle h, bool own = true)
+      : h_(h, own ? Deleter : NoopDeleter) {}
+  NDArray(const std::vector<mx_uint> &shape, int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()), 1, 0, 0,
+                            dtype, &h));
+    h_.reset(h, Deleter);
+  }
+  NDArray(const std::vector<mx_uint> &shape,
+          const std::vector<float> &data)
+      : NDArray(shape) {
+    SyncCopyFromCPU(data);
+  }
+  void SyncCopyFromCPU(const std::vector<float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(get(), data.data(), data.size()));
+  }
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(get(), out.data(), out.size()));
+    return out;
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint nd = 0;
+    const mx_uint *dims = nullptr;
+    Check(MXNDArrayGetShape(get(), &nd, &dims));
+    return std::vector<mx_uint>(dims, dims + nd);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+  NDArrayHandle get() const { return h_.get(); }
+
+ private:
+  static void Deleter(void *h) {
+    if (h) MXNDArrayFree(h);
+  }
+  static void NoopDeleter(void *) {}
+  std::shared_ptr<void> h_;
+};
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(h, Deleter) {}
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  std::string ToJSON() const {
+    const char *js = nullptr;
+    Check(MXSymbolSaveToJSON(get(), &js));
+    return js;
+  }
+  std::vector<std::string> ListArguments() const {
+    return Names("args");
+  }
+  std::vector<std::string> ListOutputs() const { return Names("outs"); }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return Names("aux");
+  }
+  // named-input shape inference; returns per-argument shapes in
+  // ListArguments() order (plus outputs/aux via pointers if wanted)
+  std::vector<std::vector<mx_uint>> InferArgShapes(
+      const std::map<std::string, std::vector<mx_uint>> &shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0}, data;
+    for (auto &kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n, out_n, aux_n;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_d, **out_d, **aux_d;
+    int complete = 0;
+    Check(MXSymbolInferShape(
+        get(), static_cast<mx_uint>(keys.size()), keys.data(),
+        indptr.data(), data.data(), &in_n, &in_nd, &in_d, &out_n,
+        &out_nd, &out_d, &aux_n, &aux_nd, &aux_d, &complete));
+    std::vector<std::vector<mx_uint>> out;
+    for (mx_uint i = 0; i < in_n; ++i)
+      out.emplace_back(in_d[i], in_d[i] + in_nd[i]);
+    return out;
+  }
+  SymbolHandle get() const { return h_.get(); }
+
+ private:
+  std::vector<std::string> Names(const std::string &which) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    if (which == "args")
+      Check(MXSymbolListArguments(get(), &n, &arr));
+    else if (which == "outs")
+      Check(MXSymbolListOutputs(get(), &n, &arr));
+    else
+      Check(MXSymbolListAuxiliaryStates(get(), &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  static void Deleter(void *h) {
+    if (h) MXSymbolFree(h);
+  }
+  std::shared_ptr<void> h_;
+};
+
+// the reference cpp-package Operator builder: set params, push inputs,
+// create the composed symbol (missing parameter inputs are auto-created
+// like the Python frontend)
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_(op_name) {}
+  Operator &SetParam(const std::string &key, const std::string &value) {
+    params_[key] = value;
+    return *this;
+  }
+  template <typename T>
+  Operator &SetParam(const std::string &key, T value) {
+    params_[key] = std::to_string(value);
+    return *this;
+  }
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    input_keys_.push_back(name);
+    inputs_.push_back(sym);
+    return *this;
+  }
+  Operator &PushInput(const Symbol &sym) {
+    inputs_.push_back(sym);
+    return *this;
+  }
+  Symbol CreateSymbol(const std::string &name = "") {
+    if (!input_keys_.empty() && input_keys_.size() != inputs_.size())
+      throw std::runtime_error(
+          "Operator: SetInput and PushInput cannot be mixed (" +
+          std::to_string(input_keys_.size()) + " named vs " +
+          std::to_string(inputs_.size()) + " total inputs)");
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle atomic = nullptr;
+    // creators are op-name pointers (MXSymbolGetAtomicSymbolName)
+    Check(MXSymbolCreateAtomicSymbol(
+        static_cast<AtomicSymbolCreator>(
+            static_cast<const void *>(op_.c_str())),
+        static_cast<mx_uint>(keys.size()), keys.data(), vals.data(),
+        &atomic));
+    Symbol result(atomic);
+    std::vector<SymbolHandle> args;
+    for (auto &s : inputs_) args.push_back(s.get());
+    std::vector<const char *> ikeys;
+    for (auto &k : input_keys_) ikeys.push_back(k.c_str());
+    Check(MXSymbolCompose(
+        atomic, name.empty() ? nullptr : name.c_str(),
+        static_cast<mx_uint>(args.size()),
+        ikeys.size() == args.size() && !ikeys.empty() ? ikeys.data()
+                                                      : nullptr,
+        args.data()));
+    return result;
+  }
+
+ private:
+  std::string op_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> inputs_;
+};
+
+class Executor {
+ public:
+  // bind with named argument arrays; grad_req 0=null,1=write,3=add
+  Executor(const Symbol &sym,
+           const std::map<std::string, NDArray> &args,
+           const std::map<std::string, NDArray> &arg_grads = {},
+           const std::map<std::string, mx_uint> &grad_reqs = {},
+           const std::map<std::string, NDArray> &aux = {}) {
+    auto arg_names = sym.ListArguments();
+    auto aux_names = sym.ListAuxiliaryStates();
+    std::vector<NDArrayHandle> in, grads, auxs;
+    std::vector<mx_uint> reqs;
+    for (auto &n : arg_names) {
+      auto it = args.find(n);
+      if (it == args.end())
+        throw std::runtime_error("missing bind argument: " + n);
+      in.push_back(it->second.get());
+      auto g = arg_grads.find(n);
+      grads.push_back(g == arg_grads.end() ? nullptr : g->second.get());
+      auto r = grad_reqs.find(n);
+      reqs.push_back(r == grad_reqs.end()
+                         ? (g == arg_grads.end() ? 0u : 1u)
+                         : r->second);
+    }
+    for (auto &n : aux_names) {
+      auto it = aux.find(n);
+      if (it == aux.end())
+        throw std::runtime_error("missing aux state: " + n);
+      auxs.push_back(it->second.get());
+    }
+    ExecutorHandle h = nullptr;
+    Check(MXExecutorBind(sym.get(), 1, 0,
+                         static_cast<mx_uint>(in.size()), in.data(),
+                         grads.data(), reqs.data(),
+                         static_cast<mx_uint>(auxs.size()), auxs.data(),
+                         &h));
+    h_.reset(h, Deleter);
+  }
+  void Forward(bool is_train = false) {
+    Check(MXExecutorForward(get(), is_train ? 1 : 0));
+  }
+  void Backward() { Check(MXExecutorBackward(get(), 0, nullptr)); }
+  std::vector<NDArray> Outputs() {
+    mx_uint n = 0;
+    NDArrayHandle *arr = nullptr;
+    Check(MXExecutorOutputs(get(), &n, &arr));
+    std::vector<NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) {
+      // handles stay library-owned; copy through shape+data
+      mx_uint nd;
+      const mx_uint *dims;
+      Check(MXNDArrayGetShape(arr[i], &nd, &dims));
+      std::vector<mx_uint> shape(dims, dims + nd);
+      size_t total = 1;
+      for (mx_uint d : shape) total *= d;
+      std::vector<float> host(total);
+      Check(MXNDArraySyncCopyToCPU(arr[i], host.data(), host.size()));
+      out.emplace_back(shape, host);
+    }
+    return out;
+  }
+  ExecutorHandle get() const { return h_.get(); }
+
+ private:
+  static void Deleter(void *h) {
+    if (h) MXExecutorFree(h);
+  }
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_MXNET_CPP_H_

@@ -41,6 +41,7 @@ def _python_embed_flags():
 _EXTRA_FLAGS = {
     # name -> (extra compile flags, extra link flags)
     "c_predict_api": _python_embed_flags,
+    "c_api": _python_embed_flags,
 }
 
 

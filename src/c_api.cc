@@ -1,0 +1,927 @@
+// C ABI implementation — NDArray / imperative invoke / Symbol / Executor.
+//
+// Reference contract: include/mxnet/c_api.h (145 MXNET_DLL entry points;
+// the groups implemented here are NDArray :241-640, the imperative invoke
+// path src/c_api/c_api_ndarray.cc:548, Symbol :841-1260 and Executor
+// :1270-1400).  Same function names and calling shapes, so non-Python
+// frontends written against the reference's ABI port by relinking.
+//
+// TPU-native design (same inversion as c_predict_api.cc): the compute
+// path is XLA through the Python package — the executor lowers a bound
+// Symbol to ONE XLA program — so this library embeds CPython and drives
+// mxnet_tpu through the CPython C API.  Handles own Python references;
+// calls serialize on the GIL; failures set the thread-local error string
+// surfaced by MXGetLastError and return -1.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// the public header the .so must stay ABI-consistent with
+#include "../include/mxnet_tpu/c_api.h"
+
+#include "embed_common.h"
+
+namespace {
+
+// handle wrappers: each owns one Python reference plus caches whose
+// lifetime the C API promises (shape buffers, name lists)
+struct NDHandle {
+  PyObject *obj;
+  std::vector<mx_uint> shape_cache;
+};
+
+struct SymHandle {
+  PyObject *obj;        // Symbol once composed / created
+  std::string op;       // pending atomic op name (pre-Compose)
+  PyObject *attrs;      // pending attrs dict
+  std::vector<std::string> names_store;
+  std::vector<const char *> names_ptrs;
+  // InferShape result storage
+  std::vector<std::vector<mx_uint>> shapes_store[3];
+  std::vector<mx_uint> ndim_store[3];
+  std::vector<const mx_uint *> pdata_store[3];
+};
+
+struct ExecHandle {
+  PyObject *obj;
+  std::vector<NDHandle *> out_handles;
+  std::vector<NDArrayHandle> out_ptrs;
+};
+
+PyObject *import_attr(const char *module, const char *attr) {
+  PyObject *mod = PyImport_ImportModule(module);
+  if (!mod) return nullptr;
+  PyObject *a = PyObject_GetAttrString(mod, attr);
+  Py_DECREF(mod);
+  return a;
+}
+
+// parse a C string attr value as a Python literal, else keep the string
+PyObject *parse_attr_value(const char *val) {
+  PyObject *ast = PyImport_ImportModule("ast");
+  PyObject *out = nullptr;
+  if (ast) {
+    out = PyObject_CallMethod(ast, "literal_eval", "s", val);
+    Py_DECREF(ast);
+  }
+  if (!out) {
+    PyErr_Clear();
+    out = PyUnicode_FromString(val);
+  }
+  return out;
+}
+
+PyObject *attrs_dict(int num, const char **keys, const char **vals) {
+  PyObject *d = PyDict_New();
+  for (int i = 0; i < num; ++i) {
+    PyObject *v = parse_attr_value(vals[i]);
+    if (!v) {
+      Py_DECREF(d);
+      return nullptr;
+    }
+    PyDict_SetItemString(d, keys[i], v);
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+const char *dtype_name(int dtype) {
+  switch (dtype) {
+    case 0: return "float32";
+    case 1: return "float64";
+    case 2: return "float16";
+    case 3: return "uint8";
+    case 4: return "int32";
+    case 5: return "int8";
+    case 6: return "int64";
+    default: return nullptr;
+  }
+}
+
+int dtype_code(const char *name) {
+  if (!strcmp(name, "float32")) return 0;
+  if (!strcmp(name, "float64")) return 1;
+  if (!strcmp(name, "float16")) return 2;
+  if (!strcmp(name, "uint8")) return 3;
+  if (!strcmp(name, "int32")) return 4;
+  if (!strcmp(name, "int8")) return 5;
+  if (!strcmp(name, "int64")) return 6;
+  return -1;
+}
+
+// the op-name registry backing AtomicSymbolCreator handles: creators are
+// stable char* pointers into this process-lifetime store
+std::vector<std::string> *g_op_names = nullptr;
+std::vector<const char *> *g_op_ptrs = nullptr;
+std::vector<AtomicSymbolCreator> *g_creators = nullptr;
+
+bool load_op_names() {
+  if (g_op_names) return true;
+  PyObject *fn = import_attr("mxnet_tpu.ops.registry", "list_ops");
+  if (!fn) return false;
+  PyObject *lst = PyObject_CallObject(fn, nullptr);
+  Py_DECREF(fn);
+  if (!lst) return false;
+  auto *names = new std::vector<std::string>();
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    names->push_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+  Py_DECREF(lst);
+  auto *ptrs = new std::vector<const char *>();
+  auto *creators = new std::vector<AtomicSymbolCreator>();
+  for (auto &s : *names) {
+    ptrs->push_back(s.c_str());
+    creators->push_back(static_cast<AtomicSymbolCreator>(s.c_str()));
+  }
+  g_op_names = names;
+  g_op_ptrs = ptrs;
+  g_creators = creators;
+  return true;
+}
+
+// build a python NDArray from numpy-compatible host data
+PyObject *nd_zeros(const mx_uint *shape, mx_uint ndim, int dtype) {
+  PyObject *fn = import_attr("mxnet_tpu.ndarray", "zeros");
+  if (!fn) return nullptr;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  const char *dt = dtype_name(dtype);
+  PyObject *out = nullptr;
+  if (dt) {
+    PyObject *kw = Py_BuildValue("{s:s}", "dtype", dt);
+    PyObject *args = PyTuple_Pack(1, shp);
+    out = PyObject_Call(fn, args, kw);
+    Py_DECREF(args);
+    Py_DECREF(kw);
+  } else {
+    set_error("unknown dtype code");
+  }
+  Py_DECREF(shp);
+  Py_DECREF(fn);
+  return out;
+}
+
+NDHandle *wrap_nd(PyObject *obj) {
+  NDHandle *h = new NDHandle();
+  h->obj = obj;
+  return h;
+}
+
+// fill a SymHandle's cached name list from a Symbol method returning a
+// list of str
+int fill_names(SymHandle *h, const char *method, mx_uint *out_size,
+               const char ***out_array) {
+  PyObject *lst = PyObject_CallMethod(h->obj, method, nullptr);
+  if (!lst) {
+    set_py_error();
+    return -1;
+  }
+  h->names_store.clear();
+  h->names_ptrs.clear();
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->names_store.push_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+  Py_DECREF(lst);
+  for (auto &s : h->names_store) h->names_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(h->names_ptrs.size());
+  *out_array = h->names_ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  g_last_error.clear();
+  (void)dev_type; (void)dev_id; (void)delay_alloc;
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *obj = nd_zeros(shape, ndim, dtype);
+  if (!obj) {
+    if (PyErr_Occurred()) set_py_error();
+    return -1;
+  }
+  *out = wrap_nd(obj);
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  NDHandle *h = static_cast<NDHandle *>(handle);
+  if (h) {
+    Gil gil;
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  g_last_error.clear();
+  NDHandle *h = static_cast<NDHandle *>(handle);
+  Gil gil;
+  PyObject *shape = PyObject_GetAttrString(h->obj, "shape");
+  if (!shape) {
+    set_py_error();
+    return -1;
+  }
+  h->shape_cache.clear();
+  Py_ssize_t nd = PyTuple_Size(shape);
+  for (Py_ssize_t i = 0; i < nd; ++i)
+    h->shape_cache.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shape, i))));
+  Py_DECREF(shape);
+  *out_dim = static_cast<mx_uint>(h->shape_cache.size());
+  *out_pdata = h->shape_cache.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  g_last_error.clear();
+  NDHandle *h = static_cast<NDHandle *>(handle);
+  Gil gil;
+  PyObject *dt = PyObject_GetAttrString(h->obj, "dtype");
+  if (!dt) {
+    set_py_error();
+    return -1;
+  }
+  PyObject *name = PyObject_GetAttrString(dt, "name");
+  if (!name) name = PyObject_Str(dt);
+  int code = name ? dtype_code(PyUnicode_AsUTF8(name)) : -1;
+  Py_XDECREF(name);
+  Py_DECREF(dt);
+  if (code < 0) {
+    set_error("unmapped dtype");
+    return -1;
+  }
+  *out_dtype = code;
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  g_last_error.clear();
+  NDHandle *h = static_cast<NDHandle *>(handle);
+  Gil gil;
+  int ret = -1;
+  PyObject *np = nullptr, *mv = nullptr, *flat = nullptr,
+           *shaped = nullptr, *res = nullptr, *dt = nullptr,
+           *name = nullptr, *shape = nullptr, *itemsize = nullptr;
+  do {
+    dt = PyObject_GetAttrString(h->obj, "dtype");
+    if (!dt) break;
+    name = PyObject_GetAttrString(dt, "name");
+    if (!name) break;
+    itemsize = PyObject_GetAttrString(dt, "itemsize");
+    size_t isz = itemsize ? PyLong_AsSize_t(itemsize) : 4;
+    np = PyImport_ImportModule("numpy");
+    if (!np) break;
+    mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char *>(const_cast<void *>(data)),
+        static_cast<Py_ssize_t>(size * isz), PyBUF_READ);
+    if (!mv) break;
+    PyObject *view = PyObject_CallMethod(np, "frombuffer", "OO", mv,
+                                         name);
+    if (!view) break;
+    flat = PyObject_CallMethod(view, "copy", nullptr);
+    Py_DECREF(view);
+    if (!flat) break;
+    shape = PyObject_GetAttrString(h->obj, "shape");
+    if (!shape) break;
+    shaped = PyObject_CallMethod(flat, "reshape", "O", shape);
+    if (!shaped) break;
+    // arr[:] = shaped  (full-slice assignment)
+    PyObject *slice = PySlice_New(nullptr, nullptr, nullptr);
+    int rc = PyObject_SetItem(h->obj, slice, shaped);
+    Py_DECREF(slice);
+    if (rc != 0) break;
+    ret = 0;
+  } while (false);
+  if (ret != 0) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(shaped);
+  Py_XDECREF(shape);
+  Py_XDECREF(flat);
+  Py_XDECREF(mv);
+  Py_XDECREF(np);
+  Py_XDECREF(itemsize);
+  Py_XDECREF(name);
+  Py_XDECREF(dt);
+  return ret;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  g_last_error.clear();
+  NDHandle *h = static_cast<NDHandle *>(handle);
+  Gil gil;
+  int ret = -1;
+  PyObject *arr = nullptr, *flat = nullptr, *bytes = nullptr;
+  do {
+    arr = PyObject_CallMethod(h->obj, "asnumpy", nullptr);
+    if (!arr) break;
+    flat = PyObject_CallMethod(arr, "ravel", nullptr);
+    if (!flat) break;
+    bytes = PyObject_CallMethod(flat, "tobytes", nullptr);
+    if (!bytes) break;
+    char *buf = nullptr;
+    Py_ssize_t blen = 0;
+    if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0) break;
+    // `size` counts ELEMENTS (reference semantics)
+    Py_ssize_t want = blen;
+    PyObject *dt = PyObject_GetAttrString(h->obj, "dtype");
+    PyObject *itemsize =
+        dt ? PyObject_GetAttrString(dt, "itemsize") : nullptr;
+    Py_XDECREF(dt);
+    if (itemsize) {
+      want = static_cast<Py_ssize_t>(size * PyLong_AsSize_t(itemsize));
+      Py_DECREF(itemsize);
+    }
+    if (want != blen) {
+      set_error("MXNDArraySyncCopyToCPU: size mismatch");
+      break;
+    }
+    std::memcpy(data, buf, blen);
+    ret = 0;
+  } while (false);
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(bytes);
+  Py_XDECREF(flat);
+  Py_XDECREF(arr);
+  return ret;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  g_last_error.clear();
+  NDHandle *h = static_cast<NDHandle *>(handle);
+  Gil gil;
+  PyObject *res = PyObject_CallMethod(h->obj, "wait_to_read", nullptr);
+  if (!res) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *fn = import_attr("mxnet_tpu.ndarray", "waitall");
+  PyObject *res = fn ? PyObject_CallObject(fn, nullptr) : nullptr;
+  int ret = res ? 0 : -1;
+  if (ret != 0) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(fn);
+  return ret;
+}
+
+/* ---- op registry + imperative invoke ---------------------------------- */
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  if (!load_op_names()) {
+    set_py_error();
+    return -1;
+  }
+  *out_size = static_cast<mx_uint>(g_op_ptrs->size());
+  *out_array = g_op_ptrs->data();
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  if (!load_op_names()) {
+    set_py_error();
+    return -1;
+  }
+  *out_size = static_cast<mx_uint>(g_creators->size());
+  *out_array = g_creators->data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = static_cast<const char *>(creator);
+  return 0;
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  const char *op_name = static_cast<const char *>(creator);
+  Gil gil;
+  int ret = -1;
+  PyObject *mod = nullptr, *fn = nullptr, *args = nullptr, *kw = nullptr,
+           *res = nullptr;
+  // library-owned per-thread output handle storage (reference contract:
+  // valid until the next invoke)
+  static thread_local std::vector<NDArrayHandle> out_store;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.ndarray");
+    if (!mod) break;
+    fn = PyObject_GetAttrString(mod, op_name);
+    if (!fn) break;
+    args = PyTuple_New(num_inputs);
+    for (int i = 0; i < num_inputs; ++i) {
+      PyObject *o = static_cast<NDHandle *>(inputs[i])->obj;
+      Py_INCREF(o);
+      PyTuple_SET_ITEM(args, i, o);
+    }
+    kw = attrs_dict(num_params, param_keys, param_vals);
+    if (!kw) break;
+    res = PyObject_Call(fn, args, kw);
+    if (!res) break;
+    for (NDArrayHandle h : out_store) MXNDArrayFree(h);
+    out_store.clear();
+    if (PyTuple_Check(res) || PyList_Check(res)) {
+      Py_ssize_t n = PySequence_Size(res);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *o = PySequence_GetItem(res, i);  // new ref
+        out_store.push_back(wrap_nd(o));
+      }
+    } else {
+      Py_INCREF(res);
+      out_store.push_back(wrap_nd(res));
+    }
+    *num_outputs = static_cast<int>(out_store.size());
+    *outputs = out_store.data();
+    ret = 0;
+  } while (false);
+  if (ret != 0) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(kw);
+  Py_XDECREF(args);
+  Py_XDECREF(fn);
+  Py_XDECREF(mod);
+  return ret;
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *fn = import_attr("mxnet_tpu.symbol", "Variable");
+  PyObject *sym = fn ? PyObject_CallFunction(fn, "s", name) : nullptr;
+  Py_XDECREF(fn);
+  if (!sym) {
+    set_py_error();
+    return -1;
+  }
+  SymHandle *h = new SymHandle();
+  h->obj = sym;
+  h->attrs = nullptr;
+  *out = h;
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *attrs = attrs_dict(static_cast<int>(num_param), keys, vals);
+  if (!attrs) {
+    set_py_error();
+    return -1;
+  }
+  SymHandle *h = new SymHandle();
+  h->obj = nullptr;
+  h->op = static_cast<const char *>(creator);
+  h->attrs = attrs;
+  *out = h;
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  g_last_error.clear();
+  SymHandle *h = static_cast<SymHandle *>(sym);
+  if (h->obj != nullptr || h->op.empty()) {
+    set_error("MXSymbolCompose: handle is not a pending atomic symbol");
+    return -1;
+  }
+  Gil gil;
+  int ret = -1;
+  PyObject *mod = nullptr, *fn = nullptr, *py_args = nullptr,
+           *kw = nullptr, *res = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.symbol");
+    if (!mod) break;
+    fn = PyObject_GetAttrString(mod, h->op.c_str());
+    if (!fn) break;
+    kw = PyDict_Copy(h->attrs);
+    if (name) {
+      PyObject *nm = PyUnicode_FromString(name);
+      PyDict_SetItemString(kw, "name", nm);
+      Py_DECREF(nm);
+    }
+    if (keys) {
+      // named inputs go through kwargs (the generated symbol functions
+      // order them by the op's declared input names)
+      py_args = PyTuple_New(0);
+      for (mx_uint i = 0; i < num_args; ++i) {
+        SymHandle *a = static_cast<SymHandle *>(args[i]);
+        if (!a->obj) {
+          set_error("MXSymbolCompose: input symbol not composed");
+          goto done;
+        }
+        PyDict_SetItemString(kw, keys[i], a->obj);
+      }
+    } else {
+      py_args = PyTuple_New(num_args);
+      for (mx_uint i = 0; i < num_args; ++i) {
+        SymHandle *a = static_cast<SymHandle *>(args[i]);
+        if (!a->obj) {
+          set_error("MXSymbolCompose: input symbol not composed");
+          goto done;
+        }
+        Py_INCREF(a->obj);
+        PyTuple_SET_ITEM(py_args, i, a->obj);
+      }
+    }
+    res = PyObject_Call(fn, py_args, kw);
+    if (!res) break;
+    h->obj = res;
+    res = nullptr;
+    ret = 0;
+  } while (false);
+done:
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(kw);
+  Py_XDECREF(py_args);
+  Py_XDECREF(fn);
+  Py_XDECREF(mod);
+  return ret;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *fn = import_attr("mxnet_tpu.symbol", "load_json");
+  PyObject *sym = fn ? PyObject_CallFunction(fn, "s", json) : nullptr;
+  Py_XDECREF(fn);
+  if (!sym) {
+    set_py_error();
+    return -1;
+  }
+  SymHandle *h = new SymHandle();
+  h->obj = sym;
+  h->attrs = nullptr;
+  *out = h;
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  g_last_error.clear();
+  SymHandle *h = static_cast<SymHandle *>(sym);
+  Gil gil;
+  PyObject *res = PyObject_CallMethod(h->obj, "tojson", nullptr);
+  if (!res) {
+    set_py_error();
+    return -1;
+  }
+  h->names_store.clear();
+  h->names_store.push_back(PyUnicode_AsUTF8(res));
+  Py_DECREF(res);
+  *out_json = h->names_store.back().c_str();
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array) {
+  g_last_error.clear();
+  Gil gil;
+  return fill_names(static_cast<SymHandle *>(sym), "list_arguments",
+                    out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array) {
+  g_last_error.clear();
+  Gil gil;
+  return fill_names(static_cast<SymHandle *>(sym), "list_outputs",
+                    out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array) {
+  g_last_error.clear();
+  Gil gil;
+  return fill_names(static_cast<SymHandle *>(sym),
+                    "list_auxiliary_states", out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  g_last_error.clear();
+  SymHandle *h = static_cast<SymHandle *>(sym);
+  Gil gil;
+  int ret = -1;
+  PyObject *kw = nullptr, *res = nullptr, *empty = nullptr;
+  do {
+    kw = PyDict_New();
+    for (mx_uint i = 0; i < num_args; ++i) {
+      mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyObject *shp = PyTuple_New(hi - lo);
+      for (mx_uint j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(shp, j - lo,
+                         PyLong_FromUnsignedLong(arg_shape_data[j]));
+      PyDict_SetItemString(kw, keys[i], shp);
+      Py_DECREF(shp);
+    }
+    empty = PyTuple_New(0);
+    PyObject *meth = PyObject_GetAttrString(h->obj, "infer_shape");
+    if (!meth) break;
+    res = PyObject_Call(meth, empty, kw);
+    Py_DECREF(meth);
+    if (!res) break;
+    // res = (arg_shapes, out_shapes, aux_shapes) — lists of tuples;
+    // None marks an unresolved shape (reference contract: complete=0)
+    bool all_resolved = true;
+    for (int grp = 0; grp < 3; ++grp) {
+      PyObject *lst = PyTuple_GetItem(res, grp);
+      h->shapes_store[grp].clear();
+      h->ndim_store[grp].clear();
+      h->pdata_store[grp].clear();
+      Py_ssize_t n = PySequence_Size(lst);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *shp = PySequence_GetItem(lst, i);
+        std::vector<mx_uint> dims;
+        if (shp == Py_None) all_resolved = false;
+        if (shp != Py_None) {
+          Py_ssize_t nd = PySequence_Size(shp);
+          for (Py_ssize_t d = 0; d < nd; ++d) {
+            PyObject *v = PySequence_GetItem(shp, d);
+            dims.push_back(
+                static_cast<mx_uint>(PyLong_AsUnsignedLong(v)));
+            Py_DECREF(v);
+          }
+        }
+        Py_DECREF(shp);
+        h->shapes_store[grp].push_back(std::move(dims));
+      }
+      for (auto &dims : h->shapes_store[grp]) {
+        h->ndim_store[grp].push_back(
+            static_cast<mx_uint>(dims.size()));
+        h->pdata_store[grp].push_back(dims.data());
+      }
+    }
+    *in_shape_size = static_cast<mx_uint>(h->pdata_store[0].size());
+    *in_shape_ndim = h->ndim_store[0].data();
+    *in_shape_data = h->pdata_store[0].data();
+    *out_shape_size = static_cast<mx_uint>(h->pdata_store[1].size());
+    *out_shape_ndim = h->ndim_store[1].data();
+    *out_shape_data = h->pdata_store[1].data();
+    *aux_shape_size = static_cast<mx_uint>(h->pdata_store[2].size());
+    *aux_shape_ndim = h->ndim_store[2].data();
+    *aux_shape_data = h->pdata_store[2].data();
+    *complete = all_resolved ? 1 : 0;
+    ret = 0;
+  } while (false);
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(empty);
+  Py_XDECREF(kw);
+  return ret;
+}
+
+int MXSymbolFree(SymbolHandle sym) {
+  SymHandle *h = static_cast<SymHandle *>(sym);
+  if (h) {
+    Gil gil;
+    Py_XDECREF(h->obj);
+    Py_XDECREF(h->attrs);
+    delete h;
+  }
+  return 0;
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                   mx_uint num_args, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store,
+                   const mx_uint *grad_req_type, mx_uint num_aux,
+                   NDArrayHandle *aux_states, ExecutorHandle *out) {
+  g_last_error.clear();
+  (void)dev_type;
+  (void)dev_id;
+  SymHandle *sh = static_cast<SymHandle *>(sym);
+  if (!sh->obj) {
+    set_error("MXExecutorBind: symbol not composed");
+    return -1;
+  }
+  Gil gil;
+  int ret = -1;
+  PyObject *args_list = nullptr, *grads = nullptr, *reqs = nullptr,
+           *aux = nullptr, *res = nullptr, *meth = nullptr,
+           *call_args = nullptr, *kw = nullptr;
+  static const char *req_names[] = {"null", "write", "inplace", "add"};
+  do {
+    args_list = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i) {
+      PyObject *o = static_cast<NDHandle *>(in_args[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(args_list, i, o);
+    }
+    bool any_grad = false;
+    grads = PyList_New(num_args);
+    reqs = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i) {
+      mx_uint req = grad_req_type ? grad_req_type[i] : 0;
+      if (req > 3) req = 0;
+      PyList_SET_ITEM(reqs, i,
+                      PyUnicode_FromString(req_names[req]));
+      if (arg_grad_store && arg_grad_store[i] && req != 0) {
+        any_grad = true;
+        PyObject *o = static_cast<NDHandle *>(arg_grad_store[i])->obj;
+        Py_INCREF(o);
+        PyList_SET_ITEM(grads, i, o);
+      } else {
+        Py_INCREF(Py_None);
+        PyList_SET_ITEM(grads, i, Py_None);
+      }
+    }
+    aux = PyList_New(num_aux);
+    for (mx_uint i = 0; i < num_aux; ++i) {
+      PyObject *o = static_cast<NDHandle *>(aux_states[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(aux, i, o);
+    }
+    meth = PyObject_GetAttrString(sh->obj, "bind");
+    if (!meth) break;
+    kw = PyDict_New();
+    PyDict_SetItemString(kw, "args", args_list);
+    if (any_grad) PyDict_SetItemString(kw, "args_grad", grads);
+    PyDict_SetItemString(kw, "grad_req", reqs);
+    if (num_aux) PyDict_SetItemString(kw, "aux_states", aux);
+    call_args = PyTuple_Pack(1, Py_None);  // ctx=None -> default
+    res = PyObject_Call(meth, call_args, kw);
+    if (!res) break;
+    ExecHandle *h = new ExecHandle();
+    h->obj = res;
+    res = nullptr;
+    *out = h;
+    ret = 0;
+  } while (false);
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(kw);
+  Py_XDECREF(call_args);
+  Py_XDECREF(meth);
+  Py_XDECREF(aux);
+  Py_XDECREF(reqs);
+  Py_XDECREF(grads);
+  Py_XDECREF(args_list);
+  return ret;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  g_last_error.clear();
+  ExecHandle *h = static_cast<ExecHandle *>(handle);
+  Gil gil;
+  PyObject *res = PyObject_CallMethod(
+      h->obj, "forward", "O", is_train ? Py_True : Py_False);
+  if (!res) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint num_head_grads,
+                       NDArrayHandle *head_grads) {
+  g_last_error.clear();
+  ExecHandle *h = static_cast<ExecHandle *>(handle);
+  Gil gil;
+  PyObject *res = nullptr;
+  if (num_head_grads == 0) {
+    res = PyObject_CallMethod(h->obj, "backward", nullptr);
+  } else {
+    PyObject *lst = PyList_New(num_head_grads);
+    for (mx_uint i = 0; i < num_head_grads; ++i) {
+      PyObject *o = static_cast<NDHandle *>(head_grads[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(lst, i, o);
+    }
+    res = PyObject_CallMethod(h->obj, "backward", "O", lst);
+    Py_DECREF(lst);
+  }
+  if (!res) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  g_last_error.clear();
+  ExecHandle *h = static_cast<ExecHandle *>(handle);
+  Gil gil;
+  PyObject *outs = PyObject_GetAttrString(h->obj, "outputs");
+  if (!outs) {
+    set_py_error();
+    return -1;
+  }
+  for (NDHandle *old : h->out_handles) {
+    Py_XDECREF(old->obj);
+    delete old;
+  }
+  h->out_handles.clear();
+  h->out_ptrs.clear();
+  Py_ssize_t n = PySequence_Size(outs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PySequence_GetItem(outs, i);  // new ref
+    NDHandle *nh = wrap_nd(o);
+    h->out_handles.push_back(nh);
+    h->out_ptrs.push_back(nh);
+  }
+  Py_DECREF(outs);
+  *out_size = static_cast<mx_uint>(h->out_ptrs.size());
+  *out = h->out_ptrs.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  ExecHandle *h = static_cast<ExecHandle *>(handle);
+  if (h) {
+    Gil gil;
+    for (NDHandle *old : h->out_handles) {
+      Py_XDECREF(old->obj);
+      delete old;
+    }
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+}  // extern "C"

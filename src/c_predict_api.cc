@@ -25,58 +25,15 @@
 #include <string>
 #include <vector>
 
+#include "embed_common.h"
+
 typedef unsigned int mx_uint;
 typedef void *PredictorHandle;
-
-static thread_local std::string g_last_error;
 
 struct MXPredictor {
   PyObject *predictor;              // mxnet_tpu.predictor.Predictor
   std::vector<std::vector<mx_uint>> out_shapes;
 };
-
-static void set_error(const char *msg) { g_last_error = msg ? msg : ""; }
-
-static void set_py_error() {
-  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
-  PyErr_Fetch(&type, &value, &trace);
-  PyErr_NormalizeException(&type, &value, &trace);
-  PyObject *s = value ? PyObject_Str(value) : nullptr;
-  set_error(s ? PyUnicode_AsUTF8(s) : "unknown python error");
-  Py_XDECREF(s);
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(trace);
-}
-
-static bool ensure_python() {
-  bool we_initialized = false;
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    we_initialized = true;
-  }
-  // make the framework importable: MXNET_TPU_HOME, else the cwd
-  PyGILState_STATE g = PyGILState_Ensure();
-  const char *home = std::getenv("MXNET_TPU_HOME");
-  std::string code = "import sys, os\n";
-  if (home) {
-    code += std::string("p = r'''") + home + "'''\n";
-  } else {
-    code += "p = os.getcwd()\n";
-  }
-  code +=
-      "if p not in sys.path:\n"
-      "    sys.path.insert(0, p)\n";
-  int rc = PyRun_SimpleString(code.c_str());
-  PyGILState_Release(g);
-  if (we_initialized) {
-    // Py_InitializeEx leaves the calling thread owning the GIL; detach
-    // so other threads' PyGILState_Ensure can acquire it (without this,
-    // a second serving thread deadlocks forever)
-    PyEval_SaveThread();
-  }
-  return rc == 0;
-}
 
 extern "C" {
 

@@ -1,0 +1,69 @@
+// Shared CPython-embedding plumbing for the native ABI libraries
+// (c_api.cc, c_predict_api.cc): interpreter init + MXNET_TPU_HOME
+// sys.path injection, thread-local error capture, GIL guard.
+#ifndef MXNET_TPU_SRC_EMBED_COMMON_H_
+#define MXNET_TPU_SRC_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <string>
+
+static thread_local std::string g_last_error;
+
+static void set_error(const char *msg) { g_last_error = msg ? msg : ""; }
+
+static void set_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  set_error(s ? PyUnicode_AsUTF8(s) : "unknown python error");
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+// init CPython (once) and make the framework importable: MXNET_TPU_HOME,
+// else the cwd.  Latched after the first success so the per-call cost on
+// hot paths (imperative invoke) is one atomic load.
+static bool ensure_python() {
+  static std::atomic<bool> ready{false};
+  if (ready.load(std::memory_order_acquire)) return true;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  const char *home = std::getenv("MXNET_TPU_HOME");
+  std::string code = "import sys, os\n";
+  if (home) {
+    code += std::string("p = r'''") + home + "'''\n";
+  } else {
+    code += "p = os.getcwd()\n";
+  }
+  code +=
+      "if p not in sys.path:\n"
+      "    sys.path.insert(0, p)\n";
+  int rc = PyRun_SimpleString(code.c_str());
+  PyGILState_Release(g);
+  if (we_initialized) {
+    // Py_InitializeEx leaves the calling thread owning the GIL; detach
+    // so other threads' PyGILState_Ensure can acquire it (without this,
+    // a second serving thread deadlocks forever)
+    PyEval_SaveThread();
+  }
+  if (rc == 0) ready.store(true, std::memory_order_release);
+  return rc == 0;
+}
+
+struct Gil {
+  PyGILState_STATE g;
+  Gil() : g(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(g); }
+};
+
+#endif  // MXNET_TPU_SRC_EMBED_COMMON_H_

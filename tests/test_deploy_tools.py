@@ -266,3 +266,55 @@ int main(int argc, char** argv) {
                    "float32").reshape(4, 3)
     # the C process runs with default matmul precision (no conftest)
     np.testing.assert_allclose(got, expected, rtol=5e-3, atol=1e-3)
+
+
+def test_cpp_api_client(tmp_path):
+    """The expanded C ABI (VERDICT r3 task 3): compile the cpp-package
+    example — symbol composition through the registry-generated C++ op
+    frontend, shape inference, executor bind, fwd/bwd TRAINING with the
+    fused sgd_update invoked imperatively, scoring, JSON round-trip —
+    and require it to reach >0.9 accuracy, all from one C++ binary.
+
+    Reference: include/mxnet/c_api.h groups NDArray/Symbol/Executor +
+    cpp-package/example/mlp.cpp."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    from mxnet_tpu import _native
+
+    lib = _native._load("c_api")
+    if lib is None:
+        pytest.skip("c_api did not build (no libpython?)")
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    # the generated op frontend must be fresh w.r.t. the registry
+    gen = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "gen_cpp_package.py"),
+         "-o", str(tmp_path / "op.h")],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=300)
+    assert gen.returncode == 0, gen.stdout + gen.stderr
+    committed = open(os.path.join(repo, "include", "mxnet_tpu", "cpp",
+                                  "op.h")).read()
+    assert committed == open(str(tmp_path / "op.h")).read(), \
+        "include/mxnet_tpu/cpp/op.h is stale; re-run " \
+        "tools/gen_cpp_package.py"
+
+    so = os.path.join(repo, "mxnet_tpu", "_build", "c_api.so")
+    exe = tmp_path / "cpp_client"
+    res = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         "-I", os.path.join(repo, "include"),
+         os.path.join(repo, "examples", "deploy", "cpp_api", "main.cc"),
+         so, "-Wl,-rpath," + os.path.dirname(so), "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_HOME=repo,
+               LD_LIBRARY_PATH=os.path.dirname(so))
+    res = subprocess.run([str(exe)], capture_output=True, text=True,
+                         env=env, timeout=600)
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    assert "CPP API CLIENT OK" in res.stdout, res.stdout
