@@ -125,8 +125,21 @@ def _custom_num_outputs(attrs):
 
 
 def _custom_compute(attrs, *inputs):
-    """The Custom registry op: host callback forward + custom_vjp backward
-    (reference ``PushFComputeEx``-over-callbacks, ``custom.cc:36``)."""
+    """The Custom registry op (reference ``custom.cc:36``), two tiers:
+
+    1. **Device path (default)**: the user's ``forward``/``backward``
+       are CALLED DURING TRACING with NDArray shims over the traced
+       values — custom ops written with ``mx.nd`` operations (which ARE
+       jax computations) compile straight into the surrounding XLA
+       program and run on the accelerator, no host round-trip.  This is
+       the TPU-native answer to the reference's ``FnProperty::kAsync``
+       callback scheduling.
+    2. **Host-callback fallback**: ops that materialize numpy
+       (``.asnumpy()``) cannot trace; they raise a concretization error
+       and fall back to ``jax.pure_callback`` + ``custom_vjp`` — which
+       only runs on backends with host-callback support (NOT the axon
+       TPU tunnel).  ``MXNET_CUSTOM_OP_CALLBACK=1`` forces this tier.
+    """
     import jax
 
     if "op_type" not in attrs:
@@ -155,6 +168,83 @@ def _custom_compute(attrs, *inputs):
 
         return prop.create_operator(cpu(), [list(s) for s in in_shapes],
                                     list(in_dtypes))
+
+    # ---- tier 1: trace the user code into the XLA program -------------
+    from .base import get_env
+
+    if not get_env("MXNET_CUSTOM_OP_CALLBACK", 0, int):
+        import jax.numpy as jnp
+
+        from .ndarray import NDArray
+
+        from . import autograd as _ag
+
+        def traced_forward(*xs):
+            op = _new_op()
+            in_data = [NDArray(jnp.asarray(x)) for x in xs]
+            out_data = [NDArray(jnp.zeros(s, np.dtype(d)))
+                        for s, d in zip(out_shapes, out_dtypes)]
+            # the op's internals run INSIDE this trace; recording them
+            # on the imperative tape would leak tracers (the Custom
+            # node itself is what the tape sees)
+            with _ag.pause():
+                op.forward(is_train=is_train,
+                           req=["write"] * len(out_data),
+                           in_data=in_data, out_data=out_data, aux=[])
+            return tuple(o._data.astype(a.dtype)
+                         for o, a in zip(out_data, out_avals))
+
+        def traced_backward(cts, xs, outs):
+            op = _new_op()
+            in_grad = [NDArray(jnp.zeros(s, np.dtype(d)))
+                       for s, d in zip(in_shapes, in_dtypes)]
+            with _ag.pause():
+                op.backward(
+                    req=["write"] * n_in,
+                    out_grad=[NDArray(jnp.asarray(g)) for g in cts],
+                    in_data=[NDArray(jnp.asarray(x)) for x in xs],
+                    out_data=[NDArray(jnp.asarray(o)) for o in outs],
+                    in_grad=in_grad, aux=[])
+            return tuple(g._data.astype(a.dtype)
+                         for g, a in zip(in_grad, in_avals))
+
+        device_ok = True
+        try:
+            # probe abstractly FIRST: a half-traced user forward must
+            # not leak partial effects into the real trace
+            jax.eval_shape(traced_forward, *in_avals)
+        except Exception:  # noqa: BLE001 — any probe failure: host tier
+            device_ok = False
+        if device_ok:
+            try:
+                jax.eval_shape(traced_backward, out_avals, in_avals,
+                               out_avals)
+            except jax.errors.ConcretizationTypeError:
+                # host-bound backward (.asnumpy() etc. — covers the
+                # TracerArrayConversion subclass): the whole op takes
+                # the callback tier so gradients stay available
+                device_ok = False
+            except Exception:  # noqa: BLE001
+                # user error (e.g. forward-only op: backward raises
+                # NotImplementedError) — keep the device tier; the
+                # error surfaces if/when gradients are requested,
+                # matching the reference contract
+                pass
+        if device_ok:
+            @jax.custom_vjp
+            def run_traced(*xs):
+                return traced_forward(*xs)
+
+            def traced_fwd_rule(*xs):
+                outs = traced_forward(*xs)
+                return outs, (xs, outs)
+
+            def traced_bwd_rule(res, cts):
+                xs, outs = res
+                return traced_backward(tuple(cts), xs, outs)
+
+            run_traced.defvjp(traced_fwd_rule, traced_bwd_rule)
+            return run_traced(*inputs)
 
     def host_forward(*np_in):
         op = _new_op()

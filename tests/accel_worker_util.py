@@ -1,0 +1,23 @@
+"""Shared launcher for accelerator subprocess workers (the tests that
+must run WITHOUT the conftest CPU pin so the real device is visible)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_accel_worker(argv, timeout=560):
+    """Run a worker script in a clean env (no JAX_PLATFORMS pin) from
+    the repo root; skip the calling test when the worker printed the
+    no-accelerator sentinel; return the CompletedProcess."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    res = subprocess.run([sys.executable] + list(argv),
+                         capture_output=True, text=True, env=env,
+                         cwd=REPO, timeout=timeout)
+    if "SKIP no accelerator" in res.stdout:
+        pytest.skip("no accelerator in this environment")
+    return res

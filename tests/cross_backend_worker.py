@@ -177,9 +177,9 @@ def sweep():
     # alias names answer through the same OpDef; count the full
     # registered-name coverage of the defs that actually RAN
     skipped_set = set(skipped)
+    failed_names = {f.split(":", 1)[0] for f in failed}
     covered_defs = {id(registry.get(n)) for _, n in seen_defs.items()
-                    if n not in skipped_set and
-                    not any(n in f for f in failed)}
+                    if n not in skipped_set and n not in failed_names}
     covered_names = [n for n in registry.list_ops()
                      if id(registry.get(n)) in covered_defs]
     print("SWEEP_DONE ran=%d skipped=%d failed=%d names_covered=%d" %

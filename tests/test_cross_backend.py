@@ -10,16 +10,12 @@ import sys
 
 import pytest
 
+from accel_worker_util import run_accel_worker
+
 
 def test_tpu_cpu_consistency():
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS",)}
-    repo = os.path.join(os.path.dirname(__file__), "..")
-    res = subprocess.run(
-        [sys.executable, os.path.join("tests", "cross_backend_worker.py")],
-        capture_output=True, text=True, env=env, cwd=repo, timeout=560)
-    if "SKIP no accelerator" in res.stdout:
-        pytest.skip("no accelerator in this environment")
+    res = run_accel_worker(
+        [os.path.join("tests", "cross_backend_worker.py")])
     assert res.returncode == 0, res.stdout + res.stderr
     assert "ALL_OK" in res.stdout, res.stdout
 
@@ -30,15 +26,9 @@ def test_registry_consistency_sweep():
     backends; per-op maxdiff is reported and must sit inside the
     tolerance tier.  Reference: the GPU suite imports the whole CPU op
     suite (test_operator_gpu.py:23)."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS",)}
-    repo = os.path.join(os.path.dirname(__file__), "..")
-    res = subprocess.run(
-        [sys.executable,
-         os.path.join("tests", "cross_backend_worker.py"), "sweep"],
-        capture_output=True, text=True, env=env, cwd=repo, timeout=1700)
-    if "SKIP no accelerator" in res.stdout:
-        pytest.skip("no accelerator in this environment")
+    res = run_accel_worker(
+        [os.path.join("tests", "cross_backend_worker.py"), "sweep"],
+        timeout=1700)
     assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-4000:]
     assert "SWEEP_ALL_OK" in res.stdout, res.stdout[-4000:]
     import re
