@@ -49,3 +49,9 @@ def test_example_ssd():
     out = _run("examples/ssd/train_ssd.py", "--num-epochs", "2",
                "--num-examples", "128")
     assert "loss first->last" in out
+
+
+def test_example_rcnn():
+    out = _run("examples/rcnn/train_rcnn.py", "--num-epochs", "3",
+               "--num-examples", "64", "--batch-size", "8")
+    assert "RCNN TRAINS OK" in out
