@@ -42,6 +42,7 @@ _EXTRA_FLAGS = {
     # name -> (extra compile flags, extra link flags)
     "c_predict_api": _python_embed_flags,
     "c_api": _python_embed_flags,
+    "im2rec": lambda: (["-pthread"], ["-pthread"]),
 }
 
 
@@ -71,6 +72,43 @@ def _load(name):
             lib = None
         _LIB[name] = lib
         return lib
+
+
+def native_im2rec():
+    """The parallel image->RecordIO packer library, or None."""
+    lib = _load("im2rec")
+    if lib is None:
+        return None
+    if not getattr(lib, "_i2r_configured", False):
+        lib.i2r_pack.restype = ctypes.c_long
+        lib.i2r_pack.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_char_p,
+                                 ctypes.c_int]
+        lib._i2r_configured = True
+    return lib
+
+
+def pack_recordio(list_path, root, rec_path, idx_path, nthreads=4):
+    """Pack already-encoded image files listed in a .lst into .rec/.idx
+    with the native parallel packer (the reference's ``tools/im2rec.cc``
+    role).  Returns the record count, or None when the native library
+    is unavailable; raises on unreadable inputs."""
+    from .base import MXNetError
+
+    lib = native_im2rec()
+    if lib is None:
+        return None
+    n = lib.i2r_pack(str(list_path).encode(), str(root or "").encode(),
+                     str(rec_path).encode(), str(idx_path).encode(),
+                     int(nthreads))
+    if n < 0:
+        raise MXNetError(
+            "native im2rec pack failed (code %d: %s)" % (n, {
+                -1: "cannot open list file",
+                -2: "unreadable image file",
+                -3: "cannot open output",
+                -4: "output write failed (disk full?)"}.get(n, "?")))
+    return int(n)
 
 
 def native_recordio():

@@ -1,0 +1,220 @@
+// Native image -> RecordIO packer.
+//
+// The TPU build's counterpart of the reference's parallel C++ packer
+// (tools/im2rec.cc: OpenCV decode/encode + dmlc::RecordIOWriter over a
+// thread pool).  This build has no OpenCV; re-encoding stays in the
+// Python path (tools/im2rec.py --resize/--quality), and the native
+// packer owns the case the reference went native FOR — dataset packing
+// throughput: already-encoded image files are read by a worker pool and
+// framed into .rec/.idx at IO speed, no Python in the loop.
+//
+// Formats (must match mxnet_tpu/recordio.py):
+//   frame:  u32 magic 0xced7230a | u32 (cflag<<29 | length) | payload |
+//           zero-pad to 4 bytes (cflag 0 — whole records only)
+//   IRHeader: <IfQQ> flag, label(f32), id(u64), id2(u64); multi-label
+//           rows use flag=n, label=0, then n f32 labels
+//   .idx:   "key\toffset\n" per record
+//   .lst:   "idx\tlabel...\tpath" (tab-separated; last field is the
+//           relative path, fields between are float labels)
+//
+// C ABI (ctypes, mxnet_tpu/_native.py):
+//   i2r_pack(list_path, root, rec_path, idx_path, nthreads)
+//     -> records packed | negative errno-style code
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Entry {
+  uint64_t idx;
+  std::vector<float> labels;
+  std::string path;
+  std::vector<char> payload;   // IRHeader + image bytes
+  std::atomic<int> ready{0};   // 0 pending, 1 ok, -1 failed
+};
+
+bool parse_list(const std::string &list_path, const std::string &root,
+                std::deque<Entry> &entries) {
+  std::ifstream f(list_path);
+  if (!f) return false;
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty()) continue;
+    std::vector<std::string> fields;
+    std::stringstream ss(line);
+    std::string tok;
+    while (std::getline(ss, tok, '\t')) fields.push_back(tok);
+    if (fields.size() < 3) continue;
+    Entry e;
+    e.idx = std::strtoull(fields[0].c_str(), nullptr, 10);
+    for (size_t i = 1; i + 1 < fields.size(); ++i)
+      e.labels.push_back(std::strtof(fields[i].c_str(), nullptr));
+    e.path = root.empty() ? fields.back()
+                          : root + "/" + fields.back();
+    entries.emplace_back();
+    Entry &slot = entries.back();
+    slot.idx = e.idx;
+    slot.labels = std::move(e.labels);
+    slot.path = std::move(e.path);
+  }
+  return true;
+}
+
+bool build_payload(Entry &e) {
+  std::ifstream img(e.path, std::ios::binary | std::ios::ate);
+  if (!img) return false;
+  std::streamsize n = img.tellg();
+  if (n < 0) return false;  // non-seekable (FIFO etc.)
+  img.seekg(0);
+  // IRHeader <IfQQ> (+ label block for multi-label rows)
+  uint32_t flag = e.labels.size() > 1
+                      ? static_cast<uint32_t>(e.labels.size())
+                      : 0;
+  float label = e.labels.size() == 1 ? e.labels[0] : 0.0f;
+  uint64_t id = e.idx, id2 = 0;
+  size_t head = 4 + 4 + 8 + 8;
+  size_t extra = flag ? e.labels.size() * 4 : 0;
+  e.payload.resize(head + extra + static_cast<size_t>(n));
+  char *p = e.payload.data();
+  std::memcpy(p, &flag, 4);
+  std::memcpy(p + 4, &label, 4);
+  std::memcpy(p + 8, &id, 8);
+  std::memcpy(p + 16, &id2, 8);
+  if (flag)
+    std::memcpy(p + head, e.labels.data(), extra);
+  if (!img.read(p + head + extra, n)) return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+long i2r_pack(const char *list_path, const char *root,
+              const char *rec_path, const char *idx_path, int nthreads) {
+  std::deque<Entry> entries;
+  if (!parse_list(list_path, root ? root : "", entries)) return -1;
+  if (entries.empty()) return 0;
+  if (nthreads < 1) nthreads = 1;
+
+  // worker pool reads+frames payloads; the writer consumes IN ORDER so
+  // the .rec layout is deterministic (reference im2rec.cc partitions
+  // the same way: parallel encode, ordered write).  Workers stay
+  // within a bounded window of the writer so resident payload memory
+  // is capped at O(window), and stop early once anything failed.
+  std::atomic<size_t> next{0};
+  std::atomic<size_t> consumed{0};
+  std::atomic<bool> failed{false};
+  const size_t window = static_cast<size_t>(nthreads) * 16;
+  std::vector<std::thread> pool;
+  std::mutex mu;
+  std::condition_variable cv;       // writer waits for payloads
+  std::condition_variable cv_room;  // workers wait for window room
+  for (int t = 0; t < nthreads; ++t) {
+    pool.emplace_back([&]() {
+      for (;;) {
+        if (failed.load(std::memory_order_acquire)) return;
+        size_t i = next.fetch_add(1);
+        if (i >= entries.size()) return;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          cv_room.wait(lk, [&]() {
+            return failed.load() ||
+                   i < consumed.load(std::memory_order_acquire) +
+                           window;
+          });
+        }
+        if (failed.load(std::memory_order_acquire)) {
+          entries[i].ready.store(-1, std::memory_order_release);
+          std::lock_guard<std::mutex> lk(mu);
+          cv.notify_all();
+          return;
+        }
+        bool ok = false;
+        try {
+          ok = build_payload(entries[i]);
+        } catch (...) {
+          ok = false;  // bad_alloc/length_error must not terminate()
+        }
+        entries[i].ready.store(ok ? 1 : -1,
+                               std::memory_order_release);
+        if (!ok) failed.store(true, std::memory_order_release);
+        std::lock_guard<std::mutex> lk(mu);
+        cv.notify_all();
+        cv_room.notify_all();
+      }
+    });
+  }
+
+  std::FILE *rec = std::fopen(rec_path, "wb");
+  std::FILE *idx = std::fopen(idx_path, "w");
+  long written = -3;
+  if (rec && idx) {
+    written = 0;
+    uint64_t offset = 0;
+    static const char zeros[4] = {0, 0, 0, 0};
+    for (size_t i = 0; i < entries.size(); ++i) {
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // also wake on global failure: workers that bail early leave
+        // unclaimed entries pending forever
+        cv.wait(lk, [&]() {
+          return entries[i].ready.load(std::memory_order_acquire) != 0 ||
+                 failed.load(std::memory_order_acquire);
+        });
+      }
+      if (entries[i].ready.load(std::memory_order_acquire) != 1) {
+        written = -2;  // unreadable input file (or aborted run)
+        break;
+      }
+      const std::vector<char> &pl = entries[i].payload;
+      uint32_t len = static_cast<uint32_t>(pl.size());
+      uint32_t pad = (4 - (len % 4)) % 4;
+      bool io_ok =
+          std::fwrite(&kMagic, 4, 1, rec) == 1 &&
+          std::fwrite(&len, 4, 1, rec) == 1 &&  // cflag 0 in top bits
+          std::fwrite(pl.data(), 1, len, rec) == len &&
+          (pad == 0 || std::fwrite(zeros, 1, pad, rec) == pad) &&
+          std::fprintf(idx, "%llu\t%llu\n",
+                       static_cast<unsigned long long>(entries[i].idx),
+                       static_cast<unsigned long long>(offset)) > 0;
+      if (!io_ok) {
+        written = -4;  // output write failed (disk full?)
+        failed.store(true, std::memory_order_release);
+        break;
+      }
+      offset += 8 + len + pad;
+      entries[i].payload.clear();
+      entries[i].payload.shrink_to_fit();
+      ++written;
+      consumed.store(i + 1, std::memory_order_release);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        cv_room.notify_all();
+      }
+    }
+  }
+  failed.store(failed.load() || written < 0, std::memory_order_release);
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    cv_room.notify_all();
+  }
+  if (rec && std::fclose(rec) != 0 && written >= 0) written = -4;
+  if (idx && std::fclose(idx) != 0 && written >= 0) written = -4;
+  for (auto &th : pool) th.join();
+  return written;
+}
+
+}  // extern "C"

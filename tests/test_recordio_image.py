@@ -244,3 +244,55 @@ def test_native_scanner_detects_corruption(tmp_path):
         f.write(b"\x00" * 16)
     with pytest.raises(mx.base.MXNetError):
         scan_recordio(path)
+
+
+def test_native_im2rec_packer(tmp_path):
+    """The native parallel packer (src/im2rec.cc, the reference
+    tools/im2rec.cc role): pass-through packs pre-encoded files into
+    .rec/.idx whose framing/IRHeader round-trip through the Python
+    reader and feed ImageRecordIter."""
+    from mxnet_tpu._native import pack_recordio
+
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("no PIL")
+    rs = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    (root / "c0").mkdir(parents=True)
+    lst_lines = []
+    for i in range(12):
+        arr = (rs.rand(16, 16, 3) * 255).astype("uint8")
+        rel = "c0/img%02d.png" % i
+        Image.fromarray(arr).save(str(root / rel))
+        lst_lines.append("%d\t%d\t%s" % (i, i % 3, rel))
+    lst = tmp_path / "set.lst"
+    lst.write_text("\n".join(lst_lines) + "\n")
+
+    n = pack_recordio(str(lst), str(root), str(tmp_path / "set.rec"),
+                      str(tmp_path / "set.idx"), nthreads=4)
+    if n is None:
+        pytest.skip("native packer unavailable (no g++)")
+    assert n == 12
+
+    from mxnet_tpu import recordio
+
+    r = recordio.MXIndexedRecordIO(str(tmp_path / "set.idx"),
+                                   str(tmp_path / "set.rec"), "r")
+    hdr, img = recordio.unpack_img(r.read_idx(5))
+    assert img.shape == (16, 16, 3)
+    assert float(hdr.label) == 5 % 3
+    assert hdr.id == 5
+
+    it = mx.io.ImageRecordIter(path_imgrec=str(tmp_path / "set.rec"),
+                               path_imgidx=str(tmp_path / "set.idx"),
+                               data_shape=(3, 16, 16), batch_size=4)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 16, 16)
+
+    # unreadable input surfaces as an error, not silence
+    bad = tmp_path / "bad.lst"
+    bad.write_text("0\t1\tdoes_not_exist.png\n")
+    with pytest.raises(mx.base.MXNetError):
+        pack_recordio(str(bad), str(root), str(tmp_path / "bad.rec"),
+                      str(tmp_path / "bad.idx"))

@@ -23,9 +23,33 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sizes-mb", default="1,4,16,64")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--cpu-mesh", type=int, default=0,
+                    help="run on a virtual N-device CPU mesh (validates "
+                         "the collective path without N chips; numbers "
+                         "are host-memory, not ICI)")
+    ap.add_argument("--dcn", type=int, default=0,
+                    help="measure the multi-PROCESS (DCN-branch) "
+                         "allreduce with N local jax.distributed "
+                         "workers (localhost transport)")
+    ap.add_argument("--dcn-worker", default="",
+                    help=argparse.SUPPRESS)  # internal: coord,nproc,rank
     args = ap.parse_args()
 
+    if args.dcn and not args.dcn_worker:
+        return _dcn_launch(args)
+    if args.dcn_worker:
+        return _dcn_worker(args)
+
+    if args.cpu_mesh:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=%d" % args.cpu_mesh)
+
     import jax
+
+    if args.cpu_mesh:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -54,6 +78,8 @@ def main():
             lambda v: jax.lax.psum(v, "data"), mesh=mesh,
             in_specs=P("data"), out_specs=P("data")))
         out = fn(x)
+        # host fetch forces completion (block_until_ready does not
+        # synchronize through the axon tunnel)
         float(np.asarray(out.addressable_shards[0].data[0]))
         t0 = time.perf_counter()
         for _ in range(args.iters):
@@ -66,5 +92,59 @@ def main():
               % (mb, dt * 1e3, busbw / 1e9))
 
 
+def _dcn_launch(args):
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--sizes-mb", args.sizes_mb, "--iters", str(args.iters),
+         "--dcn-worker", "%s,%d,%d" % (coord, args.dcn, r)],
+        env=env) for r in range(args.dcn)]
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def _dcn_worker(args):
+    coord, nproc, rank = args.dcn_worker.split(",")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(nproc),
+                               process_id=int(rank))
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.collectives import allreduce_nd
+
+    n = jax.process_count()
+    for mb in [float(x) for x in args.sizes_mb.split(",")]:
+        elems = int(mb * (1 << 20) / 4)
+        arr = mx.nd.array(np.ones((elems,), "float32"))
+        allreduce_nd(arr)  # warm the path
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = allreduce_nd(arr)
+        out.asnumpy()
+        dt = (time.perf_counter() - t0) / args.iters
+        nbytes = elems * 4
+        # allgather-based: each process receives (n-1) remote shards
+        busbw = (n - 1) * nbytes / dt
+        if int(rank) == 0:
+            print("DCN %dproc size %8.1f MB  time %8.3f ms  "
+                  "busbw %8.2f GB/s" % (n, mb, dt * 1e3, busbw / 1e9))
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

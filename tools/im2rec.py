@@ -69,6 +69,27 @@ def read_list(path):
 
 
 def pack(prefix, root, args):
+    if getattr(args, "pass_through", False):
+        # native parallel packer (reference tools/im2rec.cc role):
+        # already-encoded files are framed straight into .rec/.idx with
+        # no decode/re-encode and no Python in the loop (the C++ side
+        # parses the .lst itself — nothing to pre-read here)
+        if args.resize or args.quality != 95 or args.color != 1 or \
+                args.encoding != ".jpg":
+            raise SystemExit(
+                "--pass-through packs files untouched; it cannot honor "
+                "--resize/--quality/--color/--encoding — drop those "
+                "flags or use the re-encoding path")
+        from mxnet_tpu._native import pack_recordio
+
+        n = pack_recordio(prefix + ".lst", root, prefix + ".rec",
+                          prefix + ".idx", nthreads=args.num_thread)
+        if n is not None:
+            print("wrote %s.rec (%d records, native pass-through)"
+                  % (prefix, n))
+            return
+        print("native packer unavailable; using the Python path")
+
     from mxnet_tpu import recordio
     from mxnet_tpu.image import imread, resize_short
 
@@ -109,6 +130,9 @@ def main():
     ap.add_argument("--color", type=int, default=1)
     ap.add_argument("--encoding", default=".jpg")
     ap.add_argument("--num-thread", type=int, default=4)
+    ap.add_argument("--pass-through", action="store_true",
+                    help="pack already-encoded files natively (no "
+                         "decode/re-encode; the C++ parallel packer)")
     args = ap.parse_args()
     if args.list:
         write_list(args.prefix, list_images(args.root), args)
