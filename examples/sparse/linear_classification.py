@@ -19,13 +19,18 @@ import mxnet_tpu as mx
 from mxnet_tpu.ndarray import sparse
 
 
-def synthetic_sparse(n, dim, density, rs):
-    """Sparse features whose active indices determine the label."""
+def synthetic_sparse(n, dim, density, rs, vary=False):
+    """Sparse features whose active indices determine the label.
+    ``vary=True`` draws each row's nnz from [nnz/2, 3*nnz/2] — the
+    organic per-batch nnz variation real sparse workloads have (and the
+    executable cache must absorb; see --nnz-buckets)."""
     w_true = rs.randn(dim).astype("float32")
     rows = []
     labels = []
-    nnz = max(1, int(dim * density))
+    base = max(1, int(dim * density))
     for _ in range(n):
+        nnz = int(rs.randint(max(1, base // 2), base * 3 // 2 + 1)) \
+            if vary else base
         idx = rs.choice(dim, nnz, replace=False)
         vals = rs.rand(nnz).astype("float32")
         x = np.zeros(dim, "float32")
@@ -36,9 +41,16 @@ def synthetic_sparse(n, dim, density, rs):
 
 
 def main(args):
+    import time
+
+    if args.nnz_buckets:
+        os.environ["MXNET_SPARSE_NNZ_BUCKETS"] = "1"
     rs = np.random.RandomState(0)
     x_dense, y = synthetic_sparse(args.num_examples, args.dim,
-                                  args.density, rs)
+                                  args.density, rs,
+                                  vary=args.vary_nnz)
+    shapes_seen = set()   # distinct component shapes = kernel compiles
+    t_start = time.perf_counter()
 
     kv = mx.kv.create("local")
     kv.init("w", mx.nd.zeros((args.dim, 1)))
@@ -54,6 +66,7 @@ def main(args):
             xb = x_dense[b * args.batch_size:(b + 1) * args.batch_size]
             yb = y[b * args.batch_size:(b + 1) * args.batch_size]
             x_csr = sparse.csr_matrix(xb)
+            shapes_seen.add(("csr", x_csr._data.shape[0]))
             # pull only the rows this batch touches
             touched = np.nonzero(xb.sum(0))[0]
             w_rows = sparse.zeros("row_sparse", (args.dim, 1))
@@ -65,9 +78,15 @@ def main(args):
             # logistic-loss gradient, pushed as row_sparse
             g_dense = xb.T @ (p - yb).reshape(-1, 1) / args.batch_size
             grad = sparse.row_sparse_array(g_dense.astype("float32"))
+            shapes_seen.add(("rsp", grad._data.shape[0]))
             kv.push("w", grad)
         print("epoch %d train-acc %.4f"
               % (epoch, correct / (n_batches * args.batch_size)))
+    dt = time.perf_counter() - t_start
+    print("distinct sparse component shapes (≈ kernel compiles): %d | "
+          "total %.2fs | buckets=%s vary-nnz=%s"
+          % (len(shapes_seen), dt, bool(args.nnz_buckets),
+             bool(args.vary_nnz)))
     return correct / (n_batches * args.batch_size)
 
 
@@ -79,4 +98,10 @@ if __name__ == "__main__":
     p.add_argument("--density", type=float, default=0.02)
     p.add_argument("--lr", type=float, default=1.0)
     p.add_argument("--num-examples", type=int, default=2048)
+    p.add_argument("--vary-nnz", action="store_true",
+                   help="organic per-row nnz variation")
+    p.add_argument("--nnz-buckets", action="store_true",
+                   help="MXNET_SPARSE_NNZ_BUCKETS=1: pad nnz to "
+                        "power-of-two buckets, bounding compiles at "
+                        "O(log max_nnz)")
     main(p.parse_args())

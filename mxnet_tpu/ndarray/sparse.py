@@ -180,6 +180,43 @@ class CSRNDArray(BaseSparseNDArray):
 # constructors (reference python/mxnet/ndarray/sparse.py)
 # ---------------------------------------------------------------------------
 
+def _nnz_bucket(n):
+    """Next power-of-two bucket for nnz padding (min 16), active under
+    ``MXNET_SPARSE_NNZ_BUCKETS=1``.
+
+    Sparse kernels compile per component SHAPE (module docstring: the
+    static-shape ragged encoding); imperative workloads with organic nnz
+    variation pay a recompile per distinct nnz.  Bucketing pads nnz to
+    powers of two so the executable count is O(log max_nnz) — the
+    BucketingModule trick applied to sparsity.  Padding is inert by
+    construction: row_sparse pads with SENTINEL row id ``num_rows``
+    (out-of-range scatter indices drop under jit; gathers clamp but
+    their results are dropped too) and csr pads values with zeros
+    beyond ``indptr[-1]`` (value-linear kernels are unaffected).
+    """
+    from ..base import get_env
+
+    if not get_env("MXNET_SPARSE_NNZ_BUCKETS", 0, int):
+        return n
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_rsp_components(data, indices, num_rows):
+    import jax.numpy as jnp
+
+    bucket = _nnz_bucket(int(data.shape[0]))
+    pad = bucket - int(data.shape[0])
+    if pad <= 0:
+        return data, indices
+    zrows = jnp.zeros((pad,) + tuple(data.shape[1:]), data.dtype)
+    sentinel = jnp.full((pad,), num_rows, "int32")
+    return (jnp.concatenate([data, zrows]),
+            jnp.concatenate([indices, sentinel]))
+
+
 def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
     """Build a RowSparseNDArray from (data, indices) or a dense source."""
     import jax.numpy as jnp
@@ -193,6 +230,7 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
             indices._data.astype("int32")
         if shape is None:
             raise MXNetError("shape required with (data, indices)")
+        data, indices = _pad_rsp_components(data, indices, shape[0])
         return RowSparseNDArray(data, indices, shape, ctx)
     if isinstance(arg, RowSparseNDArray):
         return arg
@@ -200,9 +238,10 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
         arg, dtype=dtype or "float32")
     nz_rows = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0,
                                 axis=1))[0]
-    return RowSparseNDArray(
+    data, indices = _pad_rsp_components(
         jnp.asarray(dense[nz_rows]), jnp.asarray(nz_rows, "int32"),
-        dense.shape, ctx)
+        dense.shape[0])
+    return RowSparseNDArray(data, indices, dense.shape, ctx)
 
 
 def csr_matrix(arg, shape=None, ctx=None, dtype=None):
@@ -228,8 +267,16 @@ def csr_matrix(arg, shape=None, ctx=None, dtype=None):
     indptr = _np.zeros(dense.shape[0] + 1, "int32")
     _np.add.at(indptr, rows + 1, 1)
     indptr = _np.cumsum(indptr).astype("int32")
-    return CSRNDArray(jnp.asarray(dense[rows, cols]), cols.astype("int32"),
-                      indptr, dense.shape, ctx)
+    vals = dense[rows, cols]
+    cols = cols.astype("int32")
+    bucket = _nnz_bucket(len(vals))
+    if bucket > len(vals):
+        # zero-value tail beyond indptr[-1]: value-linear kernels are
+        # unaffected, the executable cache sees one shape per bucket
+        pad = bucket - len(vals)
+        vals = _np.concatenate([vals, _np.zeros(pad, vals.dtype)])
+        cols = _np.concatenate([cols, _np.zeros(pad, "int32")])
+    return CSRNDArray(jnp.asarray(vals), cols, indptr, dense.shape, ctx)
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
