@@ -223,6 +223,7 @@ class KVStore:
                     uniq = np.unique(orig_ids)
                     tgt._indices = jnp.asarray(uniq, "int32")
                     tgt._sp_shape = tuple(src.shape)
+                    tgt._true_nnz = len(uniq)
                     tgt._set_data(gather(uniq))
                 elif tgt.shape == (len(orig_ids),) + tuple(src.shape[1:]):
                     # dense per-request rows, original order incl. dups

@@ -39,7 +39,7 @@ class BaseSparseNDArray(NDArray):
     ``tostype('default')`` (storage-fallback semantics).
     """
 
-    __slots__ = ("_sp_shape", "_indices", "_indptr")
+    __slots__ = ("_sp_shape", "_indices", "_indptr", "_true_nnz")
 
     stype = "undefined"
 
@@ -53,12 +53,19 @@ class BaseSparseNDArray(NDArray):
 
     @property
     def data(self):
-        """The values component (reference ``.data``)."""
-        return NDArray(self._data, self._ctx)
+        """The values component (reference ``.data``).  Under nnz
+        bucketing the public view is sliced back to the true nnz —
+        padding never leaks to host-side consumers (numpy indexing has
+        no out-of-bounds drop)."""
+        return NDArray(self._data[:self._public_nnz()], self._ctx)
 
     @property
     def indices(self):
-        return NDArray(self._indices, self._ctx)
+        return NDArray(self._indices[:self._public_nnz()], self._ctx)
+
+    def _public_nnz(self):
+        n = getattr(self, "_true_nnz", None)
+        return int(self._data.shape[0]) if n is None else n
 
     def asnumpy(self):
         return _np.asarray(self._to_dense_jax())
@@ -122,9 +129,15 @@ class RowSparseNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, shape, ctx=None):
         import jax.numpy as jnp
 
-        super().__init__(data, ctx)
-        self._indices = indices.astype(jnp.int32) \
+        indices = indices.astype(jnp.int32) \
             if hasattr(indices, "astype") else jnp.asarray(indices, "int32")
+        # nnz bucketing applies HERE — the one spot every producer
+        # (constructors, retain, merges, kv pulls) goes through — so
+        # the O(log max_nnz) executable bound holds past the first op
+        self._true_nnz = int(data.shape[0])
+        data, indices = _pad_rsp_components(data, indices, shape[0])
+        super().__init__(data, ctx)
+        self._indices = indices
         self._sp_shape = tuple(int(s) for s in shape)
 
     def _to_dense_jax(self):
@@ -148,8 +161,20 @@ class CSRNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, indptr, shape, ctx=None):
         import jax.numpy as jnp
 
+        indices = jnp.asarray(indices).astype(jnp.int32)
+        self._true_nnz = int(data.shape[0])
+        bucket = _nnz_bucket(self._true_nnz)
+        if bucket > self._true_nnz:
+            # zero-value tail beyond indptr[-1]: value-linear kernels
+            # are unaffected; one executable per bucket
+            pad = bucket - self._true_nnz
+            data = jnp.concatenate(
+                [jnp.asarray(data),
+                 jnp.zeros((pad,), jnp.asarray(data).dtype)])
+            indices = jnp.concatenate(
+                [indices, jnp.zeros((pad,), jnp.int32)])
         super().__init__(data, ctx)
-        self._indices = jnp.asarray(indices).astype(jnp.int32)
+        self._indices = indices
         self._indptr = jnp.asarray(indptr).astype(jnp.int32)
         self._sp_shape = tuple(int(s) for s in shape)
         if len(self._sp_shape) != 2:
@@ -196,7 +221,7 @@ def _nnz_bucket(n):
     """
     from ..base import get_env
 
-    if not get_env("MXNET_SPARSE_NNZ_BUCKETS", 0, int):
+    if n == 0 or not get_env("MXNET_SPARSE_NNZ_BUCKETS", 0, int):
         return n
     b = 16
     while b < n:
@@ -230,7 +255,6 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
             indices._data.astype("int32")
         if shape is None:
             raise MXNetError("shape required with (data, indices)")
-        data, indices = _pad_rsp_components(data, indices, shape[0])
         return RowSparseNDArray(data, indices, shape, ctx)
     if isinstance(arg, RowSparseNDArray):
         return arg
@@ -238,10 +262,9 @@ def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
         arg, dtype=dtype or "float32")
     nz_rows = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0,
                                 axis=1))[0]
-    data, indices = _pad_rsp_components(
+    return RowSparseNDArray(
         jnp.asarray(dense[nz_rows]), jnp.asarray(nz_rows, "int32"),
-        dense.shape[0])
-    return RowSparseNDArray(data, indices, dense.shape, ctx)
+        dense.shape, ctx)
 
 
 def csr_matrix(arg, shape=None, ctx=None, dtype=None):
@@ -267,16 +290,8 @@ def csr_matrix(arg, shape=None, ctx=None, dtype=None):
     indptr = _np.zeros(dense.shape[0] + 1, "int32")
     _np.add.at(indptr, rows + 1, 1)
     indptr = _np.cumsum(indptr).astype("int32")
-    vals = dense[rows, cols]
-    cols = cols.astype("int32")
-    bucket = _nnz_bucket(len(vals))
-    if bucket > len(vals):
-        # zero-value tail beyond indptr[-1]: value-linear kernels are
-        # unaffected, the executable cache sees one shape per bucket
-        pad = bucket - len(vals)
-        vals = _np.concatenate([vals, _np.zeros(pad, vals.dtype)])
-        cols = _np.concatenate([cols, _np.zeros(pad, "int32")])
-    return CSRNDArray(jnp.asarray(vals), cols, indptr, dense.shape, ctx)
+    return CSRNDArray(jnp.asarray(dense[rows, cols]),
+                      cols.astype("int32"), indptr, dense.shape, ctx)
 
 
 def zeros(stype, shape, ctx=None, dtype="float32"):
@@ -385,9 +400,13 @@ def _merge_rsp(arrays):
     import jax.numpy as jnp
 
     shape = arrays[0].shape
-    idx = _np.concatenate([_np.asarray(a._indices) for a in arrays])
+    # merge the TRUE components: bucketing's sentinel rows must not
+    # enter the index union (the constructor re-pads the result)
+    idx = _np.concatenate(
+        [_np.asarray(a._indices[:a._public_nnz()]) for a in arrays])
     uniq, inv = _np.unique(idx, return_inverse=True)
-    vals = jnp.concatenate([a._data for a in arrays], axis=0)
+    vals = jnp.concatenate(
+        [a._data[:a._public_nnz()] for a in arrays], axis=0)
     import jax
 
     summed = jax.ops.segment_sum(vals, jnp.asarray(inv, "int32"),

@@ -127,15 +127,18 @@ def allreduce_row_sparse(rsp):
     from ..ndarray.sparse import RowSparseNDArray, _merge_rsp
 
     num_rows = rsp.shape[0]
-    nnz = int(rsp._indices.shape[0])
+    # strip constructor nnz-bucket padding before the wire: shipping
+    # sentinel zero-rows the receiver drops would waste the bandwidth
+    # row_sparse exists to save
+    nnz = rsp._public_nnz()
     counts = np.asarray(multihost_utils.process_allgather(
         np.asarray([nnz], "int32"))).reshape(-1)
     max_nnz = int(counts.max())
     if max_nnz == 0:
         return rsp
     pad = max_nnz - nnz
-    idx = np.asarray(rsp._indices, "int32")
-    data = np.asarray(rsp._data)
+    idx = np.asarray(rsp._indices[:nnz], "int32")
+    data = np.asarray(rsp._data[:nnz])
     if pad:
         idx = np.concatenate([idx, np.full(pad, num_rows, "int32")])
         data = np.concatenate(

@@ -65,7 +65,7 @@ def main():
     # the bandwidth property row_sparse exists for)
     assert isinstance(kv._merged["emb"], sp.RowSparseNDArray), \
         type(kv._merged["emb"])
-    assert kv._merged["emb"]._indices.shape[0] <= 4  # <= sum of nnz
+    assert kv._merged["emb"].indices.shape[0] <= 4  # true nnz <= sum
     dense = mx.nd.zeros((6, 2))
     kv.pull("emb", out=dense)
     expect_emb = np.zeros((6, 2), "float32")
