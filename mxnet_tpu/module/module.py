@@ -559,18 +559,23 @@ class Module(BaseModule):
             self._async_tick()
             return
         if self._kvstore:
-            for i, name in enumerate(self._param_names):
-                w = self._exec.arg_dict[name]
-                g = self._exec.grad_dict.get(name)
-                if g is None:
-                    continue
-                self._kvstore.push(i, g, priority=-i)
-                if self._update_on_kvstore:
-                    self._kvstore.pull(i, w, priority=-i)
-                else:
-                    merged = zeros(g.shape, g.context)
-                    self._kvstore.pull(i, merged, priority=-i)
-                    self._updater(i, merged, w)
+            # one batched push in priority order (priority=-i: earliest
+            # layers first, the reference's overlap hint order,
+            # model.py:105-116); the kvstore reduces the whole batch in
+            # a single DCN round trip instead of one per key
+            live = [(i, name) for i, name in enumerate(self._param_names)
+                    if self._exec.grad_dict.get(name) is not None]
+            keys = [i for i, _ in live]
+            grads = [self._exec.grad_dict[name] for _, name in live]
+            self._kvstore.push(keys, grads, priority=0)
+            if self._update_on_kvstore:
+                self._kvstore.pull(
+                    keys, [self._exec.arg_dict[name] for _, name in live])
+            else:
+                merged = [zeros(g.shape, g.context) for g in grads]
+                self._kvstore.pull(keys, merged)
+                for (i, name), m in zip(live, merged):
+                    self._updater(i, m, self._exec.arg_dict[name])
         else:
             for i, name in enumerate(self._param_names):
                 w = self._exec.arg_dict[name]

@@ -82,12 +82,17 @@ class BaseSparseNDArray(NDArray):
 
     def copy(self):
         # fresh wrapper sharing the immutable component buffers (dense
-        # NDArray.copy has the same sharing-safety: mutation rebinds)
+        # NDArray.copy has the same sharing-safety: mutation rebinds).
+        # Components are sliced to the TRUE nnz first: feeding padded
+        # buffers back through the constructor would re-pad and reset
+        # _true_nnz to the padded length (sentinels would leak into the
+        # public views and index unions)
+        n = self._public_nnz()
         if isinstance(self, RowSparseNDArray):
-            return RowSparseNDArray(self._data, self._indices,
+            return RowSparseNDArray(self._data[:n], self._indices[:n],
                                     self._sp_shape, self._ctx)
-        return CSRNDArray(self._data, self._indices, self._indptr,
-                          self._sp_shape, self._ctx)
+        return CSRNDArray(self._data[:n], self._indices[:n],
+                          self._indptr, self._sp_shape, self._ctx)
 
     def copyto(self, other):
         if isinstance(other, BaseSparseNDArray):
@@ -163,16 +168,7 @@ class CSRNDArray(BaseSparseNDArray):
 
         indices = jnp.asarray(indices).astype(jnp.int32)
         self._true_nnz = int(data.shape[0])
-        bucket = _nnz_bucket(self._true_nnz)
-        if bucket > self._true_nnz:
-            # zero-value tail beyond indptr[-1]: value-linear kernels
-            # are unaffected; one executable per bucket
-            pad = bucket - self._true_nnz
-            data = jnp.concatenate(
-                [jnp.asarray(data),
-                 jnp.zeros((pad,), jnp.asarray(data).dtype)])
-            indices = jnp.concatenate(
-                [indices, jnp.zeros((pad,), jnp.int32)])
+        data, indices = _pad_csr_components(jnp.asarray(data), indices)
         super().__init__(data, ctx)
         self._indices = indices
         self._indptr = jnp.asarray(indptr).astype(jnp.int32)
@@ -227,6 +223,19 @@ def _nnz_bucket(n):
     while b < n:
         b *= 2
     return b
+
+
+def _pad_csr_components(data, indices):
+    """Zero-value tail beyond ``indptr[-1]``: value-linear kernels are
+    unaffected; one executable per bucket."""
+    import jax.numpy as jnp
+
+    bucket = _nnz_bucket(int(data.shape[0]))
+    pad = bucket - int(data.shape[0])
+    if pad <= 0:
+        return data, indices
+    return (jnp.concatenate([data, jnp.zeros((pad,), data.dtype)]),
+            jnp.concatenate([indices, jnp.zeros((pad,), jnp.int32)]))
 
 
 def _pad_rsp_components(data, indices, num_rows):
