@@ -193,6 +193,12 @@ def test_transformer_seq_parallel_trains():
                     optimizer="adam",
                     optimizer_params={"learning_rate": 0.02},
                     initializer=mx.init.Xavier())
+            if seq_parallel:
+                # batch-axis-free meshes engage the fused SPMD step
+                # (the batch replicates; 'seq' is consumed inside ring
+                # attention) — regression lock for the r4 batch_axes fix
+                assert mod._fused is not None, \
+                    "fused step did not engage on the seq mesh"
         return metric.get()[1]
 
     import contextlib
