@@ -37,6 +37,13 @@ def measure(argv=None):
     elif "--deep" in argv:
         cfg = dict(vocab_size=32768, num_layers=16, d_model=1024,
                    num_heads=16, seq_len=1024)
+    elif "--moe" in argv:
+        # routed top-2 MoE: 8 experts of d_ff=1024 per block — 8x the
+        # FFN capacity of the dense d1024 config at top-2 active compute
+        # (README row; single-chip routed dispatch, no expert mesh)
+        cfg = dict(vocab_size=32000, num_layers=8, d_model=1024,
+                   num_heads=8, seq_len=1024, d_ff=1024,
+                   moe_experts=8, moe_top_k=2)
     else:
         # the MFU-headline config: d2048 keeps every matmul MXU-shaped
         # (measured 65% MFU at batch 8 vs 42% for the 16L-d1024 config)
@@ -62,7 +69,14 @@ def measure(argv=None):
 
     # analytic train FLOPs (MAC=2): 6*P*tokens for the matmul stack plus
     # the attention score/value terms 12*L*N*T^2*C
-    p_count = transformer.count_params(**cfg)
+    moe = "moe_experts" in cfg
+    if moe:
+        # analytic count ignores MoE; count the real params.  6*P*tokens
+        # is NOT the executed-FLOP count under top-k routing (only k/E
+        # of expert FLOPs run), so the MoE row reports tokens/s only.
+        p_count = sum(int(np.prod(v.shape)) for v in params.values())
+    else:
+        p_count = transformer.count_params(**cfg)
     tokens = batch * cfg["seq_len"]
     flops_per_step = (6.0 * p_count * tokens +
                       12.0 * cfg["num_layers"] * batch *
@@ -87,12 +101,15 @@ def measure(argv=None):
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens / dt, 1),
         "unit": "tokens/s",
-        "model": "%dL-d%d-T%d (%.0fM params)" % (
+        "model": "%dL-d%d-T%d%s (%.0fM params)" % (
             cfg["num_layers"], cfg["d_model"], cfg["seq_len"],
+            "-MoE-E%d-top%d" % (cfg["moe_experts"], cfg["moe_top_k"])
+            if "moe_experts" in cfg else "",
             p_count / 1e6),
         "step_ms": round(dt * 1e3, 2),
-        "achieved_tflops": round(achieved / 1e12, 2),
-        "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
+        "achieved_tflops": None if moe else round(achieved / 1e12, 2),
+        "mfu_pct": round(100 * achieved / peak, 2)
+                   if peak and not moe else None,
         "precision": "bf16+fp32-master",
         "device": kind,
     }
