@@ -9,7 +9,7 @@ workload: a GPT-style model whose FLOPs sit in large matmuls.
 
 Prints ONE JSON line with tokens/sec and %MFU.
 
-Usage: bench_transformer.py [--small]
+Usage: bench_transformer.py [--small|--deep|--moe] [--batch=N]
 """
 import json
 import sys
@@ -38,9 +38,11 @@ def measure(argv=None):
         cfg = dict(vocab_size=32768, num_layers=16, d_model=1024,
                    num_heads=16, seq_len=1024)
     elif "--moe" in argv:
-        # routed top-2 MoE: 8 experts of d_ff=1024 per block — 8x the
-        # FFN capacity of the dense d1024 config at top-2 active compute
-        # (README row; single-chip routed dispatch, no expert mesh)
+        # routed top-2 MoE: 8 experts of d_ff=1024 per block = 2x the
+        # total FFN parameters of the dense d1024 config (d_ff=4096)
+        # with only a 2048-wide active path per token (top-2) — the
+        # capacity/compute decoupling MoE buys.  Single-chip routed
+        # dispatch, no expert mesh.
         cfg = dict(vocab_size=32000, num_layers=8, d_model=1024,
                    num_heads=8, seq_len=1024, d_ff=1024,
                    moe_experts=8, moe_top_k=2)
@@ -67,8 +69,6 @@ def measure(argv=None):
             0, cfg["vocab_size"], shapes["data"]).astype("float32"))
     batch_dict = {"data": toks, "softmax_label": toks}
 
-    # analytic train FLOPs (MAC=2): 6*P*tokens for the matmul stack plus
-    # the attention score/value terms 12*L*N*T^2*C
     moe = "moe_experts" in cfg
     if moe:
         # analytic count ignores MoE; count the real params.  6*P*tokens
@@ -78,9 +78,13 @@ def measure(argv=None):
     else:
         p_count = transformer.count_params(**cfg)
     tokens = batch * cfg["seq_len"]
-    flops_per_step = (6.0 * p_count * tokens +
-                      12.0 * cfg["num_layers"] * batch *
-                      cfg["seq_len"] ** 2 * cfg["d_model"])
+    # analytic train FLOPs (MAC=2): 6*P*tokens for the matmul stack plus
+    # the attention score/value terms; skipped for MoE (6*P overcounts
+    # top-k-routed expert FLOPs)
+    flops_per_step = None if moe else (
+        6.0 * p_count * tokens +
+        12.0 * cfg["num_layers"] * batch *
+        cfg["seq_len"] ** 2 * cfg["d_model"])
 
     params, aux, states, out = step(params, aux, states, batch_dict, rng)
     float(np.asarray(out[0][0, 0]))  # force compile + completion
@@ -92,7 +96,7 @@ def measure(argv=None):
     float(np.asarray(out[0][0, 0]))
     dt = (time.perf_counter() - t0) / iters
 
-    achieved = flops_per_step / dt
+    achieved = None if moe else flops_per_step / dt
     device = jax.devices()[0]
     kind = getattr(device, "device_kind", "unknown")
     peak = next((v for k, v in PEAK_BF16.items() if kind.startswith(k)),
@@ -104,7 +108,7 @@ def measure(argv=None):
         "model": "%dL-d%d-T%d%s (%.0fM params)" % (
             cfg["num_layers"], cfg["d_model"], cfg["seq_len"],
             "-MoE-E%d-top%d" % (cfg["moe_experts"], cfg["moe_top_k"])
-            if "moe_experts" in cfg else "",
+            if moe else "",
             p_count / 1e6),
         "step_ms": round(dt * 1e3, 2),
         "achieved_tflops": None if moe else round(achieved / 1e12, 2),
