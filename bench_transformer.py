@@ -96,7 +96,8 @@ def measure(argv=None):
     float(np.asarray(out[0][0, 0]))
     dt = (time.perf_counter() - t0) / iters
 
-    achieved = None if moe else flops_per_step / dt
+    achieved = None if flops_per_step is None \
+        else flops_per_step / dt
     device = jax.devices()[0]
     kind = getattr(device, "device_kind", "unknown")
     peak = next((v for k, v in PEAK_BF16.items() if kind.startswith(k)),
@@ -111,9 +112,10 @@ def measure(argv=None):
             if moe else "",
             p_count / 1e6),
         "step_ms": round(dt * 1e3, 2),
-        "achieved_tflops": None if moe else round(achieved / 1e12, 2),
+        "achieved_tflops": round(achieved / 1e12, 2)
+                           if achieved is not None else None,
         "mfu_pct": round(100 * achieved / peak, 2)
-                   if peak and not moe else None,
+                   if peak and achieved is not None else None,
         "precision": "bf16+fp32-master",
         "device": kind,
     }

@@ -10,8 +10,10 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _run(script, *args, timeout=420):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+def _run(script, *args, timeout=420, env=None):
+    merged = dict(os.environ, JAX_PLATFORMS="cpu")
+    merged.update(env or {})
+    env = merged
     res = subprocess.run(
         [sys.executable, os.path.join(REPO, script), *args],
         capture_output=True, text=True, env=env, cwd=REPO,
@@ -55,3 +57,11 @@ def test_example_rcnn():
     out = _run("examples/rcnn/train_rcnn.py", "--num-epochs", "3",
                "--num-examples", "64", "--batch-size", "8")
     assert "RCNN TRAINS OK" in out
+
+
+def test_example_pipeline_transformer():
+    out = _run("examples/model-parallelism/pipeline_transformer.py",
+               "--num-epochs", "8",
+               env={"XLA_FLAGS":
+                        "--xla_force_host_platform_device_count=4"})
+    assert "PIPELINE TRAINS OK" in out
