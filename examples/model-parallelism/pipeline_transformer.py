@@ -27,14 +27,6 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # honor an explicit CPU request even when a TPU plugin env export
-    # would override the env var (same pin tests/conftest.py uses)
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
-
 def main(args):
     import jax
 

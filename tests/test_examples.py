@@ -65,3 +65,9 @@ def test_example_pipeline_transformer():
                env={"XLA_FLAGS":
                         "--xla_force_host_platform_device_count=4"})
     assert "PIPELINE TRAINS OK" in out
+
+
+def test_example_gluon_moe():
+    out = _run("examples/gluon/moe_classifier.py", "--num-epochs", "12",
+               "--num-examples", "128")
+    assert "GLUON MOE TRAINS OK" in out
