@@ -14,6 +14,11 @@ class Trainer:
                  kvstore="device"):
         if isinstance(params, dict) or hasattr(params, "values"):
             params = list(params.values())
+        # trainable params drive updates; ALL params (incl. grad-less
+        # state like BatchNorm running stats) join dist_async averaging
+        # rounds — per-shard moving stats would diverge without bound
+        # otherwise (same stance as Module._async_params)
+        self._all_params = list(params)
         self._params = [p for p in params if p.grad_req != "null"]
         self._scale = float(dict(optimizer_params or {}).get(
             "rescale_grad", 1.0))
@@ -41,7 +46,14 @@ class Trainer:
             self._kvstore = kvs.create(self._kvstore_type)
             for i, p in enumerate(self._params):
                 self._kvstore.init(i, p.data())
+            if getattr(self._kvstore, "_is_async", False):
+                # common starting point across hosts (the round
+                # Module.init_optimizer runs)
+                self._kvstore.sync_params(self._async_arrays())
         self._kv_initialized = True
+
+    def _async_arrays(self):
+        return [p.data() for p in self._all_params]
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step using gradients accumulated on the
@@ -49,6 +61,8 @@ class Trainer:
         kvstore push/pull, then updater)."""
         if not self._kv_initialized:
             self._init_kvstore()
+        is_async = self._kvstore is not None and \
+            getattr(self._kvstore, "_is_async", False)
         self._optimizer.rescale_grad = self._scale / batch_size
         for i, p in enumerate(self._params):
             if p._grad is None:
@@ -58,11 +72,30 @@ class Trainer:
                         "or set grad_req" % p.name)
                 continue
             grad = p._grad
-            if self._kvstore is not None:
-                # dist: all-reduce the gradient, then update worker-side
+            if self._kvstore is not None and not is_async:
+                # dist sync: all-reduce the gradient, then update
+                # worker-side (async updates are local — the push/pull
+                # round-trip would be a no-op copy)
                 self._kvstore.push(i, grad, priority=-i)
                 self._kvstore.pull(i, grad, priority=-i)
             self._updater(i, grad, p.data())
+        if is_async:
+            # dist_async: count this local update; a parameter-averaging
+            # round fires every MXNET_ASYNC_SYNC_PERIOD updates.  Gluon
+            # has no epoch loop to hook, so ALSO call sync_params() at
+            # your epoch boundaries (docs/distributed.md).
+            self._kvstore._async_tick(self._async_arrays)
+
+    def sync_params(self):
+        """dist_async parameter-averaging round across hosts (the
+        epoch-boundary sync Module runs automatically; gluon training
+        loops call this themselves).  No-op for sync kvstores and
+        single-process runs."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and \
+                getattr(self._kvstore, "_is_async", False):
+            self._kvstore.sync_params(self._async_arrays())
 
     def save_states(self, fname):
         with open(fname, "wb") as f:

@@ -308,13 +308,14 @@ class KVStore:
     def _async_tick(self, arrays):
         """Count one local update; run an averaging round every
         ``MXNET_ASYNC_SYNC_PERIOD`` updates (0 = epoch-end rounds only,
-        driven by the trainer)."""
+        driven by the trainer).  ``arrays`` may be a callable returning
+        the list, so callers skip building it when no round fires."""
         if not self._is_async:
             return
         self._async_steps += 1
         if self._async_period > 0 and \
                 self._async_steps % self._async_period == 0:
-            self.sync_params(arrays)
+            self.sync_params(arrays() if callable(arrays) else arrays)
 
     # -- barriers / control --------------------------------------------
     def barrier(self):

@@ -593,7 +593,7 @@ class Module(BaseModule):
     def _async_tick(self):
         kv = self._kvstore
         if kv is not None and getattr(kv, "_is_async", False):
-            kv._async_tick(self._async_params())
+            kv._async_tick(self._async_params)
 
     def _epoch_end_sync(self):
         """dist_async: epoch-boundary parameter-averaging round (the

@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main():
     coordinator, num_procs, rank, outdir = sys.argv[1:5]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "module"
     num_procs, rank = int(num_procs), int(rank)
 
     import jax
@@ -49,6 +50,8 @@ def main():
     fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
     net = mx.sym.SoftmaxOutput(fc2, name="softmax")
 
+    if mode == "gluon":
+        return gluon_main(X, y, rank, outdir)
     mod = mx.mod.Module(net, context=mx.cpu())
     metric = mx.metric.Accuracy()
     mod.fit(it, num_epoch=8, kvstore="dist_async", optimizer="sgd",
@@ -67,6 +70,58 @@ def main():
                    "accuracy": float(acc)}, f)
     print("ASYNC WORKER %d DONE updates=%d acc=%.3f"
           % (rank, mod._optimizer.num_update, acc))
+
+
+
+
+def gluon_main(X, y, rank, outdir):
+    """Gluon face of dist_async: Trainer local steps + explicit
+    sync_params() rounds at epoch boundaries."""
+    import json
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="dist_async")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    dataset = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(dataset, batch_size=8, shuffle=True)
+    n_updates = 0
+    net(mx.nd.array(X[:1]))        # materialize deferred shapes
+    trainer.sync_params()          # also triggers kv init + the
+                                   # automatic common-start round
+    for _ in range(8):
+        for data, label in loader:
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            n_updates += 1
+        trainer.sync_params()      # epoch-boundary averaging round
+    correct = n = 0
+    for data, label in loader:
+        out = net(data)
+        correct += int((out.asnumpy().argmax(axis=1)
+                        == label.asnumpy()).sum())
+        n += data.shape[0]
+    params = {k: v.data().asnumpy()
+              for k, v in net.collect_params().items()}
+    np.savez(os.path.join(outdir, "async_params_rank%d.npz" % rank),
+             **params)
+    with open(os.path.join(outdir,
+                           "async_result_rank%d.json" % rank), "w") as f:
+        json.dump({"num_update": n_updates,
+                   "accuracy": correct / n}, f)
+    print("ASYNC GLUON WORKER %d DONE updates=%d acc=%.3f"
+          % (rank, n_updates, correct / n))
 
 
 if __name__ == "__main__":
