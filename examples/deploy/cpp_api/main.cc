@@ -126,6 +126,7 @@ int main() {
       std::vector<float> host(total);
       Check(MXNDArraySyncCopyToCPU(outs[0], host.data(), host.size()));
       arg_arrays[kv.first].SyncCopyFromCPU(host);
+      for (int oi = 0; oi < n_out; ++oi) Check(MXNDArrayFree(outs[oi]));
     }
   }
 

@@ -60,10 +60,14 @@ int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
                                 const char **name);
 /* invoke one op imperatively (reference MXImperativeInvoke,
- * src/c_api/c_api_ndarray.cc:548).  *num_outputs must be 0 on entry;
- * *outputs receives a library-owned array valid until the next invoke
- * on the same thread.  Param values are parsed as Python literals
- * (ints/floats/tuples/bools), falling back to strings. */
+ * src/c_api/c_api_ndarray.cc:548).  Two modes, matching the reference:
+ * with *outputs == NULL on entry, *outputs receives a pointer array
+ * (valid until the next invoke on the same thread) whose NDArrayHandle
+ * elements are OWNED BY THE CALLER — free each with MXNDArrayFree.
+ * With *outputs non-NULL and *num_outputs > 0, results are copied into
+ * the caller-provided arrays in place (caller retains ownership).
+ * Param values are parsed as Python literals (ints/floats/tuples/
+ * bools), falling back to strings. */
 int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle *inputs, int *num_outputs,
                        NDArrayHandle **outputs, int num_params,

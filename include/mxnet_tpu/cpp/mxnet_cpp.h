@@ -27,9 +27,10 @@ inline void Check(int rc) {
 class NDArray {
  public:
   NDArray() = default;
-  // own=false wraps a library-owned handle (e.g. MXImperativeInvoke
-  // outputs, which the library recycles on the next invoke) without
-  // freeing it — owning such a handle would double-free
+  // own=false wraps a library-owned handle (e.g. MXExecutorOutputs
+  // arrays, whose lifetime is the executor's) without freeing it —
+  // owning such a handle would double-free.  MXImperativeInvoke output
+  // handles are caller-owned (reference contract) and take own=true.
   explicit NDArray(NDArrayHandle h, bool own = true)
       : h_(h, own ? Deleter : NoopDeleter) {}
   NDArray(const std::vector<mx_uint> &shape, int dtype = 0) {

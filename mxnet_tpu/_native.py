@@ -57,8 +57,14 @@ def _load(name):
         lib = None
         try:
             if os.path.exists(src):
+                # stale if older than the source OR any src/*.h it may
+                # include (embed_common.h is shared by the ABI libs)
+                deps = [src] + [os.path.join(_SRC_DIR, f)
+                                for f in os.listdir(_SRC_DIR)
+                                if f.endswith(".h")]
                 if not os.path.exists(so) or \
-                        os.path.getmtime(so) < os.path.getmtime(src):
+                        os.path.getmtime(so) < max(
+                            os.path.getmtime(d) for d in deps):
                     os.makedirs(_BUILD_DIR, exist_ok=True)
                     cflags, ldflags = ([], [])
                     if name in _EXTRA_FLAGS:
@@ -107,7 +113,10 @@ def pack_recordio(list_path, root, rec_path, idx_path, nthreads=4):
                 -1: "cannot open list file",
                 -2: "unreadable image file",
                 -3: "cannot open output",
-                -4: "output write failed (disk full?)"}.get(n, "?")))
+                -4: "output write failed (disk full?)",
+                -5: "image payload exceeds the 2^29-1 byte frame "
+                    "limit (length field reserves top 3 bits for "
+                    "cflag)"}.get(n, "?")))
     return int(n)
 
 

@@ -17,7 +17,33 @@ import logging
 from .base import MXNetError
 from .context import cpu
 
-__all__ = ["_split_input_slice", "DataParallelExecutorManager"]
+__all__ = ["_split_input_slice", "DataParallelExecutorManager",
+           "pair_metric_outputs"]
+
+
+def pair_metric_outputs(symbol, label_names, labels, outputs):
+    """Pair metric labels with prediction heads when the symbol carries
+    extra loss-only outputs (MakeLoss aux terms, e.g. a MoE load-balance
+    loss).  Matching is by exact head name (``stem + '_output'``), never
+    by prefix — ``softmax`` must not capture ``softmax2`` — and the
+    positional fallback skips loss-only heads."""
+    if len(outputs) <= len(labels):
+        return outputs
+    names = symbol.list_outputs()
+    loss_only = set(getattr(symbol, "_makeloss_outputs", lambda: [])())
+    pred_outputs = [o for n, o in zip(names, outputs) if n not in loss_only]
+    picked = []
+    for i, ln in enumerate(label_names[:len(labels)]):
+        stem = ln[:-6] if ln.endswith("_label") else ln
+        match = [o for n, o in zip(names, outputs)
+                 if n == stem + "_output" or n == stem]
+        if match:
+            picked.append(match[0])
+        elif i < len(pred_outputs):
+            picked.append(pred_outputs[i])
+        else:
+            picked.append(outputs[i])
+    return picked
 
 
 def _split_input_slice(batch_size, work_load_list=None):
@@ -141,7 +167,8 @@ class DataParallelExecutorManager:
     def update_metric(self, metric, labels):
         for ex, slc in zip(self.execs, self.slices):
             lab = [l[slc.start:slc.stop] for l in labels]
-            metric.update(lab, ex.outputs)
+            metric.update(lab, pair_metric_outputs(
+                self.symbol, self._label_names, lab, ex.outputs))
 
     @property
     def outputs(self):

@@ -611,21 +611,10 @@ class Module(BaseModule):
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
-        outputs = self._exec.outputs
-        if len(outputs) > len(labels):
-            # extra loss-only heads (MakeLoss aux terms, e.g. the MoE
-            # load-balance loss) train but are not predictions: pair
-            # each label with its like-named output (softmax_label ->
-            # softmax_output), falling back to position
-            names = self._symbol.list_outputs()
-            picked = []
-            for i, ln in enumerate(self._label_names[:len(labels)]):
-                stem = ln[:-6] if ln.endswith("_label") else ln
-                match = [o for n, o in zip(names, outputs)
-                         if n.startswith(stem)]
-                picked.append(match[0] if match else outputs[i])
-            outputs = picked
-        eval_metric.update(labels, outputs)
+        from ..executor_manager import pair_metric_outputs
+
+        eval_metric.update(labels, pair_metric_outputs(
+            self._symbol, self._label_names, labels, self._exec.outputs))
 
     def install_monitor(self, monitor):
         assert self.binded

@@ -88,6 +88,17 @@ class Symbol:
                 out.append("%s_output%d" % (node.name, idx))
         return out
 
+    def _makeloss_outputs(self):
+        """Output names produced by ``MakeLoss``/``make_loss`` heads —
+        loss-only terms a metric must never score as predictions
+        (reference ``src/operator/make_loss.cc`` semantics)."""
+        out = []
+        for name, (node, _idx) in zip(self.list_outputs(), self._outputs):
+            if (not node.is_variable
+                    and node.op.name in ("make_loss", "MakeLoss")):
+                out.append(name)
+        return out
+
     def _topo(self):
         """Topological order of all nodes reachable from the outputs."""
         seen, order = set(), []

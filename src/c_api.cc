@@ -454,9 +454,13 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
   int ret = -1;
   PyObject *mod = nullptr, *fn = nullptr, *args = nullptr, *kw = nullptr,
            *res = nullptr;
-  // library-owned per-thread output handle storage (reference contract:
-  // valid until the next invoke)
+  // Pointer-array storage only: the NDArrayHandle* array stays valid until
+  // the next invoke on this thread (matching the reference's reused
+  // ret_handles vector), but ownership of each handle transfers to the
+  // caller, who frees it with MXNDArrayFree — same contract as
+  // src/c_api/c_api_ndarray.cc in the reference.
   static thread_local std::vector<NDArrayHandle> out_store;
+  const bool caller_outputs = (*outputs != nullptr && *num_outputs > 0);
   do {
     mod = PyImport_ImportModule("mxnet_tpu.ndarray");
     if (!mod) break;
@@ -472,8 +476,36 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
     if (!kw) break;
     res = PyObject_Call(fn, args, kw);
     if (!res) break;
-    for (NDArrayHandle h : out_store) MXNDArrayFree(h);
-    out_store.clear();
+    if (caller_outputs) {
+      // reference write-into-provided-outputs path: copy each result into
+      // the caller's arrays in place; caller retains ownership throughout
+      PyObject *seq = (PyTuple_Check(res) || PyList_Check(res))
+                          ? (Py_INCREF(res), res)
+                          : PyTuple_Pack(1, res);
+      if (!seq) break;
+      Py_ssize_t n = PySequence_Size(seq);
+      bool copy_ok = (n == *num_outputs);
+      if (!copy_ok) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError,
+                        "MXImperativeInvoke: op output count does not match "
+                        "provided outputs");
+        break;
+      }
+      for (Py_ssize_t i = 0; i < n && copy_ok; ++i) {
+        PyObject *o = PySequence_GetItem(seq, i);  // new ref
+        PyObject *dst = static_cast<NDHandle *>((*outputs)[i])->obj;
+        PyObject *r = o ? PyObject_CallMethod(o, "copyto", "O", dst) : nullptr;
+        copy_ok = (r != nullptr);
+        Py_XDECREF(r);
+        Py_XDECREF(o);
+      }
+      Py_DECREF(seq);
+      if (!copy_ok) break;
+      ret = 0;
+      break;
+    }
+    out_store.clear();  // pointers only; handles were caller-owned
     if (PyTuple_Check(res) || PyList_Check(res)) {
       Py_ssize_t n = PySequence_Size(res);
       for (Py_ssize_t i = 0; i < n; ++i) {

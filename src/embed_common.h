@@ -38,17 +38,32 @@ static bool ensure_python() {
     we_initialized = true;
   }
   PyGILState_STATE g = PyGILState_Ensure();
+  // Insert the package root into sys.path through the C API — never by
+  // interpolating the path into Python source, where quotes/backslashes
+  // in the path would break parsing or execute unintended code.
+  int rc = -1;
   const char *home = std::getenv("MXNET_TPU_HOME");
-  std::string code = "import sys, os\n";
+  PyObject *p = nullptr;
   if (home) {
-    code += std::string("p = r'''") + home + "'''\n";
+    p = PyUnicode_DecodeFSDefault(home);
   } else {
-    code += "p = os.getcwd()\n";
+    PyObject *os = PyImport_ImportModule("os");
+    if (os) {
+      p = PyObject_CallMethod(os, "getcwd", nullptr);
+      Py_DECREF(os);
+    }
   }
-  code +=
-      "if p not in sys.path:\n"
-      "    sys.path.insert(0, p)\n";
-  int rc = PyRun_SimpleString(code.c_str());
+  PyObject *path = PySys_GetObject("path");  // borrowed
+  if (p && path && PyList_Check(path)) {
+    int present = PySequence_Contains(path, p);
+    if (present == 0) {
+      rc = PyList_Insert(path, 0, p);
+    } else if (present == 1) {
+      rc = 0;
+    }
+  }
+  Py_XDECREF(p);
+  if (rc != 0) PyErr_Clear();
   PyGILState_Release(g);
   if (we_initialized) {
     // Py_InitializeEx leaves the calling thread owning the GIL; detach

@@ -180,6 +180,13 @@ long i2r_pack(const char *list_path, const char *root,
         break;
       }
       const std::vector<char> &pl = entries[i].payload;
+      // frame format packs cflag<<29 | length into one u32: payloads at
+      // or above 2^29 bytes would silently corrupt the header
+      if (pl.size() >= (1u << 29)) {
+        written = -5;  // payload too large for the record frame format
+        failed.store(true, std::memory_order_release);
+        break;
+      }
       uint32_t len = static_cast<uint32_t>(pl.size());
       uint32_t pad = (4 - (len % 4)) % 4;
       bool io_ok =
