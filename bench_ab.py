@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Independent A/B: the framework's fused ResNet-50 train step vs a
+hand-rolled RAW-JAX implementation of the same step, on the same chip.
+
+The raw side imports NOTHING from mxnet_tpu: its own pre-activation
+ResNet-50 (same architecture as ``models/resnet.py`` — v2 bottleneck,
+NCHW), its own BatchNorm (fp32 stats over bf16 activations), its own
+SGD-momentum update (fp32 masters, bf16 compute casts, grad rescale
+1/batch), its own jit with donated buffers.  If the framework step is
+slower than this raw step by more than the noise floor, the gap is
+framework overhead; if they tie, the framework's throughput ceiling is
+the hardware/XLA roofline, not the framework.
+
+Prints ONE JSON line: {"raw_img_s", "framework_img_s", "ratio", ...}.
+
+Usage: bench_ab.py [batch] [--iters N] [--raw-only|--framework-only]
+"""
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+# ---------------------------------------------------------------------------
+# raw-JAX ResNet-50 (pre-activation v2, NCHW) — no mxnet_tpu imports
+# ---------------------------------------------------------------------------
+
+def _raw_modules():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    DIMNUMS = lax.conv_dimension_numbers(
+        (1, 1, 1, 1), (1, 1, 1, 1), ("NCHW", "OIHW", "NCHW"))
+
+    def conv(x, w, stride=1, pad=0):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride),
+            padding=((pad, pad), (pad, pad)), dimension_numbers=DIMNUMS)
+
+    def bn_train(x, gamma, beta, eps=2e-5, fix_gamma=False):
+        # mirror of the framework's bf16 BN: fp32 batch stats via
+        # E[x^2]-E[x]^2, bf16 scale/shift application
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        mean = jnp.mean(x, axis=(0, 2, 3), dtype=jnp.float32)
+        mean_sq = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(0, 2, 3))
+        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+        bshape = (1, x.shape[1], 1, 1)
+        g32 = g.astype(jnp.float32).reshape(bshape)
+        inv = lax.rsqrt(var + eps).reshape(bshape)
+        scale = (inv * g32).astype(x.dtype)
+        shift = (beta.astype(jnp.float32).reshape(bshape)
+                 - mean.reshape(bshape) * inv * g32).astype(x.dtype)
+        return x * scale + shift, mean, var
+
+    def maxpool(x, k=3, s=2, p=1):
+        import numpy as np
+
+        # init must be a host constant: a traced init breaks
+        # reduce_window's linearization rule under jit(grad(...))
+        return lax.reduce_window(
+            x, np.array(-np.inf, x.dtype), lax.max,
+            (1, 1, k, k), (1, 1, s, s),
+            ((0, 0), (0, 0), (p, p), (p, p)))
+
+    return conv, bn_train, maxpool
+
+
+RESNET50_UNITS = [3, 4, 6, 3]
+RESNET50_FILTERS = [64, 256, 512, 1024, 2048]
+
+
+def raw_init(rng, num_classes=1000):
+    """fp32 master parameters + BN aux stats for raw ResNet-50."""
+    import jax
+    import jax.numpy as jnp
+
+    params, aux = {}, {}
+    keys = iter(jax.random.split(rng, 256))
+
+    def add_conv(name, cin, cout, k):
+        fan_in = cin * k * k
+        params[name + "_weight"] = jax.random.normal(
+            next(keys), (cout, cin, k, k), "float32") * (2.0 / fan_in) ** 0.5
+
+    def add_bn(name, c):
+        params[name + "_gamma"] = jnp.ones((c,), "float32")
+        params[name + "_beta"] = jnp.zeros((c,), "float32")
+        aux[name + "_moving_mean"] = jnp.zeros((c,), "float32")
+        aux[name + "_moving_var"] = jnp.ones((c,), "float32")
+
+    add_bn("bn_data", 3)
+    add_conv("conv0", 3, 64, 7)
+    add_bn("bn0", 64)
+    cin = 64
+    for i, (n_units, filt) in enumerate(zip(RESNET50_UNITS,
+                                            RESNET50_FILTERS[1:])):
+        for j in range(n_units):
+            name = "stage%d_unit%d" % (i + 1, j + 1)
+            add_bn(name + "_bn1", cin)
+            add_conv(name + "_conv1", cin, filt // 4, 1)
+            add_bn(name + "_bn2", filt // 4)
+            add_conv(name + "_conv2", filt // 4, filt // 4, 3)
+            add_bn(name + "_bn3", filt // 4)
+            add_conv(name + "_conv3", filt // 4, filt, 1)
+            if j == 0:
+                add_conv(name + "_sc", cin, filt, 1)
+            cin = filt
+    add_bn("bn1", cin)
+    import jax.random as jrandom
+    params["fc1_weight"] = jrandom.normal(
+        next(keys), (num_classes, cin), "float32") * (1.0 / cin) ** 0.5
+    params["fc1_bias"] = jnp.zeros((num_classes,), "float32")
+    return params, aux
+
+
+def raw_forward(p, x):
+    """bf16 forward; returns (logits, new_bn_stats {name: (mean, var)})."""
+    import jax.numpy as jnp
+
+    conv, bn_train, maxpool = _raw_modules()
+    stats = {}
+
+    def bn(name, h, fix_gamma=False):
+        out, mean, var = bn_train(h, p[name + "_gamma"], p[name + "_beta"],
+                                  fix_gamma=fix_gamma)
+        stats[name] = (mean, var)
+        return out
+
+    h = bn("bn_data", x, fix_gamma=True)
+    h = conv(h, p["conv0_weight"], stride=2, pad=3)
+    h = jnp.maximum(bn("bn0", h), 0)
+    h = maxpool(h)
+    cin = 64
+    for i, (n_units, filt) in enumerate(zip(RESNET50_UNITS,
+                                            RESNET50_FILTERS[1:])):
+        for j in range(n_units):
+            name = "stage%d_unit%d" % (i + 1, j + 1)
+            stride = 1 if (i == 0 or j > 0) else 2
+            a1 = jnp.maximum(bn(name + "_bn1", h), 0)
+            b = conv(a1, p[name + "_conv1_weight"])
+            b = jnp.maximum(bn(name + "_bn2", b), 0)
+            b = conv(b, p[name + "_conv2_weight"], stride=stride, pad=1)
+            b = jnp.maximum(bn(name + "_bn3", b), 0)
+            b = conv(b, p[name + "_conv3_weight"])
+            sc = h if j > 0 else conv(a1, p[name + "_sc_weight"],
+                                      stride=stride)
+            h = b + sc
+            cin = filt
+    h = jnp.maximum(bn("bn1", h), 0)
+    h = jnp.mean(h, axis=(2, 3))  # global average pool
+    logits = h @ p["fc1_weight"].T + p["fc1_bias"]
+    return logits, stats
+
+
+def make_raw_step(batch, momentum=0.9, bn_momentum=0.9):
+    """jitted fused train step: fwd+bwd+SGD-momentum+BN-stat update,
+    donated fp32 masters, bf16 compute — the raw mirror of
+    ``mxnet_tpu.fused.TrainStep``."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(params, aux, mom, x, y, lr):
+        def loss_fn(p):
+            pc = {k: v.astype(jnp.bfloat16) for k, v in p.items()}
+            logits, stats = raw_forward(pc, x.astype(jnp.bfloat16))
+            logits32 = logits.astype(jnp.float32)
+            logz = jax.nn.log_softmax(logits32, axis=-1)
+            ce = -jnp.sum(jnp.take_along_axis(
+                logz, y[:, None].astype(jnp.int32), axis=-1))
+            return ce, stats
+
+        grads, stats = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_mom = {}, {}
+        fixed = {"bn_data_gamma"}  # fix_gamma head: pinned to 1
+        for k in params:
+            if k in fixed:
+                new_params[k] = params[k]
+                new_mom[k] = mom[k]
+                continue
+            g = grads[k] * (1.0 / batch)
+            m = momentum * mom[k] - lr * g
+            new_params[k] = params[k] + m
+            new_mom[k] = m
+        new_aux = {}
+        for name, (mean, var) in stats.items():
+            new_aux[name + "_moving_mean"] = (
+                bn_momentum * aux[name + "_moving_mean"]
+                + (1 - bn_momentum) * mean)
+            new_aux[name + "_moving_var"] = (
+                bn_momentum * aux[name + "_moving_var"]
+                + (1 - bn_momentum) * var)
+        return new_params, new_aux, new_mom
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def measure_raw(batch, iters=20):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = jax.random.PRNGKey(0)
+    params, aux = raw_init(rng)
+    mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+    x = jax.random.normal(rng, (batch, 3, 224, 224), "float32")
+    y = jnp.zeros((batch,), "float32")
+    step = make_raw_step(batch)
+    params, aux, mom = step(params, aux, mom, x, y, 0.1)
+    float(np.asarray(params["fc1_bias"][0]))  # force completion
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, aux, mom = step(params, aux, mom, x, y, 0.1)
+    float(np.asarray(params["fc1_bias"][0]))
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def measure_framework(batch, iters=20):
+    import bench
+
+    from mxnet_tpu.models import resnet
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224), layout="NCHW")
+    img_s, _ = bench._bench_model(sym, batch, "bfloat16", iters=iters)
+    return img_s
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    batch = int(args[0]) if args else 512
+    iters = 20
+    if "--iters" in sys.argv:
+        iters = int(sys.argv[sys.argv.index("--iters") + 1])
+
+    result = {"metric": "resnet50_ab_raw_vs_framework", "batch_size": batch,
+              "unit": "img/s"}
+    if "--framework-only" not in sys.argv:
+        result["raw_img_s"] = round(measure_raw(batch, iters), 2)
+    if "--raw-only" not in sys.argv:
+        result["framework_img_s"] = round(measure_framework(batch, iters), 2)
+    if "raw_img_s" in result and "framework_img_s" in result:
+        result["ratio_framework_over_raw"] = round(
+            result["framework_img_s"] / result["raw_img_s"], 4)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,15 +17,20 @@ from .. import symbol as sym
 
 def transformer_block(x, idx, d_model, num_heads, d_ff,
                       seq_parallel=False, moe_experts=0, moe_top_k=2,
-                      expert_parallel=False, moe_capacity_factor=1.25):
-    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)).
+                      expert_parallel=False, moe_capacity_factor=1.25,
+                      dropout=0.0):
+    """Pre-norm block: x + Drop(MHA(LN(x))); x + Drop(MLP(LN(x))).
 
     With ``moe_experts > 0`` the MLP is a top-k routed
-    mixture-of-experts (``MoE`` op); returns ``(x, aux_loss_sym)``."""
+    mixture-of-experts (``MoE`` op); returns ``(x, aux_loss_sym)``.
+    ``dropout`` applies residual dropout after the attention and MLP
+    sublayers (the GPT placement)."""
     h = sym.LayerNorm(x, name="blk%d_ln1" % idx)
     h = sym.MultiHeadAttention(h, num_heads=num_heads, causal=True,
                                seq_parallel=seq_parallel,
                                name="blk%d_attn" % idx)
+    if dropout:
+        h = sym.Dropout(h, p=dropout, name="blk%d_drop1" % idx)
     x = x + h
     h = sym.LayerNorm(x, name="blk%d_ln2" % idx)
     aux = None
@@ -41,6 +46,8 @@ def transformer_block(x, idx, d_model, num_heads, d_ff,
         h = sym.Activation(h, act_type="gelu", name="blk%d_gelu" % idx)
         h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
                                name="blk%d_ffn2" % idx)
+    if dropout:
+        h = sym.Dropout(h, p=dropout, name="blk%d_drop2" % idx)
     return x + h, aux
 
 
@@ -48,7 +55,7 @@ def get_symbol(vocab_size=32000, num_layers=12, d_model=768, num_heads=12,
                d_ff=None, seq_len=1024, seq_parallel=False,
                moe_experts=0, moe_top_k=2, moe_aux_coef=0.01,
                expert_parallel=False, moe_capacity_factor=1.25,
-               **kwargs):
+               dropout=0.0, **kwargs):
     """``seq_parallel=True`` runs every attention via ring attention over
     the active mesh's 'seq' axis (long-context training: T shards over
     chips, K/V rotate on ICI).
@@ -79,7 +86,8 @@ def get_symbol(vocab_size=32000, num_layers=12, d_model=768, num_heads=12,
                                    moe_experts=moe_experts,
                                    moe_top_k=moe_top_k,
                                    expert_parallel=expert_parallel,
-                                   moe_capacity_factor=moe_capacity_factor)
+                                   moe_capacity_factor=moe_capacity_factor,
+                                   dropout=dropout)
         if aux is not None:
             aux_total = aux if aux_total is None else aux_total + aux
             n_aux += 1

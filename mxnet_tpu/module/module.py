@@ -186,8 +186,12 @@ class Module(BaseModule):
             if isinstance(fused, PipelineTrainStep):
                 if getattr(self, "_pipeline_stale", False):
                     self._fused_states = fused.unpack_states()
+                # newly set params/aux win over the packed buffers (the
+                # same stance as arg_dict: external writes are honored,
+                # the next step repacks all three)
                 fused._packed_params = None
                 fused._packed_states = None
+                fused._packed_aux = None
                 self._pipeline_stale = False
 
     def _sync_pipeline(self):
@@ -200,6 +204,8 @@ class Module(BaseModule):
         live = self._fused.unpack_params()
         for n, v in live.items():
             self._exec.arg_dict[n]._set_data(jnp.asarray(v))
+        for n, v in self._fused.unpack_aux().items():
+            self._exec.aux_dict[n]._set_data(jnp.asarray(v))
         self._fused_states = self._fused.unpack_states()
         self._pipeline_stale = False
 

@@ -17,9 +17,13 @@ microbatching.  This module is the fresh TPU-first design, in two tiers:
   holds only its stage's weights + optimizer state), and a choice of
   schedules:
 
-  - ``schedule='gpipe'`` — all-forward wave, backward by reverse-mode
-    autodiff through the scan (activation stash grows with the
-    microbatch count M — the GPipe memory profile);
+  - ``schedule='gpipe'`` — all-forward wave stashing every stage
+    input (M slots — the GPipe memory profile), then an explicit
+    validity-gated all-backward wave that recomputes each stage
+    forward under ``jax.vjp`` (gating matters: differentiating the
+    whole forward scan would let the loss heads' custom vjp — which
+    ignores its cotangent per the reference contract — emit junk
+    gradients for the fill/drain ticks);
   - ``schedule='1f1b'`` — interleaved one-forward-one-backward: each
     stage keeps a bounded ring of at most ``2S`` stage-input
     activations and **recomputes** the stage forward during its
@@ -443,19 +447,32 @@ class PipelineTrainStep:
     ``schedule='1f1b'`` interleaves one-forward-one-backward with a
     bounded activation ring (stage inputs only; the stage forward is
     recomputed during its backward — remat); ``'gpipe'`` runs the
-    all-forward wave and lets autodiff produce the reverse wave
-    (activation stash grows with M).
+    all-forward wave over an M-slot stage-input stash, then an
+    explicit validity-gated backward wave (same recompute strategy,
+    O(M) stash instead of the O(S) ring).
 
     Call contract mirrors ``fused.TrainStep``:
     ``(params, aux, states, batch, rng, lr, t) -> (params, aux, states,
-    outs)`` — but params/states live INTERNALLY as packed stage-sharded
-    buffers between steps; the dicts handed back are the same handles
-    passed in (stale), and :meth:`unpack_params` gathers the live
-    values for checkpointing/eval (``Module`` syncs lazily through it).
+    outs)`` — but params/aux/states live INTERNALLY as packed
+    stage-sharded buffers between steps; the dicts handed back are the
+    same handles passed in (stale), and :meth:`unpack_params` /
+    :meth:`unpack_aux` gather the live values for checkpointing/eval
+    (``Module`` syncs lazily through them).
 
-    Not supported in v1 (raises): symbols with auxiliary states
-    (BatchNorm moving stats) or rng-consuming ops (Dropout) inside the
-    pipelined graph.
+    Aux states (BatchNorm moving stats) thread through the schedule as a
+    third stage-sharded packed buffer: each stage blends its own BN
+    stats once per valid microbatch tick, so after one step the moving
+    stats equal the sequential microbatch-loop semantics
+    (``new = mom^M * old + (1-mom) * sum_m mom^(M-1-m) * stat_m``) —
+    training-mode BN *reads* batch stats, never the aux buffer, so the
+    1F1B recompute stays consistent no matter when the backward tick
+    lands (reference aux-state semantics:
+    ``src/operator/batch_norm.cc`` FMutateInputs).
+
+    Rng ops (Dropout) draw a per-(stage, microbatch) key
+    ``fold_in(fold_in(step_rng, m), k)``: the 1F1B backward recompute
+    re-derives the same key from its tick index, so the recomputed
+    dropout mask is bit-identical to the forward's.
     """
 
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
@@ -491,25 +508,12 @@ class PipelineTrainStep:
         self.optimizer = optimizer
         self.lr = optimizer.lr
 
-        # symbol-level guards run eagerly; the split itself is deferred
-        # to the first batch (_build) where input shapes make the
-        # boundary signatures shape-aware
+        # the split itself is deferred to the first batch (_build) where
+        # input shapes make the boundary signatures shape-aware
         feed_set = set(self.data_names) | set(self.label_names)
-        for n in symbol._topo():
-            if n.is_variable:
-                continue
-            if n.op.needs_rng:
-                raise MXNetError(
-                    "pipeline v1 cannot schedule rng ops (%s); remove "
-                    "Dropout or use the fused non-pipelined step"
-                    % n.op.name)
-        if symbol.list_auxiliary_states():
-            raise MXNetError(
-                "pipeline v1 cannot thread aux states (%s); BatchNorm "
-                "moving stats are unsupported under the pipeline "
-                "schedule" % symbol.list_auxiliary_states())
         self.param_names = [a for a in symbol.list_arguments()
                             if a not in feed_set]
+        self.aux_names = list(symbol.list_auxiliary_states())
         self._frozen = frozenset(fixed_param_names)
 
         # default grad scale: per-microbatch losses sum over M; 'batch'-
@@ -536,6 +540,7 @@ class PipelineTrainStep:
         self._built = None      # lazy: needs concrete batch shapes
         self._packed_params = None
         self._packed_states = None
+        self._packed_aux = None
         self._t = 0
 
     # -- layout build (first call) ---------------------------------------
@@ -565,14 +570,16 @@ class PipelineTrainStep:
         self._stage_fns = []
         self._stage_args = []
         self._stage_param_names = []
+        self._stage_aux_names = []
         feed_set = set(self.data_names) | set(self.label_names)
         for k, ssym in enumerate(self._stage_syms):
-            fn, args, _auxn = _trace_fn(ssym, is_train=True)
+            fn, args, auxn = _trace_fn(ssym, is_train=True)
             self._stage_fns.append(fn)
             self._stage_args.append(args)
             self._stage_param_names.append(
                 [a for a in args if a not in feed_set
                  and not a.startswith("pipe_in")])
+            self._stage_aux_names.append(list(auxn))
 
         pshapes = _infer_param_shapes(self.symbol, dict(full_shapes))
         # microbatch-sized shape inference for the boundary templates
@@ -592,6 +599,17 @@ class PipelineTrainStep:
                 for n in tpl})
         self._state_packers = [_Packer(t) for t in state_tpls]
         self._ls = max(max(p.total for p in self._state_packers), 1)
+
+        # per-stage aux states (BatchNorm moving stats) pack into a
+        # third stage-sharded buffer; fp32, like the Module aux dicts
+        aux_tpls = []
+        for auxn in self._stage_aux_names:
+            aux_tpls.append({n: jax.ShapeDtypeStruct(pshapes[n],
+                                                     np.float32)
+                             for n in auxn})
+        self._aux_packers = [_Packer(t) for t in aux_tpls]
+        self._la = max(max(p.total for p in self._aux_packers), 1)
+        self._aux_tpls = aux_tpls
 
         # chain eval_shape through stages for boundary templates + the
         # canonical (shape-sorted) slot permutation per boundary
@@ -613,7 +631,8 @@ class PipelineTrainStep:
                 else:
                     argspec[a] = param_tpls[k][a]
             outs, _ = jax.eval_shape(
-                lambda ar: fn(ar, {}, jax.random.PRNGKey(0)), argspec)
+                lambda ar, ax: fn(ar, ax, jax.random.PRNGKey(0)),
+                argspec, aux_tpls[k])
             cur = list(outs)
             if k < S - 1:
                 order = sorted(
@@ -670,17 +689,23 @@ class PipelineTrainStep:
         def zeros_emit():
             return tuple(jnp.zeros(t.shape, t.dtype) for t in out_tpl)
 
+        la = self._la
+
         def stage_fwd(k):
-            """fwd branch for stage k: (p_row, carry, feed) ->
-            (carry_out, emits)."""
+            """fwd branch for stage k: (p_row, a_row, carry, feed, key)
+            -> (carry_out, emits, new_a_row).  ``key`` is the
+            per-microbatch key; the per-stage fold keeps rng streams of
+            different stages independent."""
             fn = self._stage_fns[k]
             args_k = self._stage_args[k]
             packer = self._param_packers[k]
+            apacker = self._aux_packers[k]
             in_perm = self._boundary_perm[k - 1] if k > 0 else None
             out_perm = self._boundary_perm[k] if k < S - 1 else None
 
-            def branch(p_row, carry, feed):
+            def branch(p_row, a_row, carry, feed, key):
                 params = packer.unpack(p_row[:packer.total])
+                aux = apacker.unpack(a_row[:apacker.total])
                 ar = {}
                 for a in args_k:
                     if a.startswith("pipe_in"):
@@ -692,25 +717,31 @@ class PipelineTrainStep:
                         ar[a] = lax.stop_gradient(feed[a])
                     else:
                         ar[a] = params[a]
-                outs, _ = fn(ar, {}, jax.random.PRNGKey(0))
+                outs, new_aux = fn(ar, aux, jax.random.fold_in(key, k))
                 outs = list(outs)
+                new_a_row = lax.stop_gradient(apacker.pack(new_aux, la))
                 if k < S - 1:
                     carry_out = tuple(outs[i] for i in out_perm)
-                    return carry_out, zeros_emit()
-                return zeros_carry(), tuple(outs)
+                    return carry_out, zeros_emit(), new_a_row
+                return zeros_carry(), tuple(outs), new_a_row
 
             return branch
 
         fwd_branches = [stage_fwd(k) for k in range(S)]
 
         def stage_bwd(k):
-            """bwd branch for stage k (recompute + vjp): (p_row, x,
-            feed, g_in) -> (g_p_row, g_carry_out)."""
+            """bwd branch for stage k (recompute + vjp): (p_row, a_row,
+            x, feed, g_in, key) -> (g_p_row, g_carry_out).  ``key`` is
+            re-derived from the backward tick's microbatch index, so
+            the recomputed rng ops (dropout masks) are bit-identical to
+            the forward's; training-mode BN reads batch stats only, so
+            the recompute is aux-timing independent."""
             branch_f = fwd_branches[k]
 
-            def branch(p_row, x, feed, g_in):
+            def branch(p_row, a_row, x, feed, g_in, key):
                 def f(pr, c):
-                    return branch_f(pr, c, feed)
+                    c_out, emits, _na = branch_f(pr, a_row, c, feed, key)
+                    return c_out, emits
 
                 (c_out, emits), vjp_fn = jax.vjp(f, p_row, x)
                 if k == S - 1:
@@ -757,9 +788,16 @@ class PipelineTrainStep:
             m = jnp.clip(m, 0, M - 1)
             return {k: v[m] for k, v in micro.items()}
 
-        def body_1f1b(pp, ps, micro, rng, lr, t):
+        def micro_key(rng, m):
+            # per-microbatch key; fwd and bwd recompute derive the SAME
+            # key from their own tick indices, keeping dropout masks
+            # bit-identical across the 1F1B recompute
+            return jax.random.fold_in(rng, jnp.clip(m, 0, M - 1))
+
+        def body_1f1b(pp, ps, pa, micro, rng, lr, t):
             p_row = pp[0]
             s_row = ps[0]
+            a_row = pa[0]
             sidx = lax.axis_index(axis)
             ring = tuple(jnp.zeros((R,) + tp.shape, tp.dtype)
                          for tp in carry_tpl)
@@ -770,12 +808,15 @@ class PipelineTrainStep:
             g_carry = zeros_carry()
 
             def tick(state, t_idx):
-                carry_f, g_carry, ring, grad_acc, outs_buf = state
+                carry_f, g_carry, ring, grad_acc, outs_buf, a_row = state
                 m_f = t_idx - sidx
                 valid_f = (m_f >= 0) & (m_f < M)
                 feed_f = feed_at(micro, m_f)
-                c_out, emits = lax.switch(sidx, fwd_branches, p_row,
-                                          carry_f, feed_f)
+                c_out, emits, a_new = lax.switch(
+                    sidx, fwd_branches, p_row, a_row, carry_f, feed_f,
+                    micro_key(rng, m_f))
+                # BN moving stats blend once per VALID microbatch tick
+                a_row = jnp.where(valid_f, a_new, a_row)
                 slot_f = jnp.mod(m_f, R)
                 ring = tuple(
                     lax.dynamic_update_index_in_dim(r, v, slot_f, 0)
@@ -799,86 +840,111 @@ class PipelineTrainStep:
                                                      keepdims=False)
                             for r in ring)
                 feed_b = feed_at(micro, m_b)
-                g_pr, g_c = lax.switch(sidx, bwd_branches, p_row, x_b,
-                                       feed_b, g_carry)
+                g_pr, g_c = lax.switch(sidx, bwd_branches, p_row, a_row,
+                                       x_b, feed_b, g_carry,
+                                       micro_key(rng, m_b))
                 grad_acc = grad_acc + jnp.where(valid_b, 1.0, 0.0) * g_pr
                 g_next = tuple(lax.ppermute(
                     jnp.where(valid_b, v, jnp.zeros_like(v)), axis,
                     perm_b) for v in g_c)
-                return (carry_next, g_next, ring, grad_acc, outs_buf), None
+                return (carry_next, g_next, ring, grad_acc, outs_buf,
+                        a_row), None
 
             ticks = jnp.arange(M + 2 * (S - 1))
-            (carry_f, g_carry, ring, grad_acc, outs_buf), _ = lax.scan(
-                tick, (carry_f, g_carry, ring, grad_acc, outs_buf),
-                ticks)
+            (carry_f, g_carry, ring, grad_acc, outs_buf, a_row), _ = \
+                lax.scan(tick, (carry_f, g_carry, ring, grad_acc,
+                                outs_buf, a_row), ticks)
 
             outs_rep = tuple(
                 lax.psum(jnp.where(sidx == S - 1, b, jnp.zeros_like(b)),
                          axis) for b in outs_buf)
             new_p_row, new_s_row = lax.switch(
                 sidx, upd_branches, p_row, s_row, grad_acc, lr, t, rng)
-            return new_p_row[None], new_s_row[None], outs_rep
+            return new_p_row[None], new_s_row[None], a_row[None], outs_rep
 
-        def body_gpipe(pp, ps, micro, rng, lr, t):
+        def body_gpipe(pp, ps, pa, micro, rng, lr, t):
+            # All-forward wave stashing every stage INPUT (M slots — the
+            # GPipe memory profile), then an explicit all-backward wave
+            # over the stash.  The backward is validity-GATED per tick:
+            # differentiating the whole forward scan instead would let
+            # the loss heads' custom vjp (which by the reference
+            # contract ignores its cotangent) emit junk gradients for
+            # the fill/drain ticks.
             p_row = pp[0]
             s_row = ps[0]
+            a_row = pa[0]
             sidx = lax.axis_index(axis)
+            stash = tuple(jnp.zeros((M,) + tp.shape, tp.dtype)
+                          for tp in carry_tpl)
+            outs_buf = tuple(jnp.zeros((M,) + tp.shape, tp.dtype)
+                             for tp in out_tpl)
+            carry_f = zeros_carry()
 
-            def fwd_all(p_row):
-                outs_buf = tuple(jnp.zeros((M,) + tp.shape, tp.dtype)
-                                 for tp in out_tpl)
-                carry_f = zeros_carry()
+            def tick_f(state, t_idx):
+                carry_f, stash, outs_buf, a_row = state
+                m_f = t_idx - sidx
+                valid_f = (m_f >= 0) & (m_f < M)
+                m_safe = jnp.clip(m_f, 0, M - 1)
+                feed_f = feed_at(micro, m_f)
+                c_out, emits, a_new = lax.switch(
+                    sidx, fwd_branches, p_row, a_row, carry_f,
+                    feed_f, micro_key(rng, m_f))
+                a_row = jnp.where(valid_f, a_new, a_row)
+                stash = tuple(
+                    lax.dynamic_update_index_in_dim(b, v, m_safe, 0)
+                    for b, v in zip(stash, carry_f))
+                emit_gate = valid_f & (sidx == S - 1)
+                outs_buf = tuple(
+                    lax.dynamic_update_index_in_dim(
+                        b, jnp.where(emit_gate, v,
+                                     lax.dynamic_index_in_dim(
+                                         b, m_safe, 0, keepdims=False)),
+                        m_safe, 0)
+                    for b, v in zip(outs_buf, emits))
+                carry_next = tuple(lax.ppermute(v, axis, perm_f)
+                                   for v in c_out)
+                return (carry_next, stash, outs_buf, a_row), None
 
-                def tick(state, t_idx):
-                    carry_f, outs_buf = state
-                    m_f = t_idx - sidx
-                    valid_f = (m_f >= 0) & (m_f < M)
-                    feed_f = feed_at(micro, m_f)
-                    c_out, emits = lax.switch(sidx, fwd_branches, p_row,
-                                              carry_f, feed_f)
-                    emit_gate = valid_f & (sidx == S - 1)
-                    m_safe = jnp.clip(m_f, 0, M - 1)
-                    outs_buf = tuple(
-                        lax.dynamic_update_index_in_dim(
-                            b, jnp.where(emit_gate, v,
-                                         lax.dynamic_index_in_dim(
-                                             b, m_safe, 0,
-                                             keepdims=False)),
-                            m_safe, 0)
-                        for b, v in zip(outs_buf, emits))
-                    carry_next = tuple(lax.ppermute(v, axis, perm_f)
-                                       for v in c_out)
-                    return (carry_next, outs_buf), None
+            (carry_f, stash, outs_buf, a_row), _ = lax.scan(
+                tick_f, (carry_f, stash, outs_buf, a_row),
+                jnp.arange(M + S - 1))
 
-                ticks = jnp.arange(M + S - 1)
-                (_, outs_buf), _ = lax.scan(
-                    tick, (carry_f, outs_buf), ticks)
-                # loss seed: sum of all outputs (loss heads carry custom
-                # vjp); psum makes the value replicated and routes the
-                # cotangent back to the last stage
-                loss = sum(
-                    lax.psum(jnp.where(sidx == S - 1,
-                                       b.astype(jnp.float32),
-                                       jnp.zeros_like(
-                                           b, dtype=jnp.float32)).sum(),
-                             axis) for b in outs_buf)
-                return loss, outs_buf
+            grad_acc = jnp.zeros_like(p_row)
+            g_carry = zeros_carry()
 
-            loss, vjp_fn, outs_buf = jax.vjp(fwd_all, p_row,
-                                             has_aux=True)
-            grad_row = vjp_fn(jnp.ones((), jnp.float32))[0]
+            def tick_b(state, t_idx):
+                g_carry, grad_acc = state
+                m_b = t_idx - (S - 1 - sidx)
+                valid_b = (m_b >= 0) & (m_b < M)
+                m_safe = jnp.clip(m_b, 0, M - 1)
+                x_b = tuple(lax.dynamic_index_in_dim(b, m_safe, 0,
+                                                     keepdims=False)
+                            for b in stash)
+                feed_b = feed_at(micro, m_b)
+                g_pr, g_c = lax.switch(sidx, bwd_branches, p_row, a_row,
+                                       x_b, feed_b, g_carry,
+                                       micro_key(rng, m_b))
+                grad_acc = grad_acc + jnp.where(valid_b, 1.0, 0.0) * g_pr
+                g_next = tuple(lax.ppermute(
+                    jnp.where(valid_b, v, jnp.zeros_like(v)), axis,
+                    perm_b) for v in g_c)
+                return (g_next, grad_acc), None
+
+            (g_carry, grad_acc), _ = lax.scan(
+                tick_b, (g_carry, grad_acc), jnp.arange(M + S - 1))
+
             outs_rep = tuple(
                 lax.psum(jnp.where(sidx == S - 1, b, jnp.zeros_like(b)),
                          axis) for b in outs_buf)
             new_p_row, new_s_row = lax.switch(
-                sidx, upd_branches, p_row, s_row, grad_row, lr, t, rng)
-            return new_p_row[None], new_s_row[None], outs_rep
+                sidx, upd_branches, p_row, s_row, grad_acc, lr, t, rng)
+            return new_p_row[None], new_s_row[None], a_row[None], outs_rep
 
         body = body_1f1b if self.schedule == "1f1b" else body_gpipe
         pspec = P(axis)
         specs = dict(
-            in_specs=(pspec, pspec, P(), P(), P(), P()),
-            out_specs=(pspec, pspec, P()))
+            in_specs=(pspec, pspec, pspec, P(), P(), P(), P()),
+            out_specs=(pspec, pspec, pspec, P()))
         try:
             fn = shard_map(body, mesh=mesh, check_vma=False, **specs)
         except TypeError:
@@ -887,9 +953,9 @@ class PipelineTrainStep:
         repl = NamedSharding(mesh, P())
         return jax.jit(
             fn,
-            in_shardings=(row_sh, row_sh, repl, repl, repl, repl),
-            out_shardings=(row_sh, row_sh, repl),
-            donate_argnums=(0, 1))
+            in_shardings=(row_sh, row_sh, row_sh, repl, repl, repl, repl),
+            out_shardings=(row_sh, row_sh, row_sh, repl),
+            donate_argnums=(0, 1, 2))
 
     # -- packing interface -----------------------------------------------
     def pack_params(self, params):
@@ -919,6 +985,20 @@ class PipelineTrainStep:
         return jax.device_put(stacked,
                               NamedSharding(self.mesh, P(self.axis)))
 
+    def pack_aux(self, aux):
+        """{name: array} aux states -> stage-sharded (S, La) buffer."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rows = []
+        for k, pk in enumerate(self._aux_packers):
+            sub = {n: aux[n] for n in self._stage_aux_names[k]}
+            rows.append(pk.pack(sub, self._la))
+        stacked = jnp.stack(rows)
+        return jax.device_put(stacked,
+                              NamedSharding(self.mesh, P(self.axis)))
+
     def unpack_params(self):
         """Gather the live packed parameters back to a {name: array}
         dict (replicated) — the checkpoint/eval sync point."""
@@ -944,12 +1024,23 @@ class PipelineTrainStep:
             out.update(pk.unpack(host[k][:pk.total]))
         return out
 
+    def unpack_aux(self):
+        """Gather the live packed aux states (BN moving stats) back to
+        a replicated {name: array} dict."""
+        import numpy as np
+
+        out = {}
+        if getattr(self, "_packed_aux", None) is None:
+            return out
+        host = np.asarray(self._packed_aux)
+        for k, pk in enumerate(self._aux_packers):
+            out.update(pk.unpack(host[k][:pk.total]))
+        return out
+
     # -- call -------------------------------------------------------------
     def __call__(self, params, aux, states, batch, rng, lr=None, t=None):
         import jax.numpy as jnp
 
-        if aux:
-            raise MXNetError("pipeline v1 does not thread aux states")
         if t is None:
             self._t += 1
             t = self._t
@@ -960,13 +1051,16 @@ class PipelineTrainStep:
         if self._packed_params is None:
             self._packed_params = self.pack_params(params)
             self._packed_states = self.pack_states(states)
+            self._packed_aux = self.pack_aux(aux)
         micro = {}
         for k, v in batch.items():
             arr = jnp.asarray(v)
             micro[k] = arr.reshape((self.n_micro, self._mb)
                                    + tuple(arr.shape[1:]))
-        self._packed_params, self._packed_states, outs = self._jit_step(
-            self._packed_params, self._packed_states, micro, rng,
+        (self._packed_params, self._packed_states, self._packed_aux,
+         outs) = self._jit_step(
+            self._packed_params, self._packed_states, self._packed_aux,
+            micro, rng,
             jnp.asarray(self.lr if lr is None else lr, "float32"),
             jnp.asarray(t, "int32"))
         # un-microbatch the outputs: (M, mb, ...) -> (N, ...)
@@ -976,8 +1070,10 @@ class PipelineTrainStep:
         return params, aux, states, flat_outs
 
     def init_state(self, shapes, dtype="float32", seed=0):
-        """Allocate packed params/states directly (bench convenience;
-        Module initializes through its own initializer path)."""
+        """Allocate params/aux/states directly (bench convenience;
+        Module initializes through its own initializer path).  Returns
+        ``(params, aux, states)`` — the same triple as
+        ``fused.TrainStep.init_state``."""
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -999,4 +1095,9 @@ class PipelineTrainStep:
                 scale = (2.0 / max(1, fan_in)) ** 0.5
                 params[n] = scale * jax.random.normal(sub, shp, dtype)
             states[n] = self.optimizer.init_fused_state(params[n])
-        return params, states
+        aux = {}
+        for n in self.aux_names:
+            shp = all_shapes[n]
+            aux[n] = jnp.ones(shp, "float32") if n.endswith("_var") \
+                else jnp.zeros(shp, "float32")
+        return params, aux, states

