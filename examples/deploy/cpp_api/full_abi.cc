@@ -1,0 +1,249 @@
+// Full-ABI client: drives the CachedOp, Autograd, DataIter and KVStore
+// C-API groups end-to-end from one C++ binary — CSV data loaded through
+// MXDataIter*, gradients computed through MXAutograd* over an
+// MXInvokeCachedOp forward, parameters updated through MXKVStore* with
+// a registered C updater.  No Python in this file.
+//
+// Reference analogue: the same training loop a Scala/C++ frontend runs
+// against include/mxnet/c_api.h groups :680-760 (autograd), :1400-1500
+// (data iter), :1513-1770 (kvstore), c_api_ndarray.cc:611 (CachedOp).
+// Build: see README.md next to this file (same line as main.cc with
+// full_abi.cc substituted).
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu/cpp/mxnet_cpp.h"
+#include "mxnet_tpu/cpp/op.h"
+
+using mxnet_tpu::cpp::Check;
+using mxnet_tpu::cpp::NDArray;
+using mxnet_tpu::cpp::Symbol;
+
+namespace {
+
+constexpr mx_uint kBatch = 32, kDim = 8, kHidden = 16, kClasses = 3;
+constexpr mx_uint kRows = 96;
+constexpr float kLr = 0.5f;
+
+// the C updater registered with MXKVStoreSetUpdater: local -= lr * recv
+void SgdUpdater(int key, NDArrayHandle recv, NDArrayHandle local,
+                void *handle) {
+  (void)key;
+  (void)handle;
+  mx_uint nd = 0;
+  const mx_uint *dims = nullptr;
+  Check(MXNDArrayGetShape(local, &nd, &dims));
+  size_t total = 1;
+  for (mx_uint i = 0; i < nd; ++i) total *= dims[i];
+  std::vector<float> w(total), g(total);
+  Check(MXNDArraySyncCopyToCPU(local, w.data(), w.size()));
+  Check(MXNDArraySyncCopyToCPU(recv, g.data(), g.size()));
+  for (size_t i = 0; i < total; ++i) w[i] -= kLr * g[i];
+  Check(MXNDArraySyncCopyFromCPU(local, w.data(), w.size()));
+}
+
+}  // namespace
+
+int main() {
+  // ---- synthetic separable task written as CSV ----
+  unsigned seed = 4242;
+  auto frand = [&seed]() {
+    seed = seed * 1103515245u + 12345u;
+    return static_cast<float>((seed >> 16) & 0x7fff) / 32768.0f - 0.5f;
+  };
+  std::vector<float> w_true(kDim * kClasses);
+  for (auto &v : w_true) v = frand();
+  {
+    std::ofstream dcsv("full_abi_data.csv"), lcsv("full_abi_label.csv");
+    for (mx_uint i = 0; i < kRows; ++i) {
+      std::vector<float> x(kDim);
+      float best = -1e30f;
+      int cls = 0;
+      for (mx_uint j = 0; j < kDim; ++j) x[j] = frand();
+      for (mx_uint c = 0; c < kClasses; ++c) {
+        float s = 0;
+        for (mx_uint j = 0; j < kDim; ++j)
+          s += x[j] * w_true[j * kClasses + c];
+        if (s > best) { best = s; cls = static_cast<int>(c); }
+      }
+      for (mx_uint j = 0; j < kDim; ++j)
+        dcsv << x[j] << (j + 1 == kDim ? '\n' : ',');
+      lcsv << cls << '\n';
+    }
+  }
+
+  // ---- MXDataIter*: find CSVIter in the creator registry ----
+  mx_uint n_iters = 0;
+  DataIterCreator *iters = nullptr;
+  Check(MXListDataIters(&n_iters, &iters));
+  DataIterCreator csv_creator = nullptr;
+  for (mx_uint i = 0; i < n_iters; ++i) {
+    const char *nm = nullptr;
+    Check(MXDataIterGetIterInfo(iters[i], &nm, nullptr, nullptr, nullptr,
+                                nullptr, nullptr));
+    if (std::string(nm) == "CSVIter") csv_creator = iters[i];
+  }
+  if (!csv_creator) { std::printf("CSVIter not found\n"); return 1; }
+  const char *ikeys[] = {"data_csv", "data_shape", "label_csv",
+                         "batch_size"};
+  const char *ivals[] = {"full_abi_data.csv", "(8,)",
+                         "full_abi_label.csv", "32"};
+  DataIterHandle it = nullptr;
+  Check(MXDataIterCreateIter(csv_creator, 4, ikeys, ivals, &it));
+
+  // ---- symbol + CachedOp ----
+  Symbol data = Symbol::Variable("data");
+  Symbol fc1 = mxnet_tpu::cpp::op::FullyConnected(
+      "fc1", {data}, {{"num_hidden", std::to_string(kHidden)}});
+  Symbol act = mxnet_tpu::cpp::op::Activation(
+      "act", {fc1}, {{"act_type", "relu"}});
+  Symbol fc2 = mxnet_tpu::cpp::op::FullyConnected(
+      "fc2", {act}, {{"num_hidden", std::to_string(kClasses)}});
+  Symbol net = mxnet_tpu::cpp::op::SoftmaxOutput(
+      "softmax", {fc2}, {{"normalization", "batch"}});
+  CachedOpHandle cop = nullptr;
+  Check(MXCreateCachedOp(net.get(), &cop));
+
+  auto args = net.ListArguments();   // data, fc1_w, fc1_b, fc2_w, fc2_b,
+                                     // softmax_label
+  auto shapes = net.InferArgShapes(
+      {{"data", {kBatch, kDim}}, {"softmax_label", {kBatch}}});
+
+  // ---- parameters + grads; init through MXKVStore* ----
+  KVStoreHandle kv = nullptr;
+  Check(MXKVStoreCreate("local", &kv));
+  const char *kv_type = nullptr;
+  Check(MXKVStoreGetType(kv, &kv_type));
+  int rank = -1, size = 0, is_worker = 0;
+  Check(MXKVStoreGetRank(kv, &rank));
+  Check(MXKVStoreGetGroupSize(kv, &size));
+  Check(MXKVStoreIsWorkerNode(&is_worker));
+  std::printf("kvstore type=%s rank=%d/%d worker=%d\n", kv_type, rank,
+              size, is_worker);
+  Check(MXKVStoreSetUpdater(kv, SgdUpdater, nullptr));
+
+  std::map<std::string, NDArray> params, grads;
+  std::vector<std::string> pnames;
+  for (size_t i = 0; i < args.size(); ++i) {
+    if (args[i] == "data" || args[i] == "softmax_label") continue;
+    NDArray arr(shapes[i]);
+    size_t total = 1;
+    for (mx_uint d : shapes[i]) total *= d;
+    std::vector<float> init(total);
+    float scale = std::sqrt(2.0f / static_cast<float>(
+        shapes[i].size() > 1 ? shapes[i][1] : shapes[i][0]));
+    for (auto &v : init) v = frand() * 2.0f * scale;
+    arr.SyncCopyFromCPU(init);
+    params.emplace(args[i], arr);
+    grads.emplace(args[i], NDArray(shapes[i]));
+    pnames.push_back(args[i]);
+    int key = static_cast<int>(pnames.size()) - 1;
+    NDArrayHandle vh = arr.get();
+    Check(MXKVStoreInit(kv, 1, &key, &vh));
+  }
+
+  // ---- mark parameters for autograd (req 1 = write) ----
+  {
+    std::vector<NDArrayHandle> vars, gbufs;
+    std::vector<mx_uint> reqs;
+    for (auto &n : pnames) {
+      vars.push_back(params[n].get());
+      gbufs.push_back(grads[n].get());
+      reqs.push_back(1);
+    }
+    Check(MXAutogradMarkVariables(
+        static_cast<mx_uint>(vars.size()), vars.data(), reqs.data(),
+        gbufs.data()));
+  }
+
+  // ---- training epochs: DataIter -> CachedOp fwd (recorded) ->
+  //      MXAutogradBackward -> kvstore push/pull ----
+  for (int epoch = 0; epoch < 60; ++epoch) {
+    Check(MXDataIterBeforeFirst(it));
+    int has_next = 0;
+    Check(MXDataIterNext(it, &has_next));
+    while (has_next) {
+      NDArrayHandle bdata = nullptr, blabel = nullptr;
+      Check(MXDataIterGetData(it, &bdata));
+      Check(MXDataIterGetLabel(it, &blabel));
+
+      int prev_rec = 0, prev_train = 0;
+      Check(MXAutogradSetIsRecording(1, &prev_rec));
+      Check(MXAutogradSetIsTraining(1, &prev_train));
+      std::vector<NDArrayHandle> cop_in = {
+          bdata, params["fc1_weight"].get(), params["fc1_bias"].get(),
+          params["fc2_weight"].get(), params["fc2_bias"].get(), blabel};
+      int n_out = 0;
+      NDArrayHandle *outs = nullptr;
+      Check(MXInvokeCachedOp(cop, static_cast<int>(cop_in.size()),
+                             cop_in.data(), &n_out, &outs));
+      unsigned char recording = 0;
+      Check(MXAutogradIsRecording(&recording));
+      if (!recording) { std::printf("recording flag lost\n"); return 1; }
+      Check(MXAutogradBackward(1, &outs[0], nullptr, 0));
+      Check(MXAutogradSetIsRecording(0, &prev_rec));
+      Check(MXAutogradSetIsTraining(0, &prev_train));
+      for (int oi = 0; oi < n_out; ++oi) Check(MXNDArrayFree(outs[oi]));
+
+      // push grads / pull updated params through the kvstore
+      for (size_t i = 0; i < pnames.size(); ++i) {
+        int key = static_cast<int>(i);
+        NDArrayHandle gh = grads[pnames[i]].get();
+        NDArrayHandle ph = params[pnames[i]].get();
+        Check(MXKVStorePush(kv, 1, &key, &gh, 0));
+        Check(MXKVStorePull(kv, 1, &key, &ph, 0));
+      }
+      Check(MXDataIterNext(it, &has_next));
+    }
+  }
+
+  // ---- score: full pass, recording off ----
+  Check(MXDataIterBeforeFirst(it));
+  int has_next = 0, correct = 0, total_n = 0;
+  Check(MXDataIterNext(it, &has_next));
+  while (has_next) {
+    NDArrayHandle bdata = nullptr, blabel = nullptr;
+    Check(MXDataIterGetData(it, &bdata));
+    Check(MXDataIterGetLabel(it, &blabel));
+    std::vector<NDArrayHandle> cop_in = {
+        bdata, params["fc1_weight"].get(), params["fc1_bias"].get(),
+        params["fc2_weight"].get(), params["fc2_bias"].get(), blabel};
+    int n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXInvokeCachedOp(cop, static_cast<int>(cop_in.size()),
+                           cop_in.data(), &n_out, &outs));
+    std::vector<float> probs(kBatch * kClasses), lab(kBatch);
+    Check(MXNDArraySyncCopyToCPU(outs[0], probs.data(), probs.size()));
+    Check(MXNDArraySyncCopyToCPU(blabel, lab.data(), lab.size()));
+    int pad = 0;
+    Check(MXDataIterGetPadNum(it, &pad));
+    for (mx_uint i = 0; i < kBatch - static_cast<mx_uint>(pad); ++i) {
+      int best = 0;
+      for (mx_uint c = 1; c < kClasses; ++c)
+        if (probs[i * kClasses + c] > probs[i * kClasses + best])
+          best = static_cast<int>(c);
+      correct += (best == static_cast<int>(lab[i]));
+      ++total_n;
+    }
+    for (int oi = 0; oi < n_out; ++oi) Check(MXNDArrayFree(outs[oi]));
+    Check(MXDataIterNext(it, &has_next));
+  }
+  float acc = static_cast<float>(correct) /
+              static_cast<float>(total_n ? total_n : 1);
+  std::printf("accuracy %.3f over %d rows\n", acc, total_n);
+
+  Check(MXKVStoreBarrier(kv));
+  Check(MXKVStoreFree(kv));
+  Check(MXDataIterFree(it));
+  Check(MXFreeCachedOp(cop));
+  if (acc > 0.9f) {
+    std::printf("FULL ABI CLIENT OK\n");
+    return 0;
+  }
+  std::printf("accuracy too low\n");
+  return 1;
+}

@@ -1,10 +1,12 @@
 /*
  * C ABI for mxnet_tpu — NDArray / imperative invoke / Symbol / Executor
- * groups, following the reference surface in include/mxnet/c_api.h
- * (NDArray :241-640, imperative invoke c_api_ndarray.cc:548, Symbol
- * :841-1260, Executor :1270-1400) so C/C++ frontends written against the
- * reference port by relinking.  The deployment-only predictor surface
- * lives in c_predict_api.h.
+ * / CachedOp / Autograd / DataIter / KVStore groups, following the
+ * reference surface in include/mxnet/c_api.h (NDArray :241-640,
+ * imperative invoke c_api_ndarray.cc:548, Symbol :841-1260, Executor
+ * :1270-1400, CachedOp c_api_ndarray.cc:611-660, Autograd :680-760,
+ * DataIter :1400-1500, KVStore :1513-1770) so C/C++ frontends written
+ * against the reference port by relinking.  The deployment-only
+ * predictor surface lives in c_predict_api.h.
  *
  * Design: the compute path is XLA via the Python package (the executor
  * compiles bound graphs to single XLA programs); this library embeds
@@ -122,6 +124,110 @@ int MXExecutorBackward(ExecutorHandle handle, mx_uint num_head_grads,
 int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
                       NDArrayHandle **out);
 int MXExecutorFree(ExecutorHandle handle);
+
+/* ---- CachedOp (reference c_api_ndarray.cc:611-660) -------------------- */
+typedef void *CachedOpHandle;
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+int MXFreeCachedOp(CachedOpHandle handle);
+/* inputs follow list_arguments() then list_auxiliary_states() order;
+ * output handles follow the MXImperativeInvoke ownership contract
+ * (*outputs NULL on entry -> caller owns the returned handles) */
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+
+/* ---- Autograd (reference c_api.h:680-760) ----------------------------- */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradIsRecording(unsigned char *curr);
+int MXAutogradIsTraining(unsigned char *curr);
+/* grad req codes: 0=null, 1=write, 3=add (reference OpReqType) */
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles);
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles);
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+int MXAutogradBackwardEx(mx_uint num_output,
+                         NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int is_train);
+
+/* ---- Data iterators (reference c_api.h:1400-1500) --------------------- */
+typedef void *DataIterHandle;
+typedef const void *DataIterCreator;
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+/* data/label handles are iterator-owned: valid until the next
+ * Next/BeforeFirst/Free on the same iterator; do NOT MXNDArrayFree */
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetIndex(DataIterHandle handle, unsigned long long **out_index,
+                       unsigned long long *out_size);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ---- KVStore (reference c_api.h:1513-1770) ---------------------------- */
+typedef void *KVStoreHandle;
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void *handle);
+typedef void(MXKVStoreServerController)(int head, const char *body,
+                                        void *controller_handle);
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+/* pull writes INTO the caller-provided arrays */
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           NDArrayHandle *row_ids, int priority);
+/* updater runs on every push for 'local' stores; recv/local handles
+ * passed to the callback are library-owned (do not free); local must be
+ * updated in place (e.g. MXNDArraySyncCopyFromCPU or an invoke with
+ * caller-provided outputs) */
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+/* role predicates: this runtime is serverless (XLA collectives +
+ * jax.distributed replace the ps-lite server/scheduler roles — SURVEY
+ * §2.3 stance), so every process is a worker */
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit);
+/* serverless: returns immediately with success (no server role exists;
+ * kept so reference-contract launch scripts run unmodified) */
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int *number, const int timeout_sec);
 
 #ifdef __cplusplus
 }

@@ -88,6 +88,19 @@ def set_training(train):
     return prev
 
 
+def _c_set_recording(is_record):
+    """C-ABI entry (MXAutogradSetIsRecording): same fresh-graph
+    semantics as entering a ``record()`` scope — an off->on transition
+    drops any stale tape and re-keys the marked-variable map."""
+    st = _st()
+    prev = st.recording
+    if is_record and not prev:
+        st.tape.clear()
+        _rebuild_marked_map()
+    st.recording = bool(is_record)
+    return prev
+
+
 class _RecordingStateScope:
     def __init__(self, is_record, train):
         self._rec, self._train = is_record, train

@@ -91,6 +91,7 @@ _init_module()
 
 from . import sparse  # noqa: E402  (storage types; reference nd.sparse)
 from .sparse import BaseSparseNDArray, CSRNDArray, RowSparseNDArray  # noqa
+from .cached_op import CachedOp  # noqa: E402  (reference nd.CachedOp)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):

@@ -1,0 +1,124 @@
+"""CachedOp — bind a Symbol once, invoke imperatively many times
+(reference ``src/c_api/c_api_ndarray.cc:611-660`` ``MXCreateCachedOp`` /
+``MXInvokeCachedOp``; Python ``mxnet.ndarray.CachedOp``).
+
+TPU-native stance: the reference replays the graph through its
+imperative engine per call; here the whole graph jit-compiles into ONE
+XLA program per (shapes, dtypes, train-mode) key — the same inversion as
+``Executor`` — and subsequent calls with the same signature are a single
+dispatch.  Under ``autograd.record()`` the invocation lands on the tape
+as ONE entry whose replay is the traced graph function, so
+``autograd.backward`` differentiates through it exactly.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["CachedOp"]
+
+
+class _GraphOp:
+    """Synthetic registry-op view of a traced symbol graph: what the
+    autograd tape needs to replay a CachedOp invocation as one entry."""
+
+    needs_rng = True
+    uses_train_mode = False
+    mutable_inputs = ()
+
+    def __init__(self, name, fn, arg_names):
+        self.name = name
+        self._fn = fn
+        self._arg_names = arg_names
+
+    def compute(self, attrs, rng, *ins):
+        args = dict(zip(self._arg_names, ins))
+        outs, _aux = self._fn(args, {}, rng)
+        return outs if len(outs) > 1 else outs[0]
+
+
+class CachedOp:
+    """``CachedOp(sym)(*inputs)``: inputs follow ``list_arguments()``
+    order, then ``list_auxiliary_states()`` order (the reference's
+    ``ListInputs(kAll)`` flattening, grouped args-then-aux here).  Aux
+    state updates (BatchNorm moving stats) write back into the passed
+    aux NDArrays, mirroring the reference's mutable-input contract."""
+
+    def __init__(self, sym):
+        self._sym = sym
+        self._arg_names = list(sym.list_arguments())
+        self._aux_names = list(sym.list_auxiliary_states())
+        self._jit_cache = {}
+        self._trace_cache = {}
+
+    @property
+    def num_inputs(self):
+        return len(self._arg_names) + len(self._aux_names)
+
+    def _traced(self, is_train):
+        from ..executor import _trace_fn
+
+        if is_train not in self._trace_cache:
+            self._trace_cache[is_train] = _trace_fn(
+                self._sym, is_train=is_train)[0]
+        return self._trace_cache[is_train]
+
+    def __call__(self, *args):
+        import jax
+
+        from .. import autograd
+        from .. import random as _random
+        from .ndarray import NDArray
+
+        expect = self.num_inputs
+        if len(args) != expect:
+            raise MXNetError(
+                "CachedOp expects %d inputs (%d arguments + %d aux "
+                "states), got %d" % (expect, len(self._arg_names),
+                                     len(self._aux_names), len(args)))
+        nds = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+        arg_nds = nds[:len(self._arg_names)]
+        aux_nds = nds[len(self._arg_names):]
+        is_train = autograd.is_training()
+        recording = autograd.is_recording()
+        rng = _random.next_key()
+
+        if recording:
+            if self._aux_names:
+                raise MXNetError(
+                    "CachedOp under autograd.record() does not support "
+                    "aux-state symbols (%s) — BatchNorm moving-stat "
+                    "mutation has no gradient meaning on the tape; run "
+                    "outside record() or use use_global_stats"
+                    % self._aux_names)
+            fn = self._traced(is_train)
+            gop = _GraphOp("cached_op", fn, self._arg_names)
+            bufs = [x._data for x in arg_nds]
+            outs = gop.compute(None, rng, *bufs)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            out_nds = [NDArray(o, arg_nds[0].context if arg_nds else None)
+                       for o in outs]
+            autograd._record(gop, None, arg_nds, [rng] + bufs, out_nds,
+                             list(outs), rng)
+            return out_nds if len(out_nds) > 1 else out_nds[0]
+
+        key = (is_train,) + tuple(
+            (tuple(x.shape), str(x.dtype)) for x in nds)
+        if key not in self._jit_cache:
+            fn = self._traced(is_train)
+
+            def run(arg_bufs, aux_bufs, k):
+                args_d = dict(zip(self._arg_names, arg_bufs))
+                aux_d = dict(zip(self._aux_names, aux_bufs))
+                return fn(args_d, aux_d, k)
+
+            self._jit_cache[key] = jax.jit(run)
+        outs, new_aux = self._jit_cache[key](
+            [x._data for x in arg_nds], [x._data for x in aux_nds], rng)
+        # reference FMutateInputs contract: aux inputs are updated
+        for name, nd in zip(self._aux_names, aux_nds):
+            if name in new_aux:
+                nd._set_data(new_aux[name])
+        ctx = arg_nds[0].context if arg_nds else None
+        out_nds = [NDArray(o, ctx) for o in outs]
+        return out_nds if len(out_nds) > 1 else out_nds[0]

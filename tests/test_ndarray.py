@@ -134,3 +134,74 @@ def test_topk_sort():
     np.testing.assert_allclose(v.asnumpy(), [[3, 2]])
     s = nd.sort(x)
     np.testing.assert_allclose(s.asnumpy(), [[1, 2, 3]])
+
+
+def test_cached_op_forward_and_cache():
+    """CachedOp (reference c_api_ndarray.cc:611 / nd.CachedOp): bind a
+    Symbol once, invoke many times — one jitted program per shape key;
+    aux states (BN moving stats) mutate in place like the reference's
+    FMutateInputs contract."""
+    import numpy as np
+
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    out = mx.sym.FullyConnected(d, weight=w, no_bias=True, num_hidden=3,
+                                name="fc")
+    out = mx.sym.Activation(out, act_type="tanh")
+    cop = mx.nd.CachedOp(out)
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randn(4, 5).astype("float32"))
+    wv = mx.nd.array(rs.randn(3, 5).astype("float32"))
+    y = cop(x, wv)
+    ref = np.tanh(x.asnumpy() @ wv.asnumpy().T)
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    y2 = cop(x, wv)  # cache hit path
+    np.testing.assert_allclose(y2.asnumpy(), y.asnumpy(), rtol=1e-6)
+    assert len(cop._jit_cache) == 1
+    with pytest.raises(mx.base.MXNetError, match="inputs"):
+        cop(x)
+
+    bn = mx.sym.BatchNorm(mx.sym.Variable("data"), fix_gamma=False,
+                          name="bn")
+    cop2 = mx.nd.CachedOp(bn)
+    gamma, beta = mx.nd.ones((5,)), mx.nd.zeros((5,))
+    mm, mv = mx.nd.zeros((5,)), mx.nd.ones((5,))
+    with mx.autograd.train_mode():
+        cop2(x, gamma, beta, mm, mv)
+    assert abs(mm.asnumpy()).max() > 1e-6  # aux mutated in place
+
+
+def test_cached_op_autograd():
+    """CachedOp under autograd.record(): the whole graph lands on the
+    tape as one entry; backward produces the same gradients as
+    recording the ops individually."""
+    import numpy as np
+
+    rs = np.random.RandomState(1)
+    x = mx.nd.array(rs.randn(4, 5).astype("float32"))
+    wv = mx.nd.array(rs.randn(3, 5).astype("float32"))
+
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    net = mx.sym.Activation(
+        mx.sym.FullyConnected(d, weight=w, no_bias=True, num_hidden=3,
+                              name="fc"), act_type="tanh")
+    cop = mx.nd.CachedOp(net)
+    g1 = mx.nd.zeros((3, 5))
+    mx.autograd.mark_variables([wv], [g1])
+    with mx.autograd.record():
+        y = cop(x, wv)
+        loss = y * y
+    mx.autograd.backward([loss])
+
+    g2 = mx.nd.zeros((3, 5))
+    wv2 = mx.nd.array(wv.asnumpy())
+    mx.autograd.mark_variables([wv2], [g2])
+    with mx.autograd.record():
+        y2 = mx.nd.Activation(
+            mx.nd.FullyConnected(x, wv2, no_bias=True, num_hidden=3),
+            act_type="tanh")
+        loss2 = y2 * y2
+    mx.autograd.backward([loss2])
+    np.testing.assert_allclose(g1.asnumpy(), g2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
