@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""DCGAN — adversarial training with TWO alternating Modules
+(reference ``example/gan/dcgan.py``): a generator Module (Deconvolution
+stack) and a discriminator Module bound with ``inputs_need_grad=True``;
+the generator trains on the gradient the discriminator produces w.r.t.
+its INPUT, handed across modules via ``modG.backward(diffD)`` — the
+training pattern nothing in single-Module ``fit`` exercises:
+
+* D steps on fake + real with manual gradient accumulation across the
+  two passes (saved ``grad_dict`` arrays added before ``update()``),
+* G steps through ``modD.get_input_grads()``.
+
+Data: synthetic 'disk' images (bright center disk, dark rim).  Learning
+is asserted the GAN way: the generator's samples move from noise toward
+the real statistics, and fool rate rises off the floor.
+
+    python examples/gan/dcgan.py --num-epochs 10
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_generator(ngf, z_dim):
+    """z (N, Z, 1, 1) -> image (N, 1, 8, 8) via Deconvolution stack."""
+    rand = mx.sym.Variable("rand")
+    g = mx.sym.Deconvolution(rand, kernel=(4, 4), num_filter=ngf * 2,
+                             no_bias=True, name="g1")          # 4x4
+    g = mx.sym.BatchNorm(g, fix_gamma=True, eps=1e-5, name="gbn1")
+    g = mx.sym.Activation(g, act_type="relu", name="gact1")
+    g = mx.sym.Deconvolution(g, kernel=(4, 4), stride=(2, 2),
+                             pad=(1, 1), num_filter=ngf,
+                             no_bias=True, name="g2")          # 8x8
+    g = mx.sym.BatchNorm(g, fix_gamma=True, eps=1e-5, name="gbn2")
+    g = mx.sym.Activation(g, act_type="relu", name="gact2")
+    g = mx.sym.Deconvolution(g, kernel=(3, 3), pad=(1, 1), num_filter=1,
+                             no_bias=True, name="g3")          # 8x8
+    return mx.sym.Activation(g, act_type="tanh", name="gout")
+
+
+def make_discriminator(ndf):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    d = mx.sym.Convolution(data, kernel=(4, 4), stride=(2, 2),
+                           pad=(1, 1), num_filter=ndf, no_bias=True,
+                           name="d1")                          # 4x4
+    d = mx.sym.LeakyReLU(d, act_type="leaky", slope=0.2, name="dact1")
+    d = mx.sym.Convolution(d, kernel=(4, 4), num_filter=1,
+                           no_bias=True, name="d2")            # 1x1
+    d = mx.sym.Flatten(d)
+    return mx.sym.LogisticRegressionOutput(d, label, name="dloss")
+
+
+def real_batch(n, rs):
+    """Bright center disk on a dark field, in [-1, 1]."""
+    yy, xx = np.mgrid[0:8, 0:8]
+    disk = (((yy - 3.5) ** 2 + (xx - 3.5) ** 2) < 6).astype("float32")
+    imgs = np.tile(disk, (n, 1, 1, 1)) * 1.6 - 0.8
+    imgs += 0.1 * rs.randn(n, 1, 8, 8).astype("float32")
+    return np.clip(imgs, -1, 1).astype("float32")
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    batch, z_dim = args.batch_size, 16
+    ctx = mx.tpu(0)
+
+    symG = make_generator(ngf=16, z_dim=z_dim)
+    symD = make_discriminator(ndf=16)
+
+    modG = mx.mod.Module(symG, data_names=("rand",), label_names=(),
+                         context=ctx)
+    modG.bind(data_shapes=[("rand", (batch, z_dim, 1, 1))])
+    modG.init_params(mx.init.Normal(0.05))
+    modG.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    modD = mx.mod.Module(symD, data_names=("data",),
+                         label_names=("label",), context=ctx)
+    modD.bind(data_shapes=[("data", (batch, 1, 8, 8))],
+              label_shapes=[("label", (batch,))],
+              inputs_need_grad=True)
+    modD.init_params(mx.init.Normal(0.05))
+    modD.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    ones = mx.nd.ones((batch,))
+    zeros = mx.nd.zeros((batch,))
+    real_mean = float(real_batch(256, rs).mean())
+    fool_rate = 0.0
+    first_gap = None
+
+    for epoch in range(args.num_epochs):
+        d_correct, d_total, fooled = 0, 0, 0
+        for _ in range(args.batches_per_epoch):
+            z = mx.nd.array(rs.randn(batch, z_dim, 1, 1)
+                            .astype("float32"))
+            modG.forward(mx.io.DataBatch([z], []), is_train=True)
+            fake = modG.get_outputs()[0]
+
+            # --- D on fake (label 0): save grads, defer update -------
+            modD.forward(mx.io.DataBatch([fake], [zeros]),
+                         is_train=True)
+            modD.backward()
+            saved = {n: g.copy()
+                     for n, g in modD._exec.grad_dict.items()
+                     if g is not None and n not in ("data", "label")}
+            p = modD.get_outputs()[0].asnumpy().ravel()
+            d_correct += int((p < 0.5).sum())
+            d_total += batch
+
+            # --- D on real (label 1): accumulate saved fake grads ----
+            xb = mx.nd.array(real_batch(batch, rs))
+            modD.forward(mx.io.DataBatch([xb], [ones]), is_train=True)
+            modD.backward()
+            for n, g in saved.items():
+                modD._exec.grad_dict[n].__iadd__(g)
+            modD.update()
+            p = modD.get_outputs()[0].asnumpy().ravel()
+            d_correct += int((p > 0.5).sum())
+            d_total += batch
+
+            # --- G step: label fake as real, push D's input gradient
+            #     back through G ------------------------------------
+            modD.forward(mx.io.DataBatch([fake], [ones]), is_train=True)
+            modD.backward()
+            diffD = modD.get_input_grads()
+            modG.backward([diffD[0]])
+            modG.update()
+            p = modD.get_outputs()[0].asnumpy().ravel()
+            fooled += int((p > 0.5).sum())
+
+        fake_np = fake.asnumpy()
+        gap = abs(float(fake_np.mean()) - real_mean)
+        if first_gap is None:
+            first_gap = gap
+        fool_rate = fooled / d_total * 2
+        print("epoch %d D-acc %.3f fool-rate %.3f fake-mean-gap %.3f"
+              % (epoch, d_correct / d_total, fool_rate, gap))
+
+    print("final fake-mean-gap %.3f (start %.3f) fool-rate %.3f"
+          % (gap, first_gap, fool_rate))
+    return gap, fool_rate
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=10)
+    p.add_argument("--batches-per-epoch", type=int, default=20)
+    p.add_argument("--lr", type=float, default=2e-4)
+    main(p.parse_args())

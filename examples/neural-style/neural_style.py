@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Neural style transfer — optimizing the INPUT image
+(reference ``example/neural-style/``: content + Gram-matrix style
+losses over fixed conv features; gradient descent on the image, not
+the weights).
+
+The capability this proves: ``autograd.mark_variables`` on a non-
+parameter input, backward producing input gradients, and an update
+loop where every network weight is frozen.
+
+    python examples/neural-style/neural_style.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def features(img, weights):
+    """Two conv feature maps from a fixed random 'perception' net (the
+    reference uses VGG19 relu layers; random filters preserve the
+    texture statistics the Gram loss needs)."""
+    w1, w2 = weights
+    f1 = mx.nd.Activation(
+        mx.nd.Convolution(img, w1, kernel=(3, 3), pad=(1, 1),
+                          num_filter=w1.shape[0], no_bias=True),
+        act_type="relu")
+    f2 = mx.nd.Activation(
+        mx.nd.Convolution(f1, w2, kernel=(3, 3), pad=(1, 1),
+                          num_filter=w2.shape[0], no_bias=True),
+        act_type="relu")
+    return f1, f2
+
+
+def gram(f):
+    n, c = f.shape[0], f.shape[1]
+    flat = mx.nd.Reshape(f, shape=(n, c, -1))
+    hw = flat.shape[2]
+    return mx.nd.batch_dot(flat, flat, transpose_b=True) / float(hw)
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    size = args.size
+    # content: diagonal gradient image; style: checkerboard texture
+    yy, xx = np.mgrid[0:size, 0:size].astype("float32")
+    content = ((yy + xx) / (2 * size))[None, None].repeat(3, 1)
+    style = (((yy // 4 + xx // 4) % 2)[None, None]
+             .repeat(3, 1).astype("float32"))
+    content_nd = mx.nd.array(content)
+    style_nd = mx.nd.array(style)
+
+    weights = (mx.nd.array(rs.randn(8, 3, 3, 3).astype("float32") * 0.4),
+               mx.nd.array(rs.randn(16, 8, 3, 3).astype("float32") * 0.2))
+
+    with autograd.pause():
+        cf1, cf2 = features(content_nd, weights)
+        sf1, sf2 = features(style_nd, weights)
+        sg1, sg2 = gram(sf1), gram(sf2)
+
+    img = mx.nd.array(content + 0.2 * rs.randn(*content.shape)
+                      .astype("float32"))
+    img_grad = mx.nd.zeros(img.shape)
+    autograd.mark_variables([img], [img_grad])
+
+    first = last = None
+    for it in range(args.iters):
+        with autograd.record():
+            f1, f2 = features(img, weights)
+            closs = mx.nd.mean(mx.nd.square(f2 - cf2))
+            g1, g2 = gram(f1), gram(f2)
+            sloss = (mx.nd.mean(mx.nd.square(g1 - sg1))
+                     + mx.nd.mean(mx.nd.square(g2 - sg2)))
+            loss = closs + args.style_weight * sloss
+        autograd.backward([loss])
+        # gradient descent ON THE IMAGE; weights never move
+        img_np = img.asnumpy() - args.lr * img_grad.asnumpy()
+        img._set_data(mx.nd.array(np.clip(img_np, -1.5, 2.5))._data)
+        val = float(loss.asscalar())
+        if first is None:
+            first = val
+        last = val
+        if it % 10 == 0:
+            print("iter %d loss %.5f (content %.5f style %.5f)"
+                  % (it, val, float(closs.asscalar()),
+                     float(sloss.asscalar())))
+    print("loss %.5f -> %.5f (%.1f%% reduction)"
+          % (first, last, 100 * (1 - last / first)))
+    return first, last
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=32)
+    p.add_argument("--iters", type=int, default=60)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--style-weight", type=float, default=1.0)
+    main(p.parse_args())

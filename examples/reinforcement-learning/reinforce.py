@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""REINFORCE policy gradient with imperative autograd rollouts
+(reference ``example/reinforcement-learning/`` — the imperative
+train-loop pattern of ``parallel_actor_critic``/``dqn``: per-step
+stochastic policy forwards, trajectory collection, one backward over
+the whole episode batch).
+
+Environment: an 8-state chain walk; the agent starts at 0, the goal is
+state 7, actions move left/right, reward 1.0 only at the goal.  The
+policy must learn 'always right' from reward alone.
+
+Exercises what Module.fit cannot: many recorded forwards per backward
+(one per env step), data-dependent episode dynamics on the host, loss
+assembled imperatively from sampled actions and discounted returns.
+
+    python examples/reinforcement-learning/reinforce.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+class ChainEnv:
+    """Vectorized 8-state chain: actions 0=left 1=right; reward at the
+    terminal goal state."""
+
+    def __init__(self, n_envs, n_states=8, horizon=10):
+        self.n_envs, self.n_states, self.horizon = n_envs, n_states, \
+            horizon
+
+    def rollout(self, policy_fn, rs):
+        pos = np.zeros(self.n_envs, dtype=np.int64)
+        done = np.zeros(self.n_envs, dtype=bool)
+        logps, rewards, masks = [], [], []
+        for _t in range(self.horizon):
+            obs = np.eye(self.n_states, dtype="float32")[pos]
+            logp_all = policy_fn(mx.nd.array(obs))       # (N, 2) log pi
+            probs = np.exp(logp_all.asnumpy())
+            acts = (rs.rand(self.n_envs) < probs[:, 1]).astype(np.int64)
+            # recorded gather of the sampled action's log-prob
+            onehot = np.eye(2, dtype="float32")[acts]
+            logp = mx.nd.sum(logp_all * mx.nd.array(onehot), axis=1)
+            step = np.where(acts == 1, 1, -1)
+            pos = np.clip(np.where(done, pos, pos + step), 0,
+                          self.n_states - 1)
+            reached = (pos == self.n_states - 1) & ~done
+            rewards.append(reached.astype("float32"))
+            masks.append((~done).astype("float32"))
+            done = done | reached
+            logps.append(logp)
+        return logps, rewards, masks, done
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    env = ChainEnv(args.n_envs)
+    w1 = mx.nd.array(rs.randn(16, env.n_states).astype("float32") * 0.3)
+    b1 = mx.nd.zeros((16,))
+    w2 = mx.nd.array(rs.randn(2, 16).astype("float32") * 0.3)
+    b2 = mx.nd.zeros((2,))
+    params = [w1, b1, w2, b2]
+    grads = [mx.nd.zeros(p.shape) for p in params]
+    autograd.mark_variables(params, grads)
+
+    def policy_fn(obs):
+        h = mx.nd.Activation(
+            mx.nd.FullyConnected(obs, w1, b1, num_hidden=16),
+            act_type="tanh")
+        logits = mx.nd.FullyConnected(h, w2, b2, num_hidden=2)
+        return mx.nd.log_softmax(logits, axis=-1)
+
+    mean_reward = 0.0
+    for it in range(args.iters):
+        with autograd.record():
+            logps, rewards, masks, _done = env.rollout(policy_fn, rs)
+            # discounted returns, then the REINFORCE surrogate
+            returns = []
+            g = np.zeros(args.n_envs, "float32")
+            for r in reversed(rewards):
+                g = r + args.gamma * g
+                returns.insert(0, g.copy())
+            base = np.mean([r.mean() for r in returns])
+            loss = None
+            for logp, g_t, m in zip(logps, returns, masks):
+                adv = mx.nd.array((g_t - base) * m)
+                term = mx.nd.sum(-logp * adv)
+                loss = term if loss is None else loss + term
+        autograd.backward([loss])
+        for p, g in zip(params, grads):
+            mx.nd.sgd_update(p, g, out=p, lr=args.lr,
+                             rescale_grad=1.0 / args.n_envs)
+        mean_reward = float(np.sum(rewards) / args.n_envs)
+        if it % 10 == 0:
+            print("iter %d mean-episode-reward %.3f" % (it, mean_reward))
+    print("final mean-episode-reward %.3f" % mean_reward)
+    return mean_reward
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--n-envs", type=int, default=64)
+    p.add_argument("--iters", type=int, default=80)
+    p.add_argument("--gamma", type=float, default=0.95)
+    p.add_argument("--lr", type=float, default=0.05)
+    main(p.parse_args())
