@@ -15,6 +15,7 @@ same ``part_index``/``num_parts`` contract as the reference C iter.
 """
 from __future__ import annotations
 
+import functools
 import io as _pyio
 import os
 import random
@@ -364,6 +365,49 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+@functools.lru_cache(maxsize=16)
+def _batch_tail_fn(mean_t, std_t):
+    """Jitted device tail of the augmenter chain: NHWC uint8 batch ->
+    NCHW fp32 (+ per-channel affine normalize).  Moving cast/transpose/
+    normalize OFF the host matters on small hosts: the per-image
+    float32 cast and strided transpose otherwise dominate decode."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        x = jnp.transpose(x, (0, 3, 1, 2)).astype(jnp.float32)
+        if mean_t is not None:
+            x = x - jnp.asarray(mean_t, jnp.float32).reshape(1, -1, 1, 1)
+        if std_t is not None:
+            x = x / jnp.asarray(std_t, jnp.float32).reshape(1, -1, 1, 1)
+        return x
+
+    return jax.jit(f)
+
+
+def _split_device_tail(aug_list):
+    """If the chain ends with CastAug [+ ColorNormalizeAug] and nothing
+    float-producing sits before them, the tail runs on DEVICE per batch
+    and the host path stays uint8.  Returns (host_augs, mean, std,
+    fast) — fast=False keeps the classic per-image path."""
+    host = list(aug_list)
+    mean = std = None
+    if host and isinstance(host[-1], ColorNormalizeAug):
+        mean, std = host[-1].mean, host[-1].std
+        host = host[:-1]
+    elif host and isinstance(host[-1], CastAug):
+        host = host[:-1]
+        return host, None, None, True
+    else:
+        return list(aug_list), None, None, False
+    if host and isinstance(host[-1], CastAug):
+        host = host[:-1]
+        m = None if mean is None else tuple(float(v) for v in mean)
+        s = None if std is None else tuple(float(v) for v in std)
+        return host, m, s, True
+    return list(aug_list), None, None, False
+
+
 class ImageIter(DataIter):
     """Image iterator over RecordIO (or an image list) with augmenters —
     the reference's Python ``ImageIter``, doubling as the backing for
@@ -426,6 +470,13 @@ class ImageIter(DataIter):
                              % (part_index, num_parts, total))
         self.aug_list = CreateAugmenter(data_shape) if aug_list is None \
             else aug_list
+        # device-tail fast path: host stays uint8, cast/transpose/
+        # normalize run jitted on device per BATCH
+        (self._host_augs, self._tail_mean, self._tail_std,
+         self._fast_tail) = _split_device_tail(self.aug_list)
+        # a 1-core host gains nothing from a decode pool (GIL thrash
+        # with the consumer); run decode inline there
+        self._serial = num_threads <= 1 or (os.cpu_count() or 1) <= 1
         self._pool = ThreadPoolExecutor(max_workers=num_threads)
         # record seek+read must be atomic (one shared file handle across
         # the decode pool); decode/augment run outside the lock
@@ -472,6 +523,29 @@ class ImageIter(DataIter):
             img = np.asarray(img, np.float32).reshape(h, w, c)
         return img.transpose(2, 0, 1), np.asarray(label, np.float32)
 
+    def _load_one_uint8(self, key):
+        """Fast-path loader: decode + host (shape-only) augs, uint8 HWC
+        out; the cast/transpose/normalize tail runs on device."""
+        if self.record is not None:
+            with self._rec_lock:
+                raw = self.record.read_idx(key)
+            header, img = recordio.unpack_img(raw)
+            label = header.label
+        else:
+            label, fname = self.imglist[key]
+            img = imread(fname)
+        for aug in self._host_augs:
+            img = aug(img)
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.data_shape
+        if img.shape[:2] != (h, w):
+            img = imresize(img.astype(np.uint8), w, h)
+            img = np.asarray(img).reshape(h, w, c)
+        return img.astype(np.uint8, copy=False), \
+            np.asarray(label, np.float32)
+
     def next(self):
         if self.cur >= len(self._order):
             raise StopIteration
@@ -483,14 +557,30 @@ class ImageIter(DataIter):
                 raise StopIteration
             want = want + self._order[:pad]
         self.cur += self.batch_size
-        loaded = list(self._pool.map(self._load_one, want))
-        data = np.stack([x[0] for x in loaded])
-        labels = np.stack([x[1] for x in loaded])
+        from .ndarray import NDArray, array
+
+        loader = self._load_one_uint8 if self._fast_tail else \
+            self._load_one
+        if self._serial:
+            loaded = [loader(k) for k in want]
+        else:
+            loaded = list(self._pool.map(loader, want))
+        if self._fast_tail:
+            c, h, w = self.data_shape
+            imgs = np.empty((self.batch_size, h, w, c), np.uint8)
+            for i, (im, _l) in enumerate(loaded):
+                imgs[i] = im
+            labels = np.stack([l for _, l in loaded])
+            dev = array(imgs)
+            out = _batch_tail_fn(self._tail_mean, self._tail_std)(
+                dev._data)
+            data_nd = NDArray(out, dev.context)
+        else:
+            data_nd = array(np.stack([x[0] for x in loaded]))
+            labels = np.stack([x[1] for x in loaded])
         if self.label_width == 1:
             labels = labels.reshape(self.batch_size, -1)[:, 0]
-        from .ndarray import array
-
-        return DataBatch(data=[array(data)], label=[array(labels)],
+        return DataBatch(data=[data_nd], label=[array(labels)],
                          pad=pad, index=None,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

@@ -3,6 +3,9 @@ reference's ``tests/nightly/dist_sync_kvstore.py`` run with
 ``tools/launch.py -n 2 --launcher local``).
 
 Usage: dist_worker.py <coordinator> <num_procs> <rank> <outdir>
+   or: dist_worker.py --from-env <outdir>   (tools/launch.py contract:
+       coordinator/size/rank read from MXNET_COORDINATOR /
+       MXNET_NUM_WORKERS / MXNET_WORKER_ID)
 
 Runs three conformance checks against the multi-process (DCN) branch of
 ``parallel.collectives.allreduce_nd`` and the KVStore rank/num_workers
@@ -24,8 +27,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
-    coordinator, num_procs, rank, outdir = sys.argv[1:5]
-    num_procs, rank = int(num_procs), int(rank)
+    if sys.argv[1] == "--from-env":
+        outdir = sys.argv[2]
+        coordinator = os.environ["MXNET_COORDINATOR"]
+        num_procs = int(os.environ["MXNET_NUM_WORKERS"])
+        rank = int(os.environ["MXNET_WORKER_ID"])
+    else:
+        coordinator, num_procs, rank, outdir = sys.argv[1:5]
+        num_procs, rank = int(num_procs), int(rank)
 
     import jax
 

@@ -29,28 +29,19 @@ def _free_port():
 
 
 def test_two_process_kvstore_and_fit(tmp_path):
+    """Workers are spawned THROUGH tools/launch.py (the reference's
+    dmlc-tracker role): coordinator address / size / rank arrive via
+    the injected MXNET_* env, not hand-rolled Popen plumbing."""
     worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
-    coord = "127.0.0.1:%d" % _free_port()
-    procs = []
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS",)}
-    for rank in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, worker, coord, "2", str(rank),
-             str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    outs = []
-    for rank, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-        assert p.returncode == 0, \
-            "worker %d failed:\n%s" % (rank, out[-4000:])
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local",
+         sys.executable, worker, "--from-env", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, \
+        "launch failed:\n%s" % (res.stdout[-3000:] + res.stderr[-3000:])
 
     for rank in range(2):
         with open(str(tmp_path / ("result_rank%d.json" % rank))) as f:
@@ -128,7 +119,7 @@ def _run_async_pair(tmp_path, mode):
             for q in procs:
                 q.kill()
             raise
-            assert p.returncode == 0, \
+        assert p.returncode == 0, \
             "async worker %d failed:\n%s" % (rank, out[-4000:])
     res = []
     for rank in range(2):
@@ -191,3 +182,92 @@ def test_launcher_quickstart_synchronizes(tmp_path):
     assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
     assert res.stdout.count("WORKER_OK") == 2, res.stdout + res.stderr
     assert "no parameter servers" in res.stderr  # -s parity warning
+
+
+def test_launcher_failure_propagation(tmp_path):
+    """dmlc-tracker semantics: a worker dying non-zero must tear down
+    the rest of the job (a dead rank otherwise hangs every peer at its
+    next collective) and the launcher's rc must be non-zero."""
+    import time
+
+    script = tmp_path / "crash_or_hang.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['MXNET_WORKER_ID'] == '0':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(300)\n")
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120)
+    dt = time.monotonic() - t0
+    assert res.returncode != 0
+    assert "tearing down" in res.stderr, res.stderr
+    assert dt < 60, "teardown did not propagate (took %.1fs)" % dt
+
+
+def test_launcher_gke_manifest(tmp_path):
+    """--launcher gke emits a kubectl-ready Indexed Job: N completions,
+    rank from the completion index, coordinator through the headless
+    Service — the modern dmlc-tracker yarn role."""
+    out_yaml = tmp_path / "job.yaml"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "16", "--launcher", "gke", "--gke-image", "img:latest",
+         "--gke-output", str(out_yaml),
+         "python", "train.py", "--epochs", "5"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    text = out_yaml.read_text()
+    assert "completionMode: Indexed" in text
+    assert "completions: 16" in text
+    assert "job-completion-index" in text
+    assert "MXNET_COORDINATOR" in text
+    assert '["python", "train.py", "--epochs", "5"]' in text
+
+
+def _run_staleness(tmp_path, mode, period, epochs=8, momentum=0.0):
+    worker = os.path.join(os.path.dirname(__file__),
+                          "staleness_worker.py")
+    coord = "127.0.0.1:%d" % _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, "2", str(rank), str(tmp_path),
+         mode, str(period), str(epochs), str(momentum)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in range(2)]
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, \
+            "staleness worker %d failed:\n%s" % (rank, out[-4000:])
+    tag = "%s_K%s" % (mode, period)
+    params = dict(np.load(str(tmp_path /
+                              ("staleness_%s_rank0.npz" % tag))))
+    with open(str(tmp_path / ("staleness_%s_rank0.json" % tag))) as f:
+        acc = json.load(f)["accuracy"]
+    return params, acc
+
+
+def test_dist_async_k1_matches_sync(tmp_path):
+    """The staleness-sweep anchor (VERDICT r4 item 8): with momentum=0,
+    dist_async at averaging period K=1 IS dist_tpu_sync — averaging
+    parameters after one local SGD step equals applying the averaged
+    gradient — so final params must match to float tolerance."""
+    sync_p, sync_acc = _run_staleness(tmp_path, "sync", 0)
+    async_p, async_acc = _run_staleness(tmp_path, "async", 1)
+    assert sync_acc > 0.9 and async_acc > 0.9, (sync_acc, async_acc)
+    # identity holds exactly per step (verified: one update matches to
+    # 0.0); over 8 epochs the two reduction orders (grad-sum allreduce
+    # vs param-mean allgather) accumulate float drift ~1e-3 through the
+    # BN nonlinearity, hence the tolerance
+    for k in sync_p:
+        np.testing.assert_allclose(
+            async_p[k], sync_p[k], rtol=1e-2, atol=2e-3,
+            err_msg="K=1 async diverges from sync on %s" % k)

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""dist_async staleness sweep (VERDICT r4 item 8): run the two-process
+CIFAR-shaped rig at averaging period K in {1, 4, 16} plus the
+dist_tpu_sync baseline, and print final accuracy + parameter divergence
+from sync for each.  The committed results live in docs/distributed.md.
+
+    python tools/async_staleness_sweep.py [--epochs 8] [--momentum 0.9]
+"""
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_pair(tmp, mode, period, epochs, momentum):
+    worker = os.path.join(REPO, "tests", "staleness_worker.py")
+    coord = "127.0.0.1:%d" % _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, "2", str(rank), tmp, mode,
+         str(period), str(epochs), str(momentum)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in range(2)]
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=420)
+        if p.returncode != 0:
+            raise SystemExit("worker %d failed:\n%s" % (rank, out[-3000:]))
+    tag = "%s_K%s" % (mode, period)
+    params = dict(np.load(os.path.join(tmp,
+                                       "staleness_%s_rank0.npz" % tag)))
+    with open(os.path.join(tmp, "staleness_%s_rank0.json" % tag)) as f:
+        acc = json.load(f)["accuracy"]
+    return params, acc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    args = ap.parse_args()
+    tmp = tempfile.mkdtemp()
+    sync_p, sync_acc = run_pair(tmp, "sync", 0, args.epochs,
+                                args.momentum)
+    print("%-10s acc %.4f  (baseline)" % ("sync", sync_acc))
+    rows = []
+    for k in (1, 4, 16):
+        p, acc = run_pair(tmp, "async", k, args.epochs, args.momentum)
+        div = max(float(np.abs(p[n] - sync_p[n]).max()) for n in sync_p)
+        rel = max(float(np.abs(p[n] - sync_p[n]).max()
+                        / (np.abs(sync_p[n]).max() + 1e-8))
+                  for n in sync_p)
+        rows.append((k, acc, div, rel))
+        print("%-10s acc %.4f  max|dw| %.4f  max rel %.3f"
+              % ("async K=%d" % k, acc, div, rel))
+    print(json.dumps({"sync_acc": sync_acc,
+                      "sweep": [{"K": k, "acc": a, "max_dw": d,
+                                 "max_rel": r} for k, a, d, r in rows]}))
+
+
+if __name__ == "__main__":
+    main()
