@@ -10,7 +10,7 @@ img/s metric.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Usage: bench.py [batch] [--fp32] [--sweep]
+Usage: bench.py [batch] [--fp32] [--sweep] [--piped (longer run) | --no-piped]
 """
 import json
 import sys
@@ -259,17 +259,29 @@ def main():
 
     # end-to-end fed benchmark: the same step consuming ImageRecordIter
     # batches decoded from a real .rec (reference numbers are all
-    # pipeline-fed); --piped only, it costs a one-time JPEG pack
-    if "--piped" in sys.argv:
+    # pipeline-fed); on by default (one timed epoch — the JSON carries
+    # the decode-rate and h2d-bandwidth diagnosis either way), disable
+    # with --no-piped.  The feeder emits NCHW fp32, so the piped row is
+    # NCHW-only; fp32 mode has no piped row (the piped step is the bf16
+    # headline config) — both skips are marked in the JSON.
+    want_piped = "--no-piped" not in sys.argv and \
+        ("--resnet-only" not in sys.argv or "--piped" in sys.argv)
+    if want_piped and (fp32 or layout != "NCHW"):
+        result["piped_skipped"] = "fp32 run" if fp32 else \
+            "piped feeder is NCHW-only"
+        want_piped = False
+    if want_piped:
         try:
             step = TrainStep(
                 sym, optimizer="sgd",
                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                                   "rescale_grad": 1.0 / batch},
                 compute_dtype=compute_dtype)
+            piped_iters = 20 if "--piped" in sys.argv else 4
             piped_s, mb_s, dec_s, put_mb_s = _measure_piped(
                 step, {"data": (batch, 3, 224, 224),
-                       "softmax_label": (batch,)}, batch)
+                       "softmax_label": (batch,)}, batch,
+                iters=piped_iters)
             import os as _os
 
             result["piped_images_per_sec"] = round(piped_s, 2)

@@ -5,9 +5,14 @@ separate optimizer-update ops (SURVEY.md §3.1, fused update ops in
 ``src/operator/optimizer_op.cc``).  On TPU the whole thing — forward,
 backward, optimizer update, and (under a mesh) the gradient all-reduce —
 compiles into ONE XLA program with donated parameter buffers: zero host
-round-trips per step, maximal fusion, collectives overlapped with
-backward compute by XLA's scheduler.  This is what ``Module`` uses when
-``fit`` runs with a compiled step, and what bench.py measures.
+round-trips per step and maximal fusion (measured on the single real
+chip).  Under a multi-chip mesh the single-program form additionally
+lets XLA's scheduler overlap the gradient collectives with backward
+compute — design intent pending real-ICI measurement (this environment
+has one chip); the pod-side check is a profiler trace confirming
+all-reduce slots hide under the backward convolutions
+(docs/distributed.md "pending hardware" list).  This is what ``Module``
+uses when ``fit`` runs with a compiled step, and what bench.py measures.
 
 Any registered :class:`~mxnet_tpu.optimizer.Optimizer` that implements
 ``fused_update`` (all of the built-in family) compiles in; per-parameter

@@ -7,8 +7,9 @@ of Q/K/V over the mesh's 'seq' axis, and rotate K/V blocks around the
 ring with ``lax.ppermute`` while each device accumulates its queries'
 attention in flash-attention style (running max + running sum), so the
 full T×T score matrix never materializes and each hop's communication
-overlaps the current block's compute (Liu et al., Ring Attention, 2023 —
-public technique).
+is scheduled so it CAN overlap the current block's compute (Liu et al.,
+Ring Attention, 2023 — public technique; the overlap itself is a
+pending-real-ICI measurement, see ``ring_attention``'s docstring).
 
 Two entry points:
 
@@ -60,8 +61,13 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     sequence axis is sharded over ``axis_name``.  Each of the
     ``axis_size`` hops computes one (T_local x T_local) score block and
     rotates K/V to the next neighbor over ICI (``ppermute``), so peak
-    memory is O(T_local^2 / ring) per device and the transfer of hop
-    i+1 overlaps the matmul of hop i in XLA's schedule.
+    memory is O(T_local^2 / ring) per device.  Design intent (pending
+    real-ICI measurement — this environment has one chip): the hop
+    structure gives XLA's scheduler independent send/compute chains so
+    the transfer of hop i+1 CAN overlap the matmul of hop i; the
+    measurement to run on a pod is a profiler trace of one layer at
+    T_local >= 1024 checking ppermute slots hide under the score
+    matmuls (docs/distributed.md "pending hardware" list).
     """
     import jax
     import jax.numpy as jnp

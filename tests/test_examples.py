@@ -151,3 +151,31 @@ def test_example_neural_style():
     out = _run("examples/neural-style/neural_style.py", "--iters", "60")
     red = float(out.split("(")[-1].split("%")[0])
     assert red > 40, out
+
+
+def test_example_fgsm():
+    """FGSM adversary: the loss-gradient-sign direction must hurt far
+    more than random-sign noise at the same budget."""
+    out = _run("examples/adversary/fgsm.py")
+    parts = out.split("acc ")
+    clean, adv, rand = (float(parts[1].split()[0]),
+                        float(parts[2].split()[0]),
+                        float(parts[3].split()[0]))
+    assert clean > 0.95 and rand > 0.9, out
+    assert adv < rand - 0.15, out
+
+
+def test_example_autoencoder():
+    """3-unit bottleneck must beat rank-3 PCA (the data manifold is
+    nonlinear)."""
+    out = _run("examples/autoencoder/autoencoder.py",
+               "--num-epochs", "20")
+    ratio = float(out.split("ratio")[1].split()[0])
+    assert ratio < 0.6, out
+
+
+def test_example_bi_lstm_sort():
+    out = _run("examples/bi-lstm-sort/bi_lstm_sort.py",
+               "--num-epochs", "12", "--num-examples", "1024")
+    acc = float(out.split("sort accuracy")[1].split()[0])
+    assert acc > 0.9, out
