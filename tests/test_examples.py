@@ -179,3 +179,12 @@ def test_example_bi_lstm_sort():
                "--num-epochs", "12", "--num-examples", "1024")
     acc = float(out.split("sort accuracy")[1].split()[0])
     assert acc > 0.9, out
+
+
+def test_example_ctc_ocr():
+    """CTC sequence training (reference example/warpctc): alignment-
+    free digit-string OCR; greedy decode must recover exact strings."""
+    out = _run("examples/warpctc/ctc_ocr.py", "--num-epochs", "12",
+               "--num-examples", "768")
+    acc = float(out.split("exact-string accuracy")[1].split()[0])
+    assert acc > 0.85, out
