@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Custom operator written in NUMPY (reference ``example/numpy-ops/
+custom_softmax.py``): a user-defined softmax-loss op whose forward AND
+backward are plain numpy, registered through ``mx.operator.CustomOp``/
+``CustomOpProp`` and trained inside a symbolic graph via
+``mx.sym.Custom``.
+
+The numpy tier runs host-side through ``pure_callback``
+(``MXNET_CUSTOM_OP_CALLBACK=1`` forces it; device-traceable ops written
+with ``mx.nd`` stay on-chip — see ``examples/torch``).  Training must
+reach >0.95 accuracy, proving gradients flow through the host-side op.
+
+    python examples/numpy-ops/numpy_softmax.py
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # host callbacks need cpu
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    """Softmax + cross-entropy head, forward/backward in numpy
+    (reference ``custom_softmax.py`` shape)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        y = e / e.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        label = in_data[1].asnumpy().astype(np.int32)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(len(label)), label] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y / len(label)))
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    centers = rs.randn(3, 16).astype("float32") * 2.0
+    y = rs.randint(0, 3, args.num_examples).astype("float32")
+    X = centers[y.astype(int)] + 0.5 * rs.randn(args.num_examples,
+                                                16).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=64,
+                           label_name="softmax_label")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.Custom(fc, label, op_type="numpy_softmax",
+                        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    score = dict(mod.score(it, mx.metric.Accuracy()))
+    print("numpy-op accuracy %.4f" % score["accuracy"])
+    return score["accuracy"]
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-examples", type=int, default=512)
+    p.add_argument("--num-epochs", type=int, default=30)
+    main(p.parse_args())

@@ -188,3 +188,19 @@ def test_example_ctc_ocr():
                "--num-examples", "768")
     acc = float(out.split("exact-string accuracy")[1].split()[0])
     assert acc > 0.85, out
+
+
+def test_example_svm():
+    out = _run("examples/svm_mnist/svm_mnist.py", "--num-epochs", "20")
+    svm = float(out.split("svm acc")[1].split()[0])
+    sm = float(out.split("softmax acc")[1].split()[0])
+    assert svm > 0.95 and sm > 0.95, out
+
+
+def test_example_numpy_ops():
+    """Reference example/numpy-ops: a CustomOp whose forward AND
+    backward are plain numpy trains inside a symbolic graph."""
+    out = _run("examples/numpy-ops/numpy_softmax.py",
+               "--num-epochs", "25")
+    acc = float(out.split("numpy-op accuracy")[1].split()[0])
+    assert acc > 0.95, out
