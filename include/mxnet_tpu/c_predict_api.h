@@ -52,6 +52,36 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
 
 int MXPredFree(PredictorHandle handle);
 
+/* Like MXPredCreate but exposing the named INTERNAL outputs (feature
+ * extraction; reference MXPredCreatePartialOut). */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys,
+                           PredictorHandle *out);
+
+/* Reference stepping contract.  The bound graph is ONE compiled XLA
+ * program (no node boundaries), so the full forward runs at step 0 and
+ * *step_left is always 0 afterwards. */
+int MXPredPartialForward(PredictorHandle handle, int step,
+                         int *step_left);
+
+/* ---- NDList: serialized ndarray collections (mean image files) ------- */
+typedef void *NDListHandle;
+/* Parse an nd.save container blob; entries are (key, float data, shape).
+ * Data/shape/key pointers are list-owned (valid until MXNDListFree). */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+int MXNDListFree(NDListHandle handle);
+
 #ifdef __cplusplus
 }
 #endif

@@ -23,7 +23,7 @@ class Predictor:
     / ``MXPredGetOutput``)."""
 
     def __init__(self, symbol_json, param_bytes_or_dict, input_shapes,
-                 ctx=None):
+                 ctx=None, output_keys=None):
         from . import symbol as sym_mod
         from .ndarray import NDArray
 
@@ -31,6 +31,21 @@ class Predictor:
             self._symbol = sym_mod.load_json(symbol_json)
         else:
             self._symbol = symbol_json
+        if output_keys:
+            # reference MXPredCreatePartialOut: expose named INTERNAL
+            # outputs instead of the symbol's heads
+            internals = self._symbol.get_internals()
+            names = internals.list_outputs()
+            picked = []
+            for key in output_keys:
+                matches = [i for i, n in enumerate(names)
+                           if n == key or n == key + "_output"]
+                if not matches:
+                    raise MXNetError(
+                        "output key %r not found among internals" % key)
+                picked.append(internals[matches[-1]])
+            self._symbol = picked[0] if len(picked) == 1 else \
+                sym_mod.Group(picked)
         if isinstance(param_bytes_or_dict, (bytes, bytearray)):
             params = self._load_param_bytes(bytes(param_bytes_or_dict))
         else:
@@ -237,3 +252,27 @@ class ExportedPredictor:
         if self._outputs is None:
             raise MXNetError("call forward() before get_output()")
         return self._outputs[index]
+
+
+def _load_nd_list_bytes(blob):
+    """C-ABI helper (MXNDListCreate): parse an ``nd.save`` container
+    blob into [(name, shape_tuple, flat_float_list), ...] — the
+    deployment mean-image artifact the reference's NDList carries."""
+    import io
+
+    import numpy as np
+
+    out = []
+    with np.load(io.BytesIO(blob), allow_pickle=False) as f:
+        keys = list(f.keys())
+        if keys and all(k.startswith("__list_") for k in keys):
+            ordered = sorted(keys, key=lambda s: int(s.split("_")[-1]))
+            names = [""] * len(ordered)
+        else:
+            ordered = keys
+            names = keys
+        for name, key in zip(names, ordered):
+            arr = np.asarray(f[key], np.float32)
+            out.append((name, tuple(int(d) for d in arr.shape),
+                        [float(x) for x in arr.ravel()]))
+    return out

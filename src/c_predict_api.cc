@@ -29,6 +29,7 @@
 
 typedef unsigned int mx_uint;
 typedef void *PredictorHandle;
+typedef void *NDListHandle;
 
 struct MXPredictor {
   PyObject *predictor;              // mxnet_tpu.predictor.Predictor
@@ -236,6 +237,179 @@ int MXPredFree(PredictorHandle handle) {
     PyGILState_Release(g);
     delete h;
   }
+  return 0;
+}
+
+// Reference MXPredCreatePartialOut: like MXPredCreate, but exposing the
+// named INTERNAL outputs (feature extraction from a trained net).
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys,
+                           PredictorHandle *out) {
+  g_last_error.clear();
+  (void)dev_type;
+  (void)dev_id;
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ret = -1;
+  PyObject *mod = nullptr, *cls = nullptr, *shapes = nullptr,
+           *params = nullptr, *pred = nullptr, *json = nullptr,
+           *keys = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (!mod) break;
+    cls = PyObject_GetAttrString(mod, "Predictor");
+    if (!cls) break;
+    shapes = PyDict_New();
+    for (mx_uint i = 0; i < num_input_nodes; ++i) {
+      mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject *shp = PyTuple_New(hi - lo);
+      for (mx_uint j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(shp, j - lo,
+                         PyLong_FromUnsignedLong(input_shape_data[j]));
+      PyDict_SetItemString(shapes, input_keys[i], shp);
+      Py_DECREF(shp);
+    }
+    keys = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SET_ITEM(keys, i, PyUnicode_FromString(output_keys[i]));
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char *>(param_bytes), param_size);
+    json = PyUnicode_FromString(symbol_json_str);
+    if (!params || !json) break;
+    pred = PyObject_CallFunctionObjArgs(cls, json, params, shapes,
+                                        Py_None, keys, NULL);
+    if (!pred) break;
+    MXPredictor *h = new MXPredictor();
+    h->predictor = pred;
+    pred = nullptr;
+    *out = h;
+    ret = 0;
+  } while (false);
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(keys);
+  Py_XDECREF(pred);
+  Py_XDECREF(json);
+  Py_XDECREF(params);
+  Py_XDECREF(shapes);
+  Py_XDECREF(cls);
+  Py_XDECREF(mod);
+  PyGILState_Release(g);
+  return ret;
+}
+
+// Reference MXPredPartialForward: step through the graph node by node.
+// Under XLA the bound graph is ONE compiled program with no node
+// boundaries, so the whole forward runs at step 0 and *step_left
+// reports 0 — the honest mapping of the stepping contract.
+int MXPredPartialForward(PredictorHandle handle, int step,
+                         int *step_left) {
+  if (step <= 0) {
+    int rc = MXPredForward(handle);
+    if (rc != 0) return rc;
+  }
+  if (step_left) *step_left = 0;
+  return 0;
+}
+
+/* ---- NDList: serialized ndarray collections (mean image files) ------- */
+
+struct NDList {
+  PyObject *obj;                    // list of (name, NDArray) pairs
+  std::vector<std::string> names;
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<std::vector<float>> datas;
+};
+
+// Reference MXNDListCreate: parse an ndarray-list file blob (the
+// mean.nd deployment artifact; here the nd.save .npz container).
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  g_last_error.clear();
+  if (!ensure_python()) {
+    set_error("python initialization failed");
+    return -1;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  int ret = -1;
+  PyObject *mod = nullptr, *fn = nullptr, *bytes = nullptr,
+           *res = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (!mod) break;
+    fn = PyObject_GetAttrString(mod, "_load_nd_list_bytes");
+    if (!fn) break;
+    bytes = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+    if (!bytes) break;
+    res = PyObject_CallFunctionObjArgs(fn, bytes, NULL);
+    if (!res) break;
+    NDList *h = new NDList();
+    h->obj = nullptr;
+    Py_ssize_t n = PyList_Size(res);
+    bool ok = true;
+    for (Py_ssize_t i = 0; i < n && ok; ++i) {
+      PyObject *item = PyList_GetItem(res, i);       // (name, shape,
+      PyObject *nm = PyTuple_GetItem(item, 0);       //  flat float list)
+      PyObject *shp = PyTuple_GetItem(item, 1);
+      PyObject *dat = PyTuple_GetItem(item, 2);
+      h->names.push_back(PyUnicode_AsUTF8(nm));
+      std::vector<mx_uint> sv;
+      for (Py_ssize_t j = 0; j < PyTuple_Size(shp); ++j)
+        sv.push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
+      h->shapes.push_back(sv);
+      Py_ssize_t dn = PySequence_Size(dat);
+      std::vector<float> dv(dn);
+      for (Py_ssize_t j = 0; j < dn; ++j)
+        dv[j] = static_cast<float>(
+            PyFloat_AsDouble(PySequence_GetItem(dat, j)));
+      h->datas.push_back(std::move(dv));
+      ok = !PyErr_Occurred();
+    }
+    if (!ok) {
+      delete h;
+      break;
+    }
+    *out = h;
+    *out_length = static_cast<mx_uint>(n);
+    ret = 0;
+  } while (false);
+  if (ret != 0 && PyErr_Occurred()) set_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(bytes);
+  Py_XDECREF(fn);
+  Py_XDECREF(mod);
+  PyGILState_Release(g);
+  return ret;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  g_last_error.clear();
+  NDList *h = static_cast<NDList *>(handle);
+  if (!h || index >= h->names.size()) {
+    set_error("MXNDListGet: index out of range");
+    return -1;
+  }
+  *out_key = h->names[index].c_str();
+  *out_data = h->datas[index].data();
+  *out_shape = h->shapes[index].data();
+  *out_ndim = static_cast<mx_uint>(h->shapes[index].size());
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDList *>(handle);
   return 0;
 }
 
