@@ -433,6 +433,8 @@ def _fill_param_shapes(node, env, shapes):
 
     op = node.op.name
     a = node.attrs
+    if not node.inputs:
+        return  # source ops (random_uniform etc.) have no data input
     data = in_shape(0)
     if data is None:
         return

@@ -204,3 +204,13 @@ def test_example_numpy_ops():
                "--num-epochs", "25")
     acc = float(out.split("numpy-op accuracy")[1].split()[0])
     assert acc > 0.95, out
+
+
+def test_example_stochastic_depth():
+    """Reference example/stochastic-depth: per-sample residual-branch
+    Bernoulli gates from symbolic random_uniform; inference graph with
+    expectation scaling shares the trained parameters."""
+    out = _run("examples/stochastic-depth/stochastic_depth.py",
+               "--num-epochs", "10")
+    acc = float(out.split("val accuracy")[1].split()[0])
+    assert acc > 0.9, out

@@ -210,3 +210,18 @@ def test_attr_scope_reaches_parameters_and_optimizer():
     with mx.AttrScope(ctx_group="g7"):
         v = mx.sym.Variable("vv")
     assert v.attr_dict()["vv"]["ctx_group"] == "g7"
+
+
+def test_infer_shapes_with_source_ops():
+    """Zero-input source ops (symbolic random_uniform) inside a graph
+    must not break parameter shape inference (round-5 regression: the
+    stochastic-depth gate pattern)."""
+    x = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    gate = mx.sym.random_uniform(low=0.0, high=1.0, shape=(8, 4))
+    out = mx.sym.broadcast_mul(fc, gate)
+    out = mx.sym.SoftmaxOutput(out, name="softmax")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(8, 6))
+    names = out.list_arguments()
+    assert arg_shapes[names.index("fc_weight")] == (4, 6)
+    assert out_shapes[0] == (8, 4)
