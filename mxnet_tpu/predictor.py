@@ -256,23 +256,32 @@ class ExportedPredictor:
 
 def _load_nd_list_bytes(blob):
     """C-ABI helper (MXNDListCreate): parse an ``nd.save`` container
-    blob into [(name, shape_tuple, flat_float_list), ...] — the
-    deployment mean-image artifact the reference's NDList carries."""
+    blob into [(name, shape_tuple, float32_bytes), ...] — the
+    deployment mean-image artifact the reference's NDList carries.
+    Data rides as raw bytes (one memcpy on the C side, no per-element
+    boxing); container parsing delegates to ``nd.load`` so the two
+    paths can never drift."""
     import io
 
     import numpy as np
 
+    import tempfile
+
+    from .ndarray import load as nd_load
+
+    # nd.load owns the container format ('__list_N' vs dict keys); feed
+    # it through a temp file since np.load-on-path is its contract
+    with tempfile.NamedTemporaryFile(suffix=".npz") as tf:
+        tf.write(blob)
+        tf.flush()
+        loaded = nd_load(tf.name)
+    if isinstance(loaded, dict):
+        items = list(loaded.items())
+    else:
+        items = [("", v) for v in loaded]
     out = []
-    with np.load(io.BytesIO(blob), allow_pickle=False) as f:
-        keys = list(f.keys())
-        if keys and all(k.startswith("__list_") for k in keys):
-            ordered = sorted(keys, key=lambda s: int(s.split("_")[-1]))
-            names = [""] * len(ordered)
-        else:
-            ordered = keys
-            names = keys
-        for name, key in zip(names, ordered):
-            arr = np.asarray(f[key], np.float32)
-            out.append((name, tuple(int(d) for d in arr.shape),
-                        [float(x) for x in arr.ravel()]))
+    for name, nd in items:
+        arr = np.ascontiguousarray(nd.asnumpy(), np.float32)
+        out.append((name, tuple(int(d) for d in arr.shape),
+                    arr.tobytes()))
     return out

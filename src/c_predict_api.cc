@@ -309,14 +309,15 @@ int MXPredCreatePartialOut(const char *symbol_json_str,
 
 // Reference MXPredPartialForward: step through the graph node by node.
 // Under XLA the bound graph is ONE compiled program with no node
-// boundaries, so the whole forward runs at step 0 and *step_left
-// reports 0 — the honest mapping of the stepping contract.
+// boundaries, so EVERY entry step value runs the whole forward and
+// *step_left reports 0 — the honest mapping of the stepping contract
+// (a reference client looping "while (step_left) PartialForward(++step)"
+// terminates after one call with complete outputs).
 int MXPredPartialForward(PredictorHandle handle, int step,
                          int *step_left) {
-  if (step <= 0) {
-    int rc = MXPredForward(handle);
-    if (rc != 0) return rc;
-  }
+  (void)step;
+  int rc = MXPredForward(handle);
+  if (rc != 0) return rc;
   if (step_left) *step_left = 0;
   return 0;
 }
@@ -324,7 +325,6 @@ int MXPredPartialForward(PredictorHandle handle, int step,
 /* ---- NDList: serialized ndarray collections (mean image files) ------- */
 
 struct NDList {
-  PyObject *obj;                    // list of (name, NDArray) pairs
   std::vector<std::string> names;
   std::vector<std::vector<mx_uint>> shapes;
   std::vector<std::vector<float>> datas;
@@ -353,12 +353,11 @@ int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
     res = PyObject_CallFunctionObjArgs(fn, bytes, NULL);
     if (!res) break;
     NDList *h = new NDList();
-    h->obj = nullptr;
     Py_ssize_t n = PyList_Size(res);
     bool ok = true;
     for (Py_ssize_t i = 0; i < n && ok; ++i) {
       PyObject *item = PyList_GetItem(res, i);       // (name, shape,
-      PyObject *nm = PyTuple_GetItem(item, 0);       //  flat float list)
+      PyObject *nm = PyTuple_GetItem(item, 0);       //  float32 bytes)
       PyObject *shp = PyTuple_GetItem(item, 1);
       PyObject *dat = PyTuple_GetItem(item, 2);
       h->names.push_back(PyUnicode_AsUTF8(nm));
@@ -367,11 +366,15 @@ int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
         sv.push_back(static_cast<mx_uint>(
             PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
       h->shapes.push_back(sv);
-      Py_ssize_t dn = PySequence_Size(dat);
-      std::vector<float> dv(dn);
-      for (Py_ssize_t j = 0; j < dn; ++j)
-        dv[j] = static_cast<float>(
-            PyFloat_AsDouble(PySequence_GetItem(dat, j)));
+      // one memcpy from the bytes object — no per-element boxing
+      char *buf = nullptr;
+      Py_ssize_t blen = 0;
+      if (PyBytes_AsStringAndSize(dat, &buf, &blen) != 0) {
+        ok = false;
+        break;
+      }
+      std::vector<float> dv(blen / sizeof(float));
+      std::memcpy(dv.data(), buf, dv.size() * sizeof(float));
       h->datas.push_back(std::move(dv));
       ok = !PyErr_Occurred();
     }
