@@ -214,3 +214,18 @@ def test_example_stochastic_depth():
                "--num-epochs", "10")
     acc = float(out.split("val accuracy")[1].split()[0])
     assert acc > 0.9, out
+
+
+def test_example_vae():
+    """VAE: reparameterized sampling inside the graph (random_normal
+    source op), KL via MakeLoss, generation by binding the decoder
+    subgraph on prior samples."""
+    out = _run("examples/vae/vae.py", "--num-epochs", "25",
+               "--num-examples", "512")
+    mse = float(out.split("recon mse")[1].split()[0])
+    peak = float(out.split("sample peak")[1].split()[0])
+    dark = float(out.split("median")[1].split()[0])
+    div = float(out.split("diversity")[1].split()[0])
+    assert mse < 0.03, out
+    assert peak > 0.5 and dark < 0.3, out     # blob-like samples
+    assert div > 0.02, out                    # no posterior collapse
