@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Memory-cost study (reference ``example/memcost/``: measure the
+training memory saved by gradient mirroring/recomputation).
+
+TPU-native form: ask XLA itself — compile the fused train step under
+each remat setting and read the program's activation (temp) memory from
+``compiled.memory_analysis()``.  The measured story DIFFERS from the
+reference's engine by design: XLA already plans conv-net memory, so on
+ResNet-50 NO checkpoint policy reduces temp memory (full remat costs
++3%) — matching the README round-2 finding that mirroring is correctly
+not the default here.  The win case is the transformer, where
+``remat='dots_saveable'`` (save matmul outputs, recompute elementwise)
+cuts activation memory ~23% (measured 5.9 GB -> 4.5 GB at
+8L-d1024-T1024 bs8 on v5e).
+
+    python examples/memcost/memcost.py --model resnet --batch 64
+    python examples/memcost/memcost.py --model transformer
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def measure(remat, model, num_layers, batch, image):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.fused import TrainStep
+    from mxnet_tpu.models import resnet, transformer
+
+    if model == "transformer":
+        sym = transformer.get_symbol(vocab_size=8192, num_layers=8,
+                                     d_model=1024, num_heads=16,
+                                     seq_len=1024)
+        shapes = {"data": (batch, 1024),
+                  "softmax_label": (batch, 1024)}
+    else:
+        sym = resnet.get_symbol(num_classes=1000,
+                                num_layers=num_layers,
+                                image_shape=(3, image, image))
+        shapes = {"data": (batch, 3, image, image),
+                  "softmax_label": (batch,)}
+    step = TrainStep(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     compute_dtype="bfloat16",
+                     remat=remat)
+    params, aux, states = step.init_state(shapes)
+    batch_dict = {k: jnp.zeros(v, "float32") for k, v in shapes.items()}
+    lowered = step._jit_step.lower(params, aux, states, batch_dict,
+                                  jax.random.PRNGKey(0), step.lr,
+                                  jnp.asarray(1, "int32"))
+    mem = lowered.compile().memory_analysis()
+    return {
+        "temp_mb": round(getattr(mem, "temp_size_in_bytes", 0) / 2**20,
+                         1),
+        "peak_mb": round((getattr(mem, "temp_size_in_bytes", 0)
+                          + getattr(mem, "argument_size_in_bytes", 0)
+                          + getattr(mem, "output_size_in_bytes", 0))
+                         / 2**20, 1),
+    }
+
+
+def main(args):
+    rows = []
+    for name, remat in (("none", None), ("full", "full"),
+                        ("dots_saveable", "dots_saveable")):
+        m = measure(remat, args.model, args.num_layers, args.batch,
+                    args.image)
+        rows.append((name, m))
+        print("remat=%-14s temp(activations) %.1f MB  peak %.1f MB"
+              % (name, m["temp_mb"], m["peak_mb"]))
+    base = rows[0][1]["temp_mb"]
+    best = min(rows[1:], key=lambda r: r[1]["temp_mb"])
+    print("best policy %r saves %.0f%% of activation temp vs none "
+          "(reference mirror: 30-50%% at ~5%% speed)"
+          % (best[0], 100 * (1 - best[1]["temp_mb"] / max(base, 1e-9))))
+    return rows
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=("resnet", "transformer"),
+                   default="resnet")
+    p.add_argument("--num-layers", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--image", type=int, default=224)
+    main(p.parse_args())
