@@ -53,13 +53,15 @@ def measure(argv=None):
                    num_heads=16, seq_len=1024)
     batch = 2 if small else int(next((a.split("=")[1] for a in argv
         if a.startswith("--batch=")), 8))
+    remat = next((a.split("=")[1] for a in argv
+                  if a.startswith("--remat=")), None)
 
     sym = transformer.get_symbol(**cfg)
     step = TrainStep(sym, optimizer="sgd",
                      optimizer_params={"learning_rate": 1e-3,
                                        "momentum": 0.9,
                                        "rescale_grad": 1.0 / batch},
-                     compute_dtype="bfloat16")
+                     compute_dtype="bfloat16", remat=remat)
     shapes = {"data": (batch, cfg["seq_len"]),
               "softmax_label": (batch, cfg["seq_len"])}
     params, aux, states = step.init_state(shapes)
