@@ -78,6 +78,9 @@ def synth_batch(n, size, rs):
 
 
 def main(args):
+    # initializers draw from the process-global rng; seed for reproducible CI
+    mx.random.seed(0)
+    np.random.seed(0)
     rs = np.random.RandomState(0)
     imgs, labels = synth_batch(args.num_examples, args.size, rs)
     it = mx.io.NDArrayIter(imgs, labels, batch_size=args.batch_size)

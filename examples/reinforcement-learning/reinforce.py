@@ -59,6 +59,9 @@ class ChainEnv:
 
 
 def main(args):
+    # initializers draw from the process-global rng; seed for reproducible CI
+    mx.random.seed(0)
+    np.random.seed(0)
     rs = np.random.RandomState(0)
     env = ChainEnv(args.n_envs)
     w1 = mx.nd.array(rs.randn(16, env.n_states).astype("float32") * 0.3)

@@ -56,6 +56,9 @@ def train(head, X, y, epochs):
 
 
 def main(args):
+    # initializers draw from the process-global rng; seed for reproducible CI
+    mx.random.seed(0)
+    np.random.seed(0)
     rs = np.random.RandomState(0)
     X, y = synth(args.num_examples, rs)
     svm_acc = train("svm", X, y, args.num_epochs)
