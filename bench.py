@@ -9,14 +9,47 @@ and %MFU against the chip's bf16 peak alongside the reference-comparable
 img/s metric.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Partial snapshots stream to stderr after each phase, and a watchdog
+(``--watchdog SEC`` / env ``MXTPU_BENCH_WATCHDOG``, default 900, 0 to
+disable) prints the partial line to stdout and exits if the run wedges.
 
-Usage: bench.py [batch] [--fp32] [--sweep] [--piped (longer run) | --no-piped]
+Usage: bench.py [batch] [--fp32] [--sweep] [--piped (opt-in long run)]
+                [--watchdog SEC]
 """
 import json
+import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, ".")
+
+# the run's (partial) result — filled in phase by phase so a watchdog
+# fire or an operator reading stderr mid-run still gets a usable line
+_RESULT = {}
+
+
+def _emit_partial():
+    """Progress snapshot to stderr after each phase (stdout stays ONE
+    final JSON line)."""
+    print(json.dumps({"partial": True, **_RESULT}), file=sys.stderr,
+          flush=True)
+
+
+def _arm_watchdog(seconds):
+    """If the run wedges (a hung device tunnel mid-phase), print the
+    partial result line to stdout and hard-exit instead of producing
+    nothing."""
+    def fire():
+        _RESULT["partial"] = True
+        _RESULT["watchdog_timeout_sec"] = seconds
+        print(json.dumps(_RESULT), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 # fwd+bwd model FLOPs per 224x224 image for ResNet-50 under the standard
 # MFU convention (multiply-add = 2 FLOPs, the same convention as the
@@ -190,7 +223,18 @@ def main():
     from mxnet_tpu.models import resnet
     from mxnet_tpu.fused import TrainStep
 
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = sys.argv[1:]
+    watchdog_s = None
+    if "--watchdog" in argv:
+        i = argv.index("--watchdog")
+        watchdog_s = float(argv[i + 1])
+        del argv[i:i + 2]
+    if watchdog_s is None:
+        watchdog_s = float(os.environ.get("MXTPU_BENCH_WATCHDOG", "900"))
+    if watchdog_s > 0:
+        _arm_watchdog(watchdog_s)
+
+    args = [a for a in argv if not a.startswith("--")]
     fp32 = "--fp32" in sys.argv
     compute_dtype = None if fp32 else "bfloat16"
     batches = [int(args[0])] if args else [512]
@@ -198,6 +242,10 @@ def main():
         batches = sorted(set(batches) | {64, 128, 256, 512})
 
     layout = "NHWC" if "--nhwc" in sys.argv else "NCHW"
+    result = _RESULT
+    result["metric"] = "resnet50_train_images_per_sec_per_chip"
+    result["precision"] = "float32" if fp32 else "bf16+fp32-master"
+    result["layout"] = layout
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224), layout=layout)
     best = (0.0, None, None)
@@ -211,6 +259,8 @@ def main():
             else (batch, 224, 224, 3)
         shapes = {"data": dshape, "softmax_label": (batch,)}
         img_s, xla_flops = _measure(step, shapes, batch)
+        result.setdefault("sweep", {})[str(batch)] = round(img_s, 2)
+        _emit_partial()
         if img_s > best[0]:
             best = (img_s, batch, xla_flops)
 
@@ -221,20 +271,18 @@ def main():
     # MFU only for the bf16 path
     peak = None if fp32 else _peak_flops(jax.devices()[0])
     baseline = 109.0  # K80 bs32 train img/s, BASELINE.md
-    result = {
-        "metric": "resnet50_train_images_per_sec_per_chip",
+    result.update({
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / baseline, 2),
         "batch_size": batch,
-        "precision": "float32" if fp32 else "bf16+fp32-master",
-        "layout": layout,
         "achieved_tflops": round(achieved / 1e12, 2),
         "flops_accounting": "xla_cost_analysis" if xla_flops
                             else "analytic_mac2",
         "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
-    }
+    })
+    _emit_partial()
     # the BASELINE distributed-scaling flagships (docs/how_to/
     # perf.md:157-167: alexnet bs256 483.37 img/s, inception-v3 bs32
     # 29.62 img/s on K80) — single-chip rows so BENCH anchors more than
@@ -256,16 +304,18 @@ def main():
             result["inception_v3_vs_baseline"] = round(inc_s / 29.62, 2)
         except Exception as exc:  # keep the primary metric robust
             result["secondary_model_error"] = str(exc)[:200]
+        _emit_partial()
 
     # end-to-end fed benchmark: the same step consuming ImageRecordIter
     # batches decoded from a real .rec (reference numbers are all
-    # pipeline-fed); on by default (one timed epoch — the JSON carries
-    # the decode-rate and h2d-bandwidth diagnosis either way), disable
-    # with --no-piped.  The feeder emits NCHW fp32, so the piped row is
-    # NCHW-only; fp32 mode has no piped row (the piped step is the bf16
-    # headline config) — both skips are marked in the JSON.
-    want_piped = "--no-piped" not in sys.argv and \
-        ("--resnet-only" not in sys.argv or "--piped" in sys.argv)
+    # pipeline-fed).  OPT-IN via --piped: it generates a 2048-image .rec
+    # on first use and runs whole epochs, which is the long pole of the
+    # run and the usual place a wedged tunnel strands the whole result
+    # (the watchdog bounds it either way).  The feeder emits NCHW fp32,
+    # so the piped row is NCHW-only; fp32 mode has no piped row (the
+    # piped step is the bf16 headline config) — skips are marked in the
+    # JSON.
+    want_piped = "--piped" in sys.argv and "--no-piped" not in sys.argv
     if want_piped and (fp32 or layout != "NCHW"):
         result["piped_skipped"] = "fp32 run" if fp32 else \
             "piped feeder is NCHW-only"
@@ -277,7 +327,7 @@ def main():
                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                                   "rescale_grad": 1.0 / batch},
                 compute_dtype=compute_dtype)
-            piped_iters = 20 if "--piped" in sys.argv else 4
+            piped_iters = 20
             piped_s, mb_s, dec_s, put_mb_s = _measure_piped(
                 step, {"data": (batch, 3, 224, 224),
                        "softmax_label": (batch,)}, batch,
@@ -296,6 +346,7 @@ def main():
                 "h2d-transfer" if xfer_img_s < dec_s else "host-decode")
         except Exception as exc:
             result["piped_error"] = str(exc)[:200]
+        _emit_partial()
 
     # secondary metric: the MXU-bound transformer workload, where the
     # framework's compute ceiling shows (ResNet-50@224 is HBM-bound on

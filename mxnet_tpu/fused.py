@@ -31,6 +31,10 @@ Extra TPU-first knobs the reference exposes differently:
   ``src/executor/graph_executor.cc:273-296``): ``'full'`` recomputes all
   activations in the backward, or pass a named jax checkpoint policy
   (e.g. ``'dots_with_no_batch_dims_saveable'``).
+* ``steps_per_call=K`` — multi-step dispatch: ``__call__`` takes a
+  ``(K, batch, …)`` super-batch and ``lax.scan``s K donated updates in
+  ONE device call, amortizing Python dispatch for small models (fed by
+  ``io.DevicePrefetchIter(steps_per_call=K)``; see docs/performance.md).
 """
 from __future__ import annotations
 
@@ -80,7 +84,8 @@ class TrainStep:
                  mesh=None, data_names=("data",),
                  label_names=("softmax_label",), dtype="float32",
                  batch_sharding_axis="data", compute_dtype=None,
-                 remat=None, fixed_param_names=(), param_sharding=None):
+                 remat=None, fixed_param_names=(), param_sharding=None,
+                 steps_per_call=1):
         import jax
         import jax.numpy as jnp
 
@@ -169,6 +174,35 @@ class TrainStep:
             # a batch-sharded prefix sharding covers the whole tuple
             return new_params, new_aux, new_states, outs
 
+        K = int(steps_per_call)
+        if K < 1:
+            raise MXNetError("steps_per_call must be >= 1, got %d" % K)
+        self._steps_per_call = K
+        if K > 1:
+            # multi-step dispatch: one device call scans K donated
+            # updates over a (K, batch, …) super-batch — Python dispatch
+            # and launch overhead amortize K-fold (the win for small
+            # models where per-step host work rivals device time).  lr is
+            # held constant across the K inner steps (the scheduler is
+            # consulted once per call); t advances per inner step so
+            # bias-corrected optimizers stay exact; the per-call rng is
+            # folded with the inner step index so dropout masks differ
+            # per step.  Outputs come back stacked (K, batch, …).
+            base_step = step
+
+            def step(params, aux, states, batch, rng, lr, t):
+                def body(carry, xs):
+                    p, a, s, tk = carry
+                    bk, k = xs
+                    p, a, s, outs = base_step(
+                        p, a, s, bk, jax.random.fold_in(rng, k), lr, tk)
+                    return (p, a, s, tk + 1), outs
+
+                (params, aux, states, _), outs = jax.lax.scan(
+                    body, (params, aux, states, t),
+                    (batch, jnp.arange(K)))
+                return params, aux, states, outs
+
         self._step_fn = step
         self._batch_sharding_axis = batch_sharding_axis
         self._param_sharding = param_sharding
@@ -216,7 +250,10 @@ class TrainStep:
         # axis, so the batch stays replicated and the mesh axes are
         # consumed inside the ops (ring attention, MoE all_to_all)
         baxes = batch_axes(mesh, self._batch_sharding_axis)
-        bshard = named_sharding(mesh, baxes) if baxes else repl
+        # a packed super-batch carries an unsharded leading K axis; the
+        # batch dim (and the stacked outputs' step dim) sits behind it
+        lead = [None] if self._steps_per_call > 1 else []
+        bshard = named_sharding(mesh, *(lead + [baxes])) if baxes else repl
         if pshard is None:
             pshard = repl
         if sshard is None:
@@ -261,11 +298,12 @@ class TrainStep:
         import jax
         import jax.numpy as jnp
 
+        K = self._steps_per_call
         if t is None:
-            self._t += 1
-            t = self._t
+            self._t += K
+            t = self._t - K + 1  # first inner step's post-increment count
         else:
-            self._t = int(t)
+            self._t = int(t) + K - 1
         # Two input hygiene passes before the donated call:
         # 1. commit uncommitted arrays (jnp.zeros products) so the jit
         #    signature is identical on every step — no recompiles;

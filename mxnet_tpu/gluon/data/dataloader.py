@@ -1,6 +1,9 @@
 """DataLoader (reference ``python/mxnet/gluon/data/dataloader.py``)."""
 from __future__ import annotations
 
+import queue
+import threading
+
 import numpy as np
 
 from ...ndarray import NDArray, array
@@ -20,10 +23,67 @@ def default_batchify_fn(data):
     return array(data)
 
 
+class _Stop:
+    pass
+
+
+class _Raised:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _DevicePrefetchingIter:
+    """Background-thread device staging over a batch generator: batches
+    are ``device_put`` and *readied* on the worker (``block_until_ready``
+    runs here), so the training loop's ``next()`` hands back an array
+    whose h2d transfer already happened while the previous step ran."""
+
+    def __init__(self, source, depth, device):
+        import jax
+
+        self._jax = jax
+        self._device = device if device is not None else \
+            jax.local_devices()[0]
+        self._queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._thread = threading.Thread(
+            target=self._worker, args=(iter(source),), daemon=True)
+        self._thread.start()
+
+    def _stage(self, item):
+        jax = self._jax
+        if isinstance(item, NDArray):
+            out = NDArray(jax.device_put(item._data, self._device),
+                          item.context)
+            jax.block_until_ready(out._data)
+            return out
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._stage(x) for x in item)
+        return item
+
+    def _worker(self, it):
+        try:
+            for batch in it:
+                self._queue.put(self._stage(batch))
+        except Exception as exc:  # propagate to the consumer thread
+            self._queue.put(_Raised(exc))
+        self._queue.put(_Stop)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is _Stop:
+            raise StopIteration
+        if isinstance(item, _Raised):
+            raise item.exc
+        return item
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
-                 batchify_fn=None, num_workers=0):
+                 batchify_fn=None, num_workers=0, prefetch=0, device=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -44,10 +104,22 @@ class DataLoader:
                 "specified if batch_sampler is")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
+        # prefetch=N overlaps batchify + h2d transfer of the next N
+        # batches with the current step (gluon analogue of wrapping a
+        # DataIter in io.DevicePrefetchIter); device defaults to the
+        # first local jax device
+        self._prefetch = int(prefetch)
+        self._device = device
 
-    def __iter__(self):
+    def _batches(self):
         for batch in self._batch_sampler:
             yield self._batchify_fn([self._dataset[i] for i in batch])
+
+    def __iter__(self):
+        if self._prefetch > 0:
+            return _DevicePrefetchingIter(self._batches(), self._prefetch,
+                                          self._device)
+        return self._batches()
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -64,6 +64,7 @@ class Trainer:
         is_async = self._kvstore is not None and \
             getattr(self._kvstore, "_is_async", False)
         self._optimizer.rescale_grad = self._scale / batch_size
+        live = []
         for i, p in enumerate(self._params):
             if p._grad is None:
                 if not ignore_stale_grad:
@@ -71,14 +72,19 @@ class Trainer:
                         "Parameter %s has no gradient; call backward first "
                         "or set grad_req" % p.name)
                 continue
-            grad = p._grad
-            if self._kvstore is not None and not is_async:
-                # dist sync: all-reduce the gradient, then update
-                # worker-side (async updates are local — the push/pull
-                # round-trip would be a no-op copy)
-                self._kvstore.push(i, grad, priority=-i)
-                self._kvstore.pull(i, grad, priority=-i)
-            self._updater(i, grad, p.data())
+            live.append((i, p))
+        if self._kvstore is not None and not is_async and live:
+            # dist sync: ONE batched push/pull all-reduces every gradient
+            # in a single DCN round trip instead of one per parameter
+            # (same batching as Module.update), then update worker-side
+            # (async updates are local — the round-trip would be a no-op
+            # copy)
+            keys = [i for i, _ in live]
+            grads = [p._grad for _, p in live]
+            self._kvstore.push(keys, grads, priority=0)
+            self._kvstore.pull(keys, grads, priority=0)
+        for i, p in live:
+            self._updater(i, p._grad, p.data())
         if is_async:
             # dist_async: count this local update; a parameter-averaging
             # round fires every MXNET_ASYNC_SYNC_PERIOD updates.  Gluon

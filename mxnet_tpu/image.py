@@ -385,11 +385,29 @@ def _batch_tail_fn(mean_t, std_t):
     return jax.jit(f)
 
 
+# augmenters that only move/select pixels: safe to run on a uint8 image
+# (resize interpolation rounds back into [0, 255]).  Anything else —
+# jitters, lighting, user Augmenter subclasses — produces float values
+# the uint8 fast path would wrap modulo 256 on the way into the batch
+# buffer.
+_SHAPE_ONLY_AUGS = (ResizeAug, ForceResizeAug, RandomCropAug,
+                    RandomSizedCropAug, CenterCropAug, HorizontalFlipAug)
+
+
+def _uint8_safe(aug):
+    if isinstance(aug, RandomOrderAug):
+        return all(_uint8_safe(t) for t in aug.ts)
+    return type(aug) in _SHAPE_ONLY_AUGS
+
+
 def _split_device_tail(aug_list):
-    """If the chain ends with CastAug [+ ColorNormalizeAug] and nothing
-    float-producing sits before them, the tail runs on DEVICE per batch
-    and the host path stays uint8.  Returns (host_augs, mean, std,
-    fast) — fast=False keeps the classic per-image path."""
+    """If the chain ends with CastAug [+ ColorNormalizeAug] and every
+    remaining host augmenter is shape-only (crop/resize/flip — nothing
+    float-producing), the tail runs on DEVICE per batch and the host
+    path stays uint8.  Returns (host_augs, mean, std, fast) —
+    fast=False keeps the classic per-image float path (a float-producing
+    jitter before CastAug would otherwise have its output wrapped modulo
+    256 by the uint8 batch buffer)."""
     host = list(aug_list)
     mean = std = None
     if host and isinstance(host[-1], ColorNormalizeAug):
@@ -397,11 +415,15 @@ def _split_device_tail(aug_list):
         host = host[:-1]
     elif host and isinstance(host[-1], CastAug):
         host = host[:-1]
-        return host, None, None, True
+        if all(_uint8_safe(a) for a in host):
+            return host, None, None, True
+        return list(aug_list), None, None, False
     else:
         return list(aug_list), None, None, False
     if host and isinstance(host[-1], CastAug):
         host = host[:-1]
+        if not all(_uint8_safe(a) for a in host):
+            return list(aug_list), None, None, False
         m = None if mean is None else tuple(float(v) for v in mean)
         s = None if std is None else tuple(float(v) for v in std)
         return host, m, s, True

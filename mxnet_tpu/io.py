@@ -19,7 +19,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "PrefetchingIter", "DevicePrefetchIter", "prefetch_to_device",
+           "CSVIter", "MNISTIter", "ImageRecordIter",
            "LibSVMIter", "ImageDetRecordIter"]
 
 
@@ -334,6 +335,256 @@ class PrefetchingIter(DataIter):
 
     def __del__(self):
         self._stop.set()
+
+
+class DevicePrefetchIter(DataIter):
+    """Async *device*-staging prefetcher: the second pipeline stage on top
+    of :class:`PrefetchingIter`'s host double-buffer.
+
+    A background thread pulls host batches from the inner iterator(s),
+    issues the host→device transfer (``jax.device_put``) into a ring of
+    ``prefetch_depth`` (≥2) in-flight device buffers, and *waits for the
+    copy on the staging thread* — so by the time ``Module.fit`` asks for
+    batch N+1, its bytes are already resident and the consumer thread
+    never blocks on the link.  This is what closes the fit-vs-step gap on
+    hosts where a fresh-buffer ``device_put`` is slow (the repo measured
+    3.6 MB/s over the tunneled link — ~9 s per 77 MB batch if paid
+    synchronously in the step loop).
+
+    Sharding-aware: under a ``mesh`` the batch is placed with the proper
+    batch ``NamedSharding`` up front (``parallel.sharding.shard_batch``),
+    so DP/FSDP meshes consume pre-sharded arrays with no re-layout in the
+    fused step.  Without a mesh, batches land on ``context``'s device (or
+    the default device).
+
+    ``steps_per_call=K`` packs K consecutive batches into one super-batch
+    with a leading K axis — one transfer and one dispatch feed K
+    ``lax.scan``'d updates (:class:`~mxnet_tpu.fused.TrainStep` with
+    ``steps_per_call=K``).  The trailing ``len(epoch) % K`` batches of an
+    epoch are dropped (a partial pack would recompile the scanned step);
+    ``provide_data``/``provide_label`` keep the *per-step* shapes.
+
+    Emitted batches carry ``staged=True`` so consumers skip their own
+    placement pass.
+    """
+
+    def __init__(self, iters, prefetch_depth=2, mesh=None, context=None,
+                 steps_per_call=1):
+        iters = iters if isinstance(iters, list) else [iters]
+        super().__init__(iters[0].batch_size)
+        if prefetch_depth < 1:
+            raise MXNetError("prefetch_depth must be >= 1")
+        if steps_per_call < 1:
+            raise MXNetError("steps_per_call must be >= 1")
+        self.iters = iters
+        self.mesh = mesh
+        self.context = context
+        self._pack = int(steps_per_call)
+        self._queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self.current_batch = None
+        self._worker_error = None
+        self._warned_drop = False
+        self._exhausted = False
+        self._start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    # -- staging --------------------------------------------------------
+    def _placement(self):
+        """(fn: host/np/jax array -> committed device array) resolved
+        lazily so constructing the iterator never initializes a backend
+        the process does not use."""
+        import jax
+
+        if self.mesh is not None:
+            from .parallel.sharding import shard_batch
+
+            leading = 1 if self._pack > 1 else 0
+            return lambda v: shard_batch(self.mesh, v, leading=leading)
+        if self.context is not None:
+            dev = self.context.jax_device
+        else:
+            dev = jax.local_devices()[0]
+        return lambda v: jax.device_put(v, dev)
+
+    @staticmethod
+    def _host_array(arr):
+        if isinstance(arr, NDArray):
+            return np.asarray(arr._data)
+        return np.asarray(arr)
+
+    def _stage_group(self, group):
+        """group: list (length pack) of per-iter batch lists -> one staged
+        DataBatch.  Runs on the worker thread: the device_put AND the wait
+        for transfer completion both happen here, off the consumer."""
+        import jax
+
+        place = self._placement()
+        first = group[0]
+        n_data = [len(b.data) for b in first]
+        n_label = [len(b.label or []) for b in first]
+
+        def stage_slot(get_arrays, counts):
+            staged = []
+            for it_idx, n in enumerate(counts):
+                for j in range(n):
+                    if self._pack == 1:
+                        arr = get_arrays(group[0][it_idx])[j]
+                        v = arr._data if isinstance(arr, NDArray) \
+                            else np.asarray(arr)
+                    else:
+                        v = np.stack([
+                            self._host_array(get_arrays(g[it_idx])[j])
+                            for g in group])
+                    out = place(v)
+                    ctx = self.context
+                    staged.append(NDArray(out, ctx) if ctx is not None
+                                  else NDArray(out))
+            return staged
+
+        data = stage_slot(lambda b: b.data, n_data)
+        label = stage_slot(lambda b: b.label or [], n_label)
+        # eat the h2d latency HERE so the consumer never does
+        jax.block_until_ready([a._data for a in data + label])
+        batch = DataBatch(data=data, label=label,
+                          pad=first[0].pad if self._pack == 1 else 0,
+                          index=first[0].index if self._pack == 1 else None,
+                          bucket_key=first[0].bucket_key,
+                          provide_data=first[0].provide_data,
+                          provide_label=first[0].provide_label)
+        batch.staged = True
+        return batch
+
+    # -- worker ---------------------------------------------------------
+    def _worker(self):
+        while not self._stop.is_set():
+            group = []
+            try:
+                for _ in range(self._pack):
+                    group.append([i.next() for i in self.iters])
+            except StopIteration:
+                if group and not self._warned_drop:
+                    self._warned_drop = True
+                    import logging
+
+                    logging.warning(
+                        "DevicePrefetchIter(steps_per_call=%d): dropping "
+                        "%d trailing batch(es) that do not fill a pack",
+                        self._pack, len(group))
+                self._queue.put(None)
+                return
+            except Exception as exc:  # surface at next() like ThreadedIter
+                if not self._stop.is_set():
+                    self._queue.put(exc)
+                return
+            try:
+                staged = self._stage_group(group)
+            except Exception as exc:
+                if not self._stop.is_set():
+                    self._queue.put(exc)
+                return
+            self._queue.put(staged)
+
+    def _start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def reset(self):
+        # same protocol as PrefetchingIter.reset: stop, drain so a worker
+        # blocked on the full queue can exit, join, drain the batch it
+        # may still have enqueued, then restart on freshly reset inners
+        self._stop.set()
+        self._drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._drain()
+        self._worker_error = None
+        self._exhausted = False
+        for i in self.iters:
+            i.reset()
+        self._start()
+
+    def iter_next(self):
+        if self._worker_error is not None:
+            # worker died on this error; keep surfacing it (reset()
+            # restarts the stream) instead of hanging on an empty queue
+            raise self._worker_error
+        if self._exhausted:
+            # keep returning False (the worker is gone — a fresh get()
+            # would block forever); reset() restarts the stream
+            return False
+        batch = self._queue.get()
+        if batch is None:
+            self._exhausted = True
+            return False
+        if isinstance(batch, Exception):
+            self._worker_error = batch
+            raise batch
+        self.current_batch = batch
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def close(self):
+        """Stop the staging thread WITHOUT restarting it (``reset`` is
+        stop-then-restart).  After ``close`` the iterator reports
+        exhaustion until ``reset``; the inner iterators are left
+        untouched for the caller to reuse."""
+        self._stop.set()
+        self._drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._drain()
+        self._exhausted = True
+
+    def __del__(self):
+        self._stop.set()
+
+
+def prefetch_to_device(iters, prefetch_depth=2, mesh=None, context=None,
+                       steps_per_call=1):
+    """Wrap an iterator (or list of iterators) in a
+    :class:`DevicePrefetchIter` — idempotent: an iterator that is already
+    device-staging is returned as-is (same pack), so callers can apply it
+    unconditionally."""
+    if isinstance(iters, DevicePrefetchIter) and \
+            iters._pack == steps_per_call:
+        return iters
+    return DevicePrefetchIter(iters, prefetch_depth=prefetch_depth,
+                              mesh=mesh, context=context,
+                              steps_per_call=steps_per_call)
 
 
 class CSVIter(NDArrayIter):

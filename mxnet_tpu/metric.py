@@ -13,7 +13,8 @@ import numpy as _np
 from .base import MXNetError, _Registry
 from .ndarray import NDArray
 
-__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+__all__ = ["EvalMetric", "CompositeEvalMetric", "LazyEvalMetric",
+           "Accuracy", "TopKAccuracy",
            "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "Loss",
            "CustomMetric", "np", "create", "register"]
 
@@ -123,6 +124,51 @@ class CompositeEvalMetric(EvalMetric):
             names.append(name)
             values.append(value)
         return names, values
+
+
+class LazyEvalMetric(EvalMetric):
+    """Deferred-sync wrapper for the pipelined training loop.
+
+    Every built-in metric's ``update`` calls ``asnumpy`` on its inputs —
+    a host sync that blocks the dispatch thread until the step that
+    produced them finishes, serializing the loop with the device.  This
+    wrapper instead *buffers references* to the (labels, preds) device
+    arrays (cheap: JAX arrays are immutable, so late evaluation sees the
+    right values) and replays them into the wrapped metric only at a sync
+    point: an explicit :meth:`flush`, any ``get``/``get_name_value``
+    (which is what ``batch_end_callback`` loggers like ``Speedometer``
+    call — so the sync cadence auto-aligns with the callback interval),
+    or every ``sync_period`` updates as a buffer bound.
+
+    ``Module.fit(metric_sync_period=K)`` wraps the training metric in
+    this automatically for K > 1.
+    """
+
+    def __init__(self, base, sync_period=None, **kwargs):
+        self._base = create(base)
+        self._pending = []
+        self._sync_period = sync_period
+        super().__init__(self._base.name, **kwargs)
+
+    def update(self, labels, preds):
+        self._pending.append((list(labels or []), list(preds)))
+        if self._sync_period and len(self._pending) >= self._sync_period:
+            self.flush()
+
+    def flush(self):
+        """Replay buffered updates into the wrapped metric (the host
+        sync happens here)."""
+        pending, self._pending = self._pending, []
+        for labels, preds in pending:
+            self._base.update(labels, preds)
+
+    def reset(self):
+        self._pending = []
+        self._base.reset()
+
+    def get(self):
+        self.flush()
+        return self._base.get()
 
 
 @register

@@ -145,14 +145,46 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, param_sharding=None, compute_dtype=None):
+            monitor=None, param_sharding=None, compute_dtype=None,
+            prefetch_to_device=None, prefetch_depth=2,
+            metric_sync_period=None, steps_per_call=None):
         """The training loop (reference ``BaseModule.fit``,
-        ``base_module.py:376``)."""
+        ``base_module.py:376``), pipelined: by default the train iterator
+        is wrapped in :class:`~mxnet_tpu.io.DevicePrefetchIter` so batch
+        ``n+1`` stages host→device while batch ``n``'s step executes, and
+        the loop itself never blocks on device results between steps (JAX
+        async dispatch) except where a metric value is actually read.
+
+        extra knobs (all also settable by env var):
+
+        * ``prefetch_to_device`` — wrap ``train_data`` for background
+          device staging (default: ``MXNET_FIT_PIPELINE``, on).  Pass an
+          already-wrapped ``DevicePrefetchIter`` as ``train_data`` to
+          control staging parameters yourself.
+        * ``prefetch_depth`` — staging ring depth (≥2 for double
+          buffering).
+        * ``metric_sync_period`` — accumulate (label, pred) device refs
+          and fold them into the metric every N batches instead of every
+          batch (``MXNET_METRIC_SYNC_PERIOD``); a ``Speedometer`` reading
+          the metric still sees up-to-date values (reads force a flush).
+        * ``steps_per_call`` — dispatch K optimizer steps as one device
+          call (``lax.scan`` over a packed super-batch staged by the
+          prefetcher); requires the fused step (``MXNET_STEPS_PER_CALL``).
+        """
+        from ..base import get_env
         from ..initializer import Uniform
 
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             initializer = Uniform(0.01)
+
+        K = max(1, int(steps_per_call if steps_per_call is not None
+                       else get_env("MXNET_STEPS_PER_CALL", 1, int)))
+        if K > 1 and monitor is not None:
+            raise MXNetError(
+                "steps_per_call > 1 is incompatible with a Monitor: the "
+                "monitor needs the per-node executor path, which has no "
+                "scanned multi-step form")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -169,18 +201,61 @@ class BaseModule:
             opt_kwargs["param_sharding"] = param_sharding
         if compute_dtype is not None:
             opt_kwargs["compute_dtype"] = compute_dtype
+        if K > 1:
+            opt_kwargs["steps_per_call"] = K
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, **opt_kwargs)
+
+        # wrap AFTER init_optimizer: staging placement follows the mesh
+        # the optimizer decided on (kvstore type → mesh)
+        pipeline = prefetch_to_device
+        if pipeline is None:
+            pipeline = get_env("MXNET_FIT_PIPELINE", True, bool)
+        fit_data = train_data
+        if pipeline or K > 1:
+            # packed super-batches only exist via the staging iter, so
+            # K > 1 forces the wrap even if pipelining was switched off
+            ctx = getattr(self, "_context", None)
+            if isinstance(ctx, (list, tuple)):  # BucketingModule keeps a bare Context
+                ctx = ctx[0] if ctx else None
+            fit_data = io_mod.prefetch_to_device(
+                train_data, prefetch_depth=prefetch_depth,
+                mesh=getattr(self, "_mesh", None), context=ctx,
+                steps_per_call=K)
 
         if validation_metric is None:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
+        sync = int(metric_sync_period if metric_sync_period is not None
+                   else get_env("MXNET_METRIC_SYNC_PERIOD", 1, int))
+        if sync > 1:
+            eval_metric = metric_mod.LazyEvalMetric(eval_metric,
+                                                    sync_period=sync)
 
+        try:
+            self._fit_epochs(fit_data, eval_data, eval_metric,
+                             validation_metric, monitor,
+                             batch_end_callback, epoch_end_callback,
+                             eval_end_callback, eval_batch_end_callback,
+                             begin_epoch, num_epoch, K)
+        finally:
+            if fit_data is not train_data:
+                # the staging worker must not outlive fit: it would keep
+                # consuming the caller's iterator (stealing the batches a
+                # follow-up fit/score would read) and can sit inside a
+                # device_put when the interpreter tears the runtime down
+                fit_data.close()
+                train_data.reset()
+
+    def _fit_epochs(self, fit_data, eval_data, eval_metric,
+                    validation_metric, monitor, batch_end_callback,
+                    epoch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, begin_epoch, num_epoch, K):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
-            data_iter = iter(train_data)
+            data_iter = iter(fit_data)
             end_of_batch = False
             next_data_batch = next(data_iter)
             while not end_of_batch:
@@ -189,11 +264,22 @@ class BaseModule:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                # lookahead next() AFTER dispatch: pulling batch n+1 off
+                # the staging queue (and refilling it) overlaps the step
+                # that is still executing asynchronously on device
                 try:
                     next_data_batch = next(data_iter)
                 except StopIteration:
                     end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                if K > 1:
+                    outs = self.get_outputs()
+                    labels = data_batch.label or []
+                    for k in range(K):
+                        self.update_metric(eval_metric,
+                                           [l[k] for l in labels],
+                                           outputs=[o[k] for o in outs])
+                else:
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -201,7 +287,7 @@ class BaseModule:
                         cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
                                          eval_metric=eval_metric,
                                          locals=locals()))
-                nbatch += 1
+                nbatch += K
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -224,7 +310,7 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
-            train_data.reset()
+            fit_data.reset()
 
     def install_monitor(self, monitor):
         raise NotImplementedError

@@ -222,7 +222,7 @@ class Module(BaseModule):
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False, param_sharding=None,
-                       compute_dtype=None):
+                       compute_dtype=None, steps_per_call=None):
         """``param_sharding``: 'replicated' (default), 'fsdp', 'tp', or a
         rule list (see ``parallel.sharding.param_sharding_rules``) —
         applied to the fused step's parameter/optimizer-state layouts
@@ -231,7 +231,12 @@ class Module(BaseModule):
         (``graph_executor.cc:395`` PlaceDevice) plus the ZeRO-style
         sharded-optimizer layout the reference approximated with
         parameter-server key sharding (``kvstore_dist.h:431``).  Also
-        settable via ``MXNET_PARAM_SHARDING``."""
+        settable via ``MXNET_PARAM_SHARDING``.
+
+        ``steps_per_call=K``: multi-step dispatch — the fused step scans
+        K donated updates over a packed (K, batch, …) super-batch per
+        device call (``fit`` packs via ``DevicePrefetchIter``).  Also
+        settable via ``MXNET_STEPS_PER_CALL``."""
         from ..base import get_env
 
         assert self.binded and self.params_initialized
@@ -241,6 +246,9 @@ class Module(BaseModule):
             param_sharding = get_env("MXNET_PARAM_SHARDING", "", str) \
                 or None
         self._param_sharding = param_sharding
+        if steps_per_call is None:
+            steps_per_call = get_env("MXNET_STEPS_PER_CALL", 1, int)
+        self._steps_per_call = max(1, int(steps_per_call))
         # mixed precision for the fused step: bf16 activations over fp32
         # master weights (also via MXNET_COMPUTE_DTYPE=bfloat16)
         if compute_dtype is None:
@@ -353,8 +361,19 @@ class Module(BaseModule):
                 raise MXNetError(
                     "compute_dtype=%r was requested but the fused step is "
                     "unavailable: %s" % (self._compute_dtype, reason))
+            # likewise an explicit multi-step dispatch request: the split
+            # path has no scanned form
+            if getattr(self, "_steps_per_call", 1) > 1:
+                raise MXNetError(
+                    "steps_per_call=%d was requested but the fused step "
+                    "is unavailable: %s" % (self._steps_per_call, reason))
 
         if self._pipeline_stages > 1:
+            if getattr(self, "_steps_per_call", 1) > 1:
+                raise MXNetError(
+                    "steps_per_call cannot combine with pipeline_stages "
+                    "(the pipelined step already runs its own microbatch "
+                    "wave per call)")
             # an EXPLICIT pipeline request never falls back silently
             from ..parallel.pipeline import PipelineTrainStep
 
@@ -429,13 +448,19 @@ class Module(BaseModule):
                 data_names=self._data_names, label_names=self._label_names,
                 fixed_param_names=self._fixed_param_names, remat=remat,
                 param_sharding=getattr(self, "_param_sharding", None),
-                compute_dtype=getattr(self, "_compute_dtype", None))
+                compute_dtype=getattr(self, "_compute_dtype", None),
+                steps_per_call=getattr(self, "_steps_per_call", 1))
         except Exception as e:  # fall back to the split path
             if getattr(self, "_compute_dtype", None) is not None:
                 raise MXNetError(
                     "compute_dtype=%r was requested but the fused step "
                     "could not be built: %s"
                     % (self._compute_dtype, e)) from e
+            if getattr(self, "_steps_per_call", 1) > 1:
+                raise MXNetError(
+                    "steps_per_call=%d was requested but the fused step "
+                    "could not be built: %s"
+                    % (self._steps_per_call, e)) from e
             if getattr(self, "_param_sharding", None) not in (
                     None, "replicated"):
                 # an EXPLICIT sharding request must not silently train
@@ -485,10 +510,19 @@ class Module(BaseModule):
         for name, arr in zip(self._label_names, data_batch.label or []):
             batch[name] = arr._data if isinstance(arr, NDArray) else \
                 jnp.asarray(arr)
-        if self._mesh is not None:
+        K = getattr(self._fused, "_steps_per_call", 1)
+        if getattr(data_batch, "staged", False):
+            # the DevicePrefetchIter staging thread already placed this
+            # batch (device or NamedSharding) — re-placing would be a
+            # synchronous no-op at best and an axis-0 re-shard at worst
+            # for packed super-batches
+            pass
+        elif self._mesh is not None:
             from ..parallel.sharding import shard_batch
 
-            batch = {k: shard_batch(self._mesh, v) for k, v in batch.items()}
+            lead = 1 if K > 1 else 0
+            batch = {k: shard_batch(self._mesh, v, leading=lead)
+                     for k, v in batch.items()}
         else:
             # load_data semantics: batches follow the module's device, not
             # the default platform (a cpu-context module on a TPU host gets
@@ -499,11 +533,14 @@ class Module(BaseModule):
             batch = {k: jax.device_put(v, dev) for k, v in batch.items()}
         # split-path parity: the scheduler is consulted at the
         # PRE-increment num_update (Optimizer.update calls _get_lr before
-        # _update_count); bias-correction t is the POST-increment count
+        # _update_count); bias-correction t is the POST-increment count.
+        # A multi-step call advances the count by K (lr holds for the K
+        # inner steps; t increments per step inside the scan).
         lr = o.lr_scheduler(o.num_update) if o.lr_scheduler else o.lr
-        for i in range(len(self._param_names)):
-            o._update_count(i)
-        t = o.num_update
+        for _ in range(K):
+            for i in range(len(self._param_names)):
+                o._update_count(i)
+        t = o.num_update - K + 1
         new_params, new_aux, self._fused_states, outs = self._fused(
             params, aux, self._fused_states, batch, _rnd.next_key(), lr, t)
         from ..parallel.pipeline import PipelineTrainStep
@@ -616,11 +653,12 @@ class Module(BaseModule):
         assert self.binded and self.inputs_need_grad
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
-    def update_metric(self, eval_metric, labels):
+    def update_metric(self, eval_metric, labels, outputs=None):
         from ..executor_manager import pair_metric_outputs
 
+        outs = self._exec.outputs if outputs is None else outputs
         eval_metric.update(labels, pair_metric_outputs(
-            self._symbol, self._label_names, labels, self._exec.outputs))
+            self._symbol, self._label_names, labels, outs))
 
     def install_monitor(self, monitor):
         assert self.binded

@@ -39,15 +39,20 @@ def batch_axes(mesh, axis="data"):
     return tuple(a for a in (axis, "fsdp") if a in mesh.shape)
 
 
-def shard_batch(mesh, x, axis="data"):
+def shard_batch(mesh, x, axis="data", leading=0):
     """Device-put a host batch sharded along the batch dimension over the
-    mesh's batch axes (the input side of data parallelism)."""
+    mesh's batch axes (the input side of data parallelism).
+
+    ``leading``: number of unsharded leading dims before the batch dim —
+    a packed super-batch (``steps_per_call=K`` → shape ``(K, batch, …)``)
+    passes ``leading=1`` so the *second* dim shards."""
     import jax
 
     names = batch_axes(mesh, axis)
     if not names:
         return jax.device_put(x, replicated(mesh))
-    return jax.device_put(x, named_sharding(mesh, names))
+    spec = [None] * leading + [names]
+    return jax.device_put(x, named_sharding(mesh, *spec))
 
 
 def constraint(x, *spec):

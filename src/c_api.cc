@@ -1610,12 +1610,18 @@ static PyObject *kvs_updater_trampoline(PyObject *self, PyObject *args) {
   if (!h || !PyArg_ParseTuple(args, "iOO", &key, &recv, &local))
     return nullptr;
   if (h->updater) {
-    NDHandle recv_h, local_h;
-    recv_h.obj = recv;
-    local_h.obj = local;
+    // ABI contract: the receiver OWNS the passed NDArrayHandles
+    // (frontends wrap them in NDArray objects whose gc calls
+    // MXNDArrayFree) — so heap-allocate the handles and give each its
+    // own reference; a stack NDHandle would be delete'd off-stack and
+    // its borrowed PyObject decref'd into underflow
+    Py_INCREF(recv);
+    Py_INCREF(local);
+    NDHandle *recv_h = wrap_nd(recv);
+    NDHandle *local_h = wrap_nd(local);
     // the callback re-enters the C ABI (invoke/copy) which takes the
     // GIL recursively via PyGILState_Ensure — safe on this thread
-    h->updater(key, &recv_h, &local_h, h->updater_arg);
+    h->updater(key, recv_h, local_h, h->updater_arg);
   }
   Py_RETURN_NONE;
 }

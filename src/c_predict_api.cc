@@ -360,7 +360,12 @@ int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
       PyObject *nm = PyTuple_GetItem(item, 0);       //  float32 bytes)
       PyObject *shp = PyTuple_GetItem(item, 1);
       PyObject *dat = PyTuple_GetItem(item, 2);
-      h->names.push_back(PyUnicode_AsUTF8(nm));
+      const char *nm_c = PyUnicode_AsUTF8(nm);  // nullptr on non-str
+      if (!nm_c) {
+        ok = false;
+        break;
+      }
+      h->names.push_back(nm_c);
       std::vector<mx_uint> sv;
       for (Py_ssize_t j = 0; j < PyTuple_Size(shp); ++j)
         sv.push_back(static_cast<mx_uint>(

@@ -13,15 +13,33 @@ sys.path.insert(0, ".")
 import numpy as np  # noqa: E402
 
 
-def _setup_or_skip():
+def _setup_or_skip(discovery_timeout=90):
     """Shared preamble: validate the LOWERING, not the matmul precision
     default (TPU matmuls default to bf16 passes — a precision policy,
-    not a kernel property); skip when no accelerator is present."""
+    not a kernel property); skip when no accelerator is present.
+
+    Backend discovery runs on a bounded side thread: a wedged
+    accelerator tunnel hangs ``jax.devices()`` indefinitely — far past
+    any caller budget — so answer SKIP after ``discovery_timeout``
+    rather than letting the parent test burn its whole timeout."""
+    import os
+    import threading
+
     import jax
 
     jax.config.update("jax_default_matmul_precision", "highest")
-    kind = getattr(jax.devices()[0], "device_kind", "cpu")
-    if "TPU" not in kind.upper() and jax.devices()[0].platform == "cpu":
+    found = []
+    t = threading.Thread(target=lambda: found.append(jax.devices()),
+                         daemon=True)
+    t.start()
+    t.join(discovery_timeout)
+    if not found:
+        print("SKIP no accelerator")
+        sys.stdout.flush()
+        os._exit(0)  # discovery thread is wedged; a clean exit would join it
+    dev = found[0][0]
+    kind = getattr(dev, "device_kind", "cpu")
+    if "TPU" not in kind.upper() and dev.platform == "cpu":
         print("SKIP no accelerator")
         return False
     return True

@@ -527,12 +527,12 @@ def test_pipeline_bn_matches_sequential_microbatch(schedule):
 
 
 def test_pipeline_dropout_recompute_bitexact():
-    """Dropout inside a pipelined graph: the 1F1B backward RECOMPUTES
-    the stage forward, so its per-(stage, microbatch) key derivation
-    must reproduce the forward's masks bit-exactly — 1F1B and GPipe
-    (which differentiates stored activations, no recompute) must then
-    produce identical outputs and identical updated params from the
-    same inputs."""
+    """Dropout inside a pipelined graph: both schedules RECOMPUTE the
+    stage forward during backward (1F1B interleaved, GPipe as a
+    validity-gated all-backward wave), so the per-(stage, microbatch)
+    key derivation must reproduce the forward's masks bit-exactly —
+    1F1B and GPipe must then produce identical outputs and identical
+    updated params from the same inputs."""
     import jax
     import jax.numpy as jnp
 

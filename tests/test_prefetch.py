@@ -1,0 +1,280 @@
+"""The pipelined training loop: DevicePrefetchIter staging, lazy
+metrics, multi-step dispatch, and their composition through
+``Module.fit`` (docs/performance.md)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DevicePrefetchIter, prefetch_to_device
+
+
+def _iter(n=80, d=6, batch=20, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, d).astype("float32")
+    w = rs.randn(d, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    return mx.io.NDArrayIter(X, y, batch_size=batch), X, y
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax",
+                                normalization="batch")
+
+
+# -- DevicePrefetchIter ----------------------------------------------------
+
+def test_prefetch_preserves_order_and_values():
+    base, X, y = _iter()
+    it = prefetch_to_device(base)
+    assert isinstance(it, DevicePrefetchIter)
+    # idempotent wrap
+    assert prefetch_to_device(it) is it
+    seen = []
+    for b in it:
+        assert getattr(b, "staged", False)
+        seen.append(b.data[0].asnumpy())
+    got = np.concatenate(seen)
+    np.testing.assert_allclose(got, X, rtol=1e-6)
+
+
+def test_prefetch_epoch_reset_replays_identically():
+    it = prefetch_to_device(_iter()[0])
+    first = [b.data[0].asnumpy() for b in it]
+    assert len(first) == 4
+    # exhausted stream keeps raising StopIteration instead of hanging
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    second = [b.data[0].asnumpy() for b in it]
+    assert len(second) == len(first)
+    for a, b in zip(first, second):
+        np.testing.assert_allclose(a, b)
+
+
+def test_prefetch_provide_shapes_passthrough():
+    base, _, _ = _iter()
+    it = prefetch_to_device(base, steps_per_call=2)
+    # per-STEP shapes even in pack mode (Module.bind traces the
+    # single-step executor from these)
+    assert it.provide_data[0].shape == (20, 6)
+    assert it.provide_label[0].shape == (20,)
+
+
+def test_prefetch_worker_exception_propagates():
+    class Exploding(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(20)
+            self._n = 0
+
+        provide_data = property(
+            lambda self: [mx.io.DataDesc("data", (20, 6))])
+        provide_label = property(
+            lambda self: [mx.io.DataDesc("softmax_label", (20,))])
+
+        def reset(self):
+            self._n = 0
+
+        def next(self):
+            self._n += 1
+            if self._n > 2:
+                raise RuntimeError("decoder exploded")
+            z = np.zeros((20, 6), "float32")
+            return mx.io.DataBatch(data=[mx.nd.array(z)],
+                                   label=[mx.nd.zeros((20,))], pad=0)
+
+    it = prefetch_to_device(Exploding())
+    it.next()
+    it.next()
+    with pytest.raises(RuntimeError, match="decoder exploded"):
+        for _ in range(4):
+            it.next()
+    # the error persists (no hang) until reset restarts the stream
+    with pytest.raises(RuntimeError, match="decoder exploded"):
+        it.next()
+    it.reset()
+    assert it.next() is not None
+
+
+def test_prefetch_packs_superbatches_and_drops_tail():
+    base, X, _ = _iter(n=100, batch=20)  # 5 batches, pack 2 -> drop 1
+    it = prefetch_to_device(base, steps_per_call=2)
+    batches = list(it)
+    assert len(batches) == 2
+    for b in batches:
+        assert b.data[0].shape == (2, 20, 6)
+    got = np.concatenate([b.data[0].asnumpy().reshape(-1, 6)
+                          for b in batches])
+    np.testing.assert_allclose(got, X[:80], rtol=1e-6)
+
+
+def test_prefetch_sharded_placement_under_mesh():
+    import jax
+
+    from mxnet_tpu.parallel import create_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    mesh = create_mesh({"data": 4}, devices=jax.devices()[:4])
+    it = prefetch_to_device(_iter()[0], mesh=mesh)
+    b = next(iter(it))
+    arr = b.data[0]._data
+    assert arr.sharding.mesh.shape["data"] == 4
+    shard = next(iter(arr.addressable_shards)).data
+    assert shard.shape[0] * 4 == arr.shape[0]
+    # packed: the SECOND axis shards, K stays whole
+    base2, _, _ = _iter()
+    it2 = prefetch_to_device(base2, mesh=mesh, steps_per_call=2)
+    b2 = next(iter(it2))
+    arr2 = b2.data[0]._data
+    shard2 = next(iter(arr2.addressable_shards)).data
+    assert shard2.shape[0] == arr2.shape[0]  # K axis unsharded
+    assert shard2.shape[1] * 4 == arr2.shape[1]
+
+
+def test_prefetch_close_releases_source():
+    """fit() closes the wrapper it created: the staging worker must not
+    keep draining the caller's iterator after the loop finishes."""
+    base, _, _ = _iter()
+    it = prefetch_to_device(base)
+    it.next()
+    it.close()
+    with pytest.raises(StopIteration):
+        it.next()
+    base.reset()
+    # the source is the caller's again: a fresh pass sees every batch
+    assert len(list(base)) == 4
+    it.reset()
+    assert it.next() is not None
+
+
+# -- LazyEvalMetric --------------------------------------------------------
+
+def test_lazy_metric_defers_then_matches():
+    eager = mx.metric.Accuracy()
+    lazy = mx.metric.LazyEvalMetric("acc", sync_period=3)
+    rs = np.random.RandomState(0)
+    for _ in range(7):
+        preds = mx.nd.array(rs.rand(10, 3).astype("float32"))
+        labels = mx.nd.array((rs.rand(10) * 3).astype("float32"))
+        eager.update([labels], [preds])
+        lazy.update([labels], [preds])
+    # reads flush: values match the eager metric exactly
+    assert lazy.get() == eager.get()
+    lazy.reset()
+    assert lazy._pending == []
+    # still usable after reset
+    lazy.update([mx.nd.array(np.zeros(4, "float32"))],
+                [mx.nd.array(np.eye(4, 3, dtype="float32"))])
+    name, value = lazy.get()
+    assert np.isfinite(value)
+
+
+# -- the pipelined fit ----------------------------------------------------
+
+def _fit(prefetch, steps_per_call=None, metric_sync=None, epochs=3):
+    mx.random.seed(7)
+    np.random.seed(7)
+    it, _, _ = _iter(n=160, batch=20, seed=3)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5},
+            prefetch_to_device=prefetch,
+            steps_per_call=steps_per_call,
+            metric_sync_period=metric_sync)
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_fit_pipelined_matches_unpipelined():
+    ref = _fit(prefetch=False)
+    pipe = _fit(prefetch=True, metric_sync=4)
+    assert ref.keys() == pipe.keys()
+    for k in ref:
+        np.testing.assert_allclose(pipe[k], ref[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+def test_fit_steps_per_call_matches_single_step():
+    ref = _fit(prefetch=False)
+    packed = _fit(prefetch=True, steps_per_call=4)
+    for k in ref:
+        np.testing.assert_allclose(packed[k], ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_fit_steps_per_call_advances_update_count():
+    it, _, _ = _iter(n=160, batch=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1},
+            steps_per_call=4)
+    # 8 batches/epoch -> 8 optimizer updates even though only 2 device
+    # calls were dispatched
+    assert mod._optimizer.num_update == 8
+
+
+def test_steps_per_call_refuses_split_path(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    it, _, _ = _iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(mx.base.MXNetError, match="steps_per_call"):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                initializer=mx.init.Xavier(), steps_per_call=2)
+
+
+def test_fit_against_manual_loop():
+    """fit's pipelined loop must be numerically identical to hand-rolled
+    forward_backward/update over the same batches."""
+    mx.random.seed(11)
+    np.random.seed(11)
+    it, _, _ = _iter(n=80, batch=20, seed=5)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for _ in range(2):
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+        it.reset()
+    manual, _ = mod.get_params()
+
+    mx.random.seed(11)
+    np.random.seed(11)
+    it2, _, _ = _iter(n=80, batch=20, seed=5)
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod2.fit(it2, num_epoch=2, optimizer="sgd",
+             initializer=mx.init.Xavier(),
+             optimizer_params={"learning_rate": 0.5})
+    fitted, _ = mod2.get_params()
+    for k in manual:
+        np.testing.assert_allclose(fitted[k].asnumpy(),
+                                   manual[k].asnumpy(),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+# -- gluon ----------------------------------------------------------------
+
+def test_dataloader_device_prefetch_matches_plain():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(50, 4).astype("float32")
+    y = rs.randn(50).astype("float32")
+    ds = ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    plain = [tuple(a.asnumpy() for a in b)
+             for b in DataLoader(ds, batch_size=16)]
+    pre = [tuple(a.asnumpy() for a in b)
+           for b in DataLoader(ds, batch_size=16, prefetch=2)]
+    assert len(plain) == len(pre)
+    for p, q in zip(plain, pre):
+        for a, b in zip(p, q):
+            np.testing.assert_allclose(a, b)

@@ -195,6 +195,10 @@ spec:
           valueFrom:
             fieldRef:
               fieldPath: metadata.annotations['batch.kubernetes.io/job-completion-index']
+        - name: JAX_PROCESS_ID
+          valueFrom:
+            fieldRef:
+              fieldPath: metadata.annotations['batch.kubernetes.io/job-completion-index']
         - name: MXNET_NUM_WORKERS
           value: "{n}"
         - name: MXNET_COORDINATOR
