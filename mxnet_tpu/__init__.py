@@ -25,7 +25,7 @@ if _os.environ.get("JAX_PLATFORMS") == "cpu":
 from . import base
 from . import attribute
 from .attribute import AttrScope
-from .base import MXNetError
+from .base import MXNetError, TrainingPreempted
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context
 from . import random
@@ -66,6 +66,9 @@ from . import rnn
 from . import parallel
 from . import test_utils
 from .model import save_checkpoint, load_checkpoint
+from . import checkpoint
+from .checkpoint import CheckpointManager, CheckpointState
+from . import testing
 from . import models
 from . import name
 from . import libinfo

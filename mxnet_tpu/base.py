@@ -12,7 +12,8 @@ from __future__ import annotations
 import logging
 import os
 
-__all__ = ["MXNetError", "get_env", "string_types", "numeric_types", "logger"]
+__all__ = ["MXNetError", "TrainingPreempted", "get_env", "string_types",
+           "numeric_types", "logger"]
 
 logger = logging.getLogger("mxnet_tpu")
 
@@ -20,6 +21,20 @@ logger = logging.getLogger("mxnet_tpu")
 class MXNetError(RuntimeError):
     """Framework error type (mirrors ``MXNetError`` raised through the
     reference's C ABI ``MXGetLastError``, ``python/mxnet/base.py``)."""
+
+
+class TrainingPreempted(MXNetError):
+    """Raised by ``Module.fit`` after a SIGTERM/SIGINT arrived mid-run
+    and the final checkpoint was written: the loop stops at the next
+    batch boundary instead of dying inside a device call.  ``epoch`` and
+    ``nbatch`` name the checkpointed position so launchers can log and
+    reschedule with ``fit(resume_from=...)``."""
+
+    def __init__(self, msg, epoch=None, nbatch=None, signum=None):
+        super().__init__(msg)
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.signum = signum
 
 
 string_types = (str,)

@@ -1,0 +1,285 @@
+"""Fault-tolerant checkpointing: atomic writes, retention, resume state.
+
+The reference's checkpoint surface (``model.py`` ``save_checkpoint`` /
+``do_checkpoint`` callbacks) assumes the process survives the write; at
+pod scale workers are preempted mid-write, so this layer guarantees:
+
+* **Atomicity** — every file (symbol json, params, optimizer states,
+  metadata) is written to a temp name and published with ``os.replace``;
+  a crash at any point leaves either the previous checkpoint or the new
+  one, never a torn file (:func:`atomic_replace`).
+* **Rank-0 writes + barrier** — under a dist kvstore only rank 0 touches
+  the filesystem, and every rank meets at ``kvstore.barrier()`` after the
+  write so no peer resumes against a half-published checkpoint.
+* **Retention** — ``keep=N`` garbage-collects all but the newest N
+  epochs (params + states + metadata; the symbol file is shared and
+  kept).
+* **Resume metadata** — a ``-NNNN.meta.json`` sidecar records the epoch,
+  the mid-epoch batch offset of a preemption checkpoint, and the
+  optimizer ``num_update`` so ``Module.fit(resume_from=...)`` reproduces
+  the uninterrupted trajectory exactly (see ``docs/fault_tolerance.md``).
+
+File layout under ``prefix`` (reference filename contract preserved):
+``prefix-symbol.json``, ``prefix-NNNN.params``, ``prefix-NNNN.states``,
+``prefix-NNNN.meta.json``.  The epoch tag ``NNNN`` counts *completed*
+epochs; a preemption checkpoint taken mid-epoch E carries tag E with
+``nbatch > 0`` in its metadata.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from .base import MXNetError, logger
+
+__all__ = ["atomic_replace", "CheckpointManager", "CheckpointState",
+           "resolve_resume"]
+
+
+def atomic_replace(path, write_cb):
+    """Write ``path`` atomically: ``write_cb(tmp_path)`` produces the
+    content under a temp name (returning the actual path it wrote when a
+    writer appends its own suffix, e.g. numpy's ``.npz``), then one
+    ``os.replace`` publishes it.  On any failure the temp file is
+    removed and ``path`` is untouched — a reader can never observe a
+    torn write.  Site ``checkpoint_io`` of the fault harness fires
+    between write and publish, the worst possible crash point."""
+    from .testing import faults
+
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    actual = None
+    try:
+        actual = write_cb(tmp) or tmp
+        faults.inject("checkpoint_io")
+        os.replace(actual, path)
+    except BaseException:
+        for leftover in {tmp, actual}:
+            if leftover and os.path.exists(leftover):
+                try:
+                    os.remove(leftover)
+                except OSError:
+                    pass
+        raise
+    return path
+
+
+class CheckpointState:
+    """Everything ``fit(resume_from=...)`` needs to continue a run."""
+
+    def __init__(self, epoch, nbatch, num_update, symbol, arg_params,
+                 aux_params, states_path=None, prefix=None):
+        self.epoch = int(epoch)          # completed epochs
+        self.nbatch = int(nbatch)        # extra batches into epoch `epoch`
+        self.num_update = int(num_update)
+        self.symbol = symbol
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.states_path = states_path   # optimizer states file, or None
+        self.prefix = prefix
+
+    def __repr__(self):
+        return ("CheckpointState(epoch=%d, nbatch=%d, num_update=%d, "
+                "states=%r)" % (self.epoch, self.nbatch, self.num_update,
+                                self.states_path))
+
+
+class CheckpointManager:
+    """Atomic, rank-aware checkpoint store over a directory.
+
+    ``kvstore`` (optional) supplies rank/barrier semantics: rank 0 writes,
+    everyone barriers.  ``keep=N`` retains only the newest N epochs.
+    ``save_optimizer_states=False`` drops the states file (params-only
+    checkpoints, e.g. for export)."""
+
+    def __init__(self, directory, prefix="model", keep=None, kvstore=None,
+                 save_optimizer_states=True):
+        if keep is not None and int(keep) < 1:
+            raise MXNetError("CheckpointManager keep must be >= 1 or None "
+                             "(got %r)" % (keep,))
+        self.directory = str(directory)
+        self.prefix_name = prefix
+        self.keep = None if keep is None else int(keep)
+        self.kvstore = kvstore
+        self.save_optimizer_states = save_optimizer_states
+
+    @property
+    def prefix(self):
+        return os.path.join(self.directory, self.prefix_name)
+
+    # -- rank / barrier -------------------------------------------------
+    def _rank(self):
+        if self.kvstore is not None:
+            return int(self.kvstore.rank)
+        if os.environ.get("MXNET_COORDINATOR") or \
+                os.environ.get("MXNET_NUM_WORKERS"):
+            import jax
+
+            return jax.process_index()
+        return 0
+
+    def _barrier(self):
+        kv = self.kvstore
+        if kv is not None and getattr(kv, "_is_dist", False):
+            kv.barrier()
+
+    # -- paths ----------------------------------------------------------
+    def _params_path(self, epoch):
+        return "%s-%04d.params" % (self.prefix, epoch)
+
+    def _states_path(self, epoch):
+        return "%s-%04d.states" % (self.prefix, epoch)
+
+    def _meta_path(self, epoch):
+        return "%s-%04d.meta.json" % (self.prefix, epoch)
+
+    # -- save -----------------------------------------------------------
+    def save(self, module=None, epoch=0, nbatch=0, symbol=None,
+             arg_params=None, aux_params=None):
+        """Write one checkpoint.  Pass a bound ``module`` (params, aux,
+        symbol and optimizer states are pulled from it) or explicit
+        ``symbol``/``arg_params``/``aux_params``.  ``epoch`` counts
+        completed epochs; ``nbatch > 0`` marks a mid-epoch preemption
+        point.  Rank 0 writes, every rank barriers; returns the epoch
+        tag."""
+        from . import model as model_mod
+
+        epoch = int(epoch)
+        if module is not None:
+            if symbol is None:
+                symbol = module.symbol
+            if arg_params is None:
+                arg_params, aux_params = module.get_params()
+        if arg_params is None:
+            raise MXNetError("CheckpointManager.save needs a module or "
+                             "explicit arg_params")
+        aux_params = aux_params or {}
+
+        if self._rank() == 0:
+            os.makedirs(self.directory, exist_ok=True)
+            model_mod.save_checkpoint(self.prefix, epoch, symbol,
+                                      arg_params, aux_params)
+            have_states = False
+            if self.save_optimizer_states and module is not None and \
+                    getattr(module, "optimizer_initialized", False):
+                atomic_replace(self._states_path(epoch),
+                               lambda tmp: module.save_optimizer_states(tmp))
+                have_states = True
+            opt = getattr(module, "_optimizer", None) \
+                if module is not None else None
+            meta = {"epoch": epoch, "nbatch": int(nbatch),
+                    "num_update": int(getattr(opt, "num_update", 0) or 0),
+                    "have_states": have_states}
+            # meta goes LAST: its presence certifies the whole set; a
+            # crash before this line leaves a superseded-but-consistent
+            # previous checkpoint as latest()
+            atomic_replace(self._meta_path(epoch),
+                           lambda tmp: _write_json(tmp, meta))
+            self._gc()
+        self._barrier()
+        return epoch
+
+    # -- discovery / load ----------------------------------------------
+    def epochs(self):
+        """Sorted epoch tags that have a params file on disk."""
+        pat = re.compile(re.escape(self.prefix_name) + r"-(\d{4})\.params$")
+        found = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            m = pat.match(name)
+            if m:
+                found.append(int(m.group(1)))
+        return sorted(found)
+
+    def latest(self):
+        """Newest resumable epoch tag, or None when the directory holds
+        no checkpoint."""
+        eps = self.epochs()
+        return eps[-1] if eps else None
+
+    def load(self, epoch=None):
+        """Load a checkpoint into a :class:`CheckpointState` (newest when
+        ``epoch`` is None)."""
+        if epoch is None:
+            epoch = self.latest()
+            if epoch is None:
+                raise MXNetError(
+                    "no checkpoint found under %r (prefix %r)"
+                    % (self.directory, self.prefix_name))
+        from . import model as model_mod
+
+        symbol, arg_params, aux_params = model_mod.load_checkpoint(
+            self.prefix, epoch)
+        meta = self._read_meta(epoch)
+        states = self._states_path(epoch)
+        return CheckpointState(
+            epoch=meta.get("epoch", epoch), nbatch=meta.get("nbatch", 0),
+            num_update=meta.get("num_update", 0), symbol=symbol,
+            arg_params=arg_params, aux_params=aux_params,
+            states_path=states if os.path.exists(states) else None,
+            prefix=self.prefix)
+
+    def _read_meta(self, epoch):
+        path = self._meta_path(epoch)
+        if not os.path.exists(path):
+            # bare save_checkpoint output (no manager metadata): resume
+            # from the epoch boundary the filename encodes
+            return {"epoch": epoch, "nbatch": 0, "num_update": 0}
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            raise MXNetError("checkpoint metadata %r is corrupt: %s"
+                             % (path, e)) from e
+
+    # -- retention ------------------------------------------------------
+    def _gc(self):
+        if self.keep is None:
+            return
+        doomed = self.epochs()[:-self.keep]
+        for epoch in doomed:
+            for path in (self._params_path(epoch), self._states_path(epoch),
+                         self._meta_path(epoch)):
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+                except OSError as e:  # keep training; disk GC can wait
+                    logger.warning("checkpoint GC could not remove %s: %s",
+                                   path, e)
+        if doomed:
+            logger.info("checkpoint GC removed epochs %s (keep=%d)",
+                        doomed, self.keep)
+
+
+def _write_json(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+
+
+def resolve_resume(resume_from, kvstore=None):
+    """Normalize ``fit(resume_from=...)`` into a :class:`CheckpointState`.
+
+    Accepts a :class:`CheckpointState`, a :class:`CheckpointManager`
+    (loads its latest), a ``prefix`` string (directory/prefix of manager
+    or bare ``save_checkpoint`` output), or a ``(prefix, epoch)`` pair.
+    """
+    if isinstance(resume_from, CheckpointState):
+        return resume_from
+    if isinstance(resume_from, CheckpointManager):
+        return resume_from.load()
+    if isinstance(resume_from, str):
+        head, tail = os.path.split(resume_from)
+        return CheckpointManager(head or ".", tail or "model",
+                                 kvstore=kvstore).load()
+    if isinstance(resume_from, (tuple, list)) and len(resume_from) == 2:
+        prefix, epoch = resume_from
+        head, tail = os.path.split(str(prefix))
+        return CheckpointManager(head or ".", tail or "model",
+                                 kvstore=kvstore).load(int(epoch))
+    raise MXNetError(
+        "resume_from must be a CheckpointState, CheckpointManager, prefix "
+        "string or (prefix, epoch) pair (got %r)" % (resume_from,))

@@ -24,6 +24,49 @@ __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "LibSVMIter", "ImageDetRecordIter"]
 
 
+def _queue_get_or_die(q, thread, what, poll_s=0.2):
+    """``queue.get`` that survives worker death.
+
+    A plain blocking ``get`` deadlocks the consumer forever when the
+    worker thread died without enqueueing its end-of-data sentinel (hard
+    crash, injected kill, interpreter teardown race).  Poll instead:
+    whenever the queue stays empty, check the worker is still alive and
+    raise a diagnosable :class:`MXNetError` the moment it is not (after
+    one final non-blocking drain to close the put-then-exit race)."""
+    while True:
+        try:
+            return q.get(timeout=poll_s)
+        except queue.Empty:
+            if thread is None or not thread.is_alive():
+                try:
+                    return q.get_nowait()
+                except queue.Empty:
+                    raise MXNetError(
+                        "%s worker thread died without delivering a "
+                        "batch, an error, or end-of-data; the input "
+                        "pipeline is broken (worker crashed or was "
+                        "killed)" % what) from None
+
+
+def _fault_hook(site, out_queue, stop_event):
+    """Run the fault-injection hook for a worker loop.  Returns True when
+    the worker must die *silently* (injected ``kill`` — no sentinel, no
+    error: the consumer-side dead-worker detection is what's under
+    test); a ``raise`` fault is forwarded through the queue like any
+    organic worker error."""
+    from .testing import faults
+
+    try:
+        faults.inject(site)
+    except faults.WorkerKilled:
+        return True
+    except Exception as exc:
+        if not stop_event.is_set():
+            out_queue.put(exc)
+        return True
+    return False
+
+
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
     """Data description (reference ``DataDesc``: name, shape, dtype, layout)."""
 
@@ -114,7 +157,7 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
@@ -124,6 +167,13 @@ class NDArrayIter(DataIter):
             "batch_size needs to be smaller than data size."
         self.idx = np.arange(self.num_data)
         self.shuffle = shuffle
+        # a private RNG makes the shuffle sequence a pure function of
+        # (seed, reset count) — required for exact replay by
+        # ``fit(resume_from=...)``, which fast-forwards by replaying
+        # resets (the global np.random stream also feeds initializers,
+        # so its draw position differs between cold start and resume)
+        self._rng = np.random.RandomState(seed) if seed is not None \
+            else np.random
         self.last_batch_handle = last_batch_handle
         if last_batch_handle == "discard":
             self.num_data = (self.num_data // batch_size) * batch_size
@@ -145,7 +195,7 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            self._rng.shuffle(self.idx)
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data)
@@ -238,6 +288,7 @@ class PrefetchingIter(DataIter):
         self._thread = None
         self.current_batch = None
         self._worker_error = None
+        self._exhausted = False
         self._start()
 
     @property
@@ -260,6 +311,8 @@ class PrefetchingIter(DataIter):
 
     def _worker(self):
         while not self._stop.is_set():
+            if _fault_hook("prefetch", self._queue, self._stop):
+                return
             try:
                 batches = [i.next() for i in self.iters]
             except StopIteration:
@@ -287,16 +340,25 @@ class PrefetchingIter(DataIter):
             self._thread.join(timeout=5)
         self._drain()
         self._worker_error = None
+        self._exhausted = False
         for i in self.iters:
             i.reset()
         self._start()
 
-    def _drain(self):
+    def _drain(self, capture_error=False):
+        """Empty the queue; with ``capture_error`` return the first
+        pending worker exception found (an error the consumer never got
+        to see), else None."""
+        pending = None
         try:
             while True:
-                self._queue.get_nowait()
+                item = self._queue.get_nowait()
+                if capture_error and pending is None and \
+                        isinstance(item, Exception):
+                    pending = item
         except queue.Empty:
             pass
+        return pending
 
     def iter_next(self):
         if self._worker_error is not None:
@@ -304,8 +366,16 @@ class PrefetchingIter(DataIter):
             # reset() restarts the stream) instead of hanging on the
             # empty queue
             raise self._worker_error
-        batches = self._queue.get()
+        if self._exhausted:
+            return False
+        try:
+            batches = _queue_get_or_die(self._queue, self._thread,
+                                        type(self).__name__)
+        except MXNetError as e:
+            self._worker_error = e  # dead worker: fail every later call
+            raise
         if batches is None:
+            self._exhausted = True
             return False
         if isinstance(batches, Exception):
             self._worker_error = batches
@@ -332,6 +402,30 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def close(self, timeout=5):
+        """Stop the worker WITHOUT restarting it (``reset`` is
+        stop-then-restart): signal stop, drain so a worker blocked on
+        the full queue can exit, join with ``timeout``, and RE-RAISE any
+        worker exception still pending in the queue — an error the
+        consumer never observed must not vanish on teardown.  After
+        ``close`` the iterator reports exhaustion until ``reset``."""
+        self._stop.set()
+        pending = self._drain(capture_error=True)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                import logging
+
+                logging.warning("%s worker did not exit within %ss on "
+                                "close()", type(self).__name__, timeout)
+            self._thread = None
+        pending = pending or self._drain(capture_error=True)
+        self._exhausted = True
+        if pending is not None and pending is not self._worker_error:
+            self._worker_error = pending
+            raise pending
 
     def __del__(self):
         self._stop.set()
@@ -466,6 +560,8 @@ class DevicePrefetchIter(DataIter):
     # -- worker ---------------------------------------------------------
     def _worker(self):
         while not self._stop.is_set():
+            if _fault_hook("device_prefetch", self._queue, self._stop):
+                return
             group = []
             try:
                 for _ in range(self._pack):
@@ -498,12 +594,17 @@ class DevicePrefetchIter(DataIter):
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
-    def _drain(self):
+    def _drain(self, capture_error=False):
+        pending = None
         try:
             while True:
-                self._queue.get_nowait()
+                item = self._queue.get_nowait()
+                if capture_error and pending is None and \
+                        isinstance(item, Exception):
+                    pending = item
         except queue.Empty:
             pass
+        return pending
 
     def reset(self):
         # same protocol as PrefetchingIter.reset: stop, drain so a worker
@@ -529,7 +630,12 @@ class DevicePrefetchIter(DataIter):
             # keep returning False (the worker is gone — a fresh get()
             # would block forever); reset() restarts the stream
             return False
-        batch = self._queue.get()
+        try:
+            batch = _queue_get_or_die(self._queue, self._thread,
+                                      "DevicePrefetchIter")
+        except MXNetError as e:
+            self._worker_error = e  # dead worker: fail every later call
+            raise
         if batch is None:
             self._exhausted = True
             return False
@@ -556,18 +662,30 @@ class DevicePrefetchIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
-    def close(self):
+    def close(self, timeout=5):
         """Stop the staging thread WITHOUT restarting it (``reset`` is
-        stop-then-restart).  After ``close`` the iterator reports
-        exhaustion until ``reset``; the inner iterators are left
-        untouched for the caller to reuse."""
+        stop-then-restart): signal stop, drain so a worker blocked on
+        the full queue can exit, join with ``timeout``, and RE-RAISE any
+        worker exception still pending in the queue — an error the
+        consumer never observed must not vanish on teardown.  After
+        ``close`` the iterator reports exhaustion until ``reset``; the
+        inner iterators are left untouched for the caller to reuse."""
         self._stop.set()
-        self._drain()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        pending = self._drain(capture_error=True)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                import logging
+
+                logging.warning("DevicePrefetchIter staging worker did "
+                                "not exit within %ss on close()", timeout)
             self._thread = None
-        self._drain()
+        pending = pending or self._drain(capture_error=True)
         self._exhausted = True
+        if pending is not None and pending is not self._worker_error:
+            self._worker_error = pending
+            raise pending
 
     def __del__(self):
         self._stop.set()

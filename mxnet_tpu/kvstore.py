@@ -29,12 +29,67 @@ remaining backward compute.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
-from .base import MXNetError
+from .base import MXNetError, get_env, logger
 from .ndarray import NDArray, zeros, imperative_invoke
 
 __all__ = ["KVStore", "create"]
+
+
+def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0):
+    """Run ``fn()`` under a wall-clock bound with retry/backoff.
+
+    The DCN rendezvous and collectives block inside C calls with no
+    native timeout: one wedged or dead peer deadlocks every healthy rank
+    forever.  ``fn`` therefore runs on a helper thread; if it has not
+    finished within ``timeout_s`` (``MXNET_KV_TIMEOUT_S``, 0 disables
+    the bound) a diagnosable :class:`MXNetError` names the wedged site
+    instead.  Transient non-MXNetError failures are retried up to
+    ``retries`` times (``MXNET_KV_RETRIES``) with exponential backoff —
+    rendezvous races at job start are the common case.  The abandoned
+    helper thread cannot be killed; it is left daemonized (the process
+    is about to fail loudly anyway, which is the point)."""
+    import threading
+    import time
+
+    if timeout_s is None:
+        timeout_s = get_env("MXNET_KV_TIMEOUT_S", 300.0, float)
+    attempt = 0
+    while True:
+        box = {}
+
+        def _call():
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — forwarded below
+                box["error"] = e
+
+        t = threading.Thread(target=_call, daemon=True,
+                             name="kv-bounded:%s" % what)
+        t.start()
+        t.join(timeout=timeout_s if timeout_s and timeout_s > 0 else None)
+        if t.is_alive():
+            raise MXNetError(
+                "%s did not complete within %.0fs (MXNET_KV_TIMEOUT_S); "
+                "a peer process is likely wedged, dead, or unreachable — "
+                "check every worker's log before restarting the job"
+                % (what, timeout_s))
+        if "error" not in box:
+            return box.get("value")
+        err = box["error"]
+        if attempt >= retries or isinstance(
+                err, (MXNetError, KeyboardInterrupt, SystemExit)):
+            if isinstance(err, MXNetError):
+                raise err
+            raise MXNetError("%s failed after %d attempt(s): %s"
+                             % (what, attempt + 1, err)) from err
+        attempt += 1
+        logger.warning("%s failed (%s); retry %d/%d in %.1fs",
+                       what, err, attempt, retries, backoff_s)
+        time.sleep(backoff_s)
+        backoff_s *= 2
 
 _VALID_TYPES = ("local", "local_allreduce_cpu", "local_allreduce_device",
                 "device", "dist_sync", "dist_device_sync", "dist_async",
@@ -64,10 +119,17 @@ class KVStore:
             # (MXNET_COORDINATOR & co.); no-op single-process.  This is
             # what makes the documented quick-start actually synchronize
             # — without it each worker would silently train a separate
-            # replica (jax.process_count() == 1 everywhere).
+            # replica (jax.process_count() == 1 everywhere).  Bounded +
+            # retried: rendezvous against a coordinator that is still
+            # starting is the normal cold-start race, and rendezvous
+            # against one that never comes up must fail loudly, not
+            # hang the worker forever.
             from .parallel import init_distributed
 
-            init_distributed()
+            _run_bounded(init_distributed,
+                         "KVStore %r init (jax.distributed rendezvous)"
+                         % kv_type,
+                         retries=get_env("MXNET_KV_RETRIES", 2, int))
         self._is_async = "async" in kv_type
         if self._is_async:
             # The reference's dist_async servers apply each worker's
@@ -83,8 +145,6 @@ class KVStore:
             # number of steps per epoch, since averaging is a
             # collective).  Staleness is bounded by the averaging
             # window; see docs/distributed.md.
-            from .base import get_env
-
             self._async_period = get_env("MXNET_ASYNC_SYNC_PERIOD", 0,
                                          int)
             self._async_steps = 0
@@ -145,8 +205,10 @@ class KVStore:
                 # comm-hygiene analogue of the reference's priority
                 # batching (callers push keys in priority order,
                 # model.py:105-116)
-                reduced = self._cross_replica_sum_flat(
-                    [merged_list[i] for i in dense_idx])
+                dense = [merged_list[i] for i in dense_idx]
+                reduced = self._bounded_collective(
+                    lambda: self._cross_replica_sum_flat(dense),
+                    "KVStore batched cross-replica gradient sum")
                 for i, m in zip(dense_idx, reduced):
                     merged_list[i] = m
                 batched = set(dense_idx)
@@ -301,9 +363,14 @@ class KVStore:
             return
         from jax.experimental import multihost_utils
 
-        for arr in arrays:
-            gathered = multihost_utils.process_allgather(arr._data)
-            arr._set_data(jax.device_put(gathered.mean(axis=0)))
+        def _average():
+            for arr in arrays:
+                gathered = multihost_utils.process_allgather(arr._data)
+                arr._set_data(jax.device_put(gathered.mean(axis=0)))
+
+        self._bounded_collective(
+            _average, "KVStore.sync_params (parameter-averaging round)",
+            retries=0)
 
     def _async_tick(self, arrays):
         """Count one local update; run an averaging round every
@@ -321,25 +388,98 @@ class KVStore:
     def barrier(self):
         """Global barrier (reference ``MXKVStoreBarrier``).  Under SPMD all
         replicas run in lockstep inside compiled steps; between steps we
-        only need to drain local async work."""
+        drain local async work, and multi-process stores additionally
+        rendezvous over DCN — bounded by ``MXNET_KV_TIMEOUT_S`` so one
+        dead rank surfaces as an MXNetError on the survivors instead of
+        an eternal hang (checkpoint rank-0-writes relies on this)."""
         from .ndarray import waitall
 
         waitall()
+        if not self._is_dist:
+            return
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+
+        def _rendezvous():
+            from .testing import faults
+
+            faults.inject("collective")
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            multihost_utils.process_allgather(np.zeros((1,), "int32"))
+
+        _run_bounded(_rendezvous, "KVStore.barrier (DCN rendezvous)")
+
+    def _bounded_collective(self, fn, what, retries=None):
+        """Run a cross-process collective under the KV timeout (identity
+        wrapper single-process — no helper thread on the hot local
+        path).  Site ``collective`` of the fault harness fires first, so
+        tests can wedge/fail the DCN path deterministically.  Pass
+        ``retries=0`` for calls that mutate state in place (a partial
+        retry would re-reduce already-reduced values)."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return fn()
+
+        def _go():
+            from .testing import faults
+
+            faults.inject("collective")
+            return fn()
+
+        if retries is None:
+            retries = get_env("MXNET_KV_RETRIES", 2, int)
+        return _run_bounded(_go, what, retries=retries)
 
     def _send_command_to_servers(self, head, body):
         pass  # no servers in the TPU design
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Write the updater's optimizer states atomically (temp +
+        ``os.replace``).  Rank-0-writes contract: non-rank-0 callers are
+        a graceful no-op, so symmetric SPMD scripts can call this
+        unconditionally without N ranks racing on one file."""
         if self._updater is None:
-            raise MXNetError("Cannot save states for distributed training")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states())
+            raise MXNetError(
+                "save_optimizer_states needs a worker-side updater: call "
+                "set_optimizer (update_on_kvstore) first — with updates "
+                "running outside the store there are no states here to "
+                "save")
+        if self.rank != 0:
+            logger.debug("save_optimizer_states: rank %d skips the write "
+                         "(rank 0 owns the file)", self.rank)
+            return
+        payload = self._updater.get_states()
+        from .checkpoint import atomic_replace
+
+        def _write(tmp):
+            with open(tmp, "wb") as f:
+                f.write(payload)
+
+        atomic_replace(fname, _write)
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
-            raise MXNetError("Cannot load states for distributed training")
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            raise MXNetError(
+                "load_optimizer_states needs a worker-side updater: call "
+                "set_optimizer (update_on_kvstore) first")
+        if not os.path.exists(fname):
+            raise MXNetError(
+                "optimizer states file %r does not exist — was the "
+                "checkpoint written with save_optimizer_states on rank 0, "
+                "and is its directory visible from this rank?" % fname)
+        try:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError("optimizer states file %r is corrupt: %s"
+                             % (fname, e)) from e
 
     # -- internals ------------------------------------------------------
     @staticmethod
@@ -406,10 +546,17 @@ class KVStore:
         for per-chip partial gradients (ICI collective, requires the
         caller to declare the stack via ``is_partial_stack``), over DCN
         for multi-process values; identity when the pushed gradient is
-        already global (the fused SPMD step's case)."""
+        already global (the fused SPMD step's case).  The multi-process
+        branch runs under the ``MXNET_KV_TIMEOUT_S`` bound: a wedged
+        peer raises instead of deadlocking the push."""
         from .parallel import collectives
         from .parallel.mesh import current_mesh
 
         mesh = getattr(self, "_mesh", None) or current_mesh()
-        return collectives.allreduce_nd(arr, mesh=mesh,
-                                        is_partial_stack=is_partial_stack)
+        if is_partial_stack:  # pure in-chip reduce, no DCN to wedge on
+            return collectives.allreduce_nd(arr, mesh=mesh,
+                                            is_partial_stack=True)
+        return self._bounded_collective(
+            lambda: collectives.allreduce_nd(
+                arr, mesh=mesh, is_partial_stack=is_partial_stack),
+            "KVStore cross-replica gradient sum")

@@ -21,21 +21,52 @@ from .module.base_module import BatchEndParam  # re-export (reference home)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Atomic: both files are written to temp names and published with
+    ``os.replace`` (:func:`mxnet_tpu.checkpoint.atomic_replace`), so a
+    crash mid-save can never leave a partial ``-symbol.json``/``.params``
+    pair on disk — a previous checkpoint under the same prefix survives
+    untouched."""
+    from .checkpoint import atomic_replace
+
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        atomic_replace("%s-symbol.json" % prefix,
+                       lambda tmp: symbol.save(tmp))
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd_save(param_name, save_dict)
-    # numpy appends .npz; keep the reference filename
-    if os.path.exists(param_name + ".npz"):
-        os.replace(param_name + ".npz", param_name)
+
+    def _write(tmp):
+        nd_save(tmp, save_dict)
+        # numpy appends .npz to extension-less names; report the real
+        # temp file so the rename publishes the reference filename
+        return tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp
+
+    atomic_replace(param_name, _write)
 
 
 def load_checkpoint(prefix, epoch):
-    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    symbol_file = "%s-symbol.json" % prefix
     param_name = "%s-%04d.params" % (prefix, epoch)
-    save_dict = nd_load(param_name)
+    if not os.path.exists(symbol_file):
+        raise MXNetError(
+            "checkpoint %r has no symbol file: %s is missing"
+            % (prefix, symbol_file))
+    try:
+        symbol = sym_mod.load(symbol_file)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError("checkpoint symbol file %s is corrupt: %s"
+                         % (symbol_file, e)) from e
+    if not os.path.exists(param_name):
+        raise MXNetError(
+            "checkpoint %r has no params for epoch %d: %s is missing"
+            % (prefix, epoch, param_name))
+    try:
+        save_dict = nd_load(param_name)
+    except Exception as e:
+        raise MXNetError("checkpoint params file %s is corrupt: %s"
+                         % (param_name, e)) from e
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, name = k.split(":", 1)

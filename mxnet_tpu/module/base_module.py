@@ -8,9 +8,12 @@ the compute under it is XLA instead of engine-pushed closures.
 from __future__ import annotations
 
 import logging
+import signal
+import sys
+import threading
 import time
 
-from ..base import MXNetError
+from ..base import MXNetError, TrainingPreempted
 from .. import metric as metric_mod
 from .. import io as io_mod
 from ..ndarray import NDArray
@@ -20,6 +23,46 @@ __all__ = ["BaseModule"]
 
 def _as_metric(m):
     return m if isinstance(m, metric_mod.EvalMetric) else metric_mod.create(m)
+
+
+class _PreemptionGuard:
+    """SIGTERM/SIGINT watcher for the duration of one ``fit``.
+
+    The handler only records the signal (the async-signal-safe minimum);
+    the training loop polls ``fired`` at batch boundaries, where params/
+    optimizer state are consistent, drains the prefetch pipeline, writes
+    the final checkpoint, and raises :class:`TrainingPreempted`.  Python
+    only allows signal handlers on the main thread, so installation is a
+    no-op elsewhere (a fit running on a worker thread trains exactly as
+    before).  Previous handlers are restored on exit."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, enabled=True):
+        self.fired = None
+        self._prev = {}
+        self._enabled = enabled and \
+            threading.current_thread() is threading.main_thread()
+
+    def __enter__(self):
+        if self._enabled:
+            for sig in self.SIGNALS:
+                try:
+                    self._prev[sig] = signal.signal(sig, self._record)
+                except (ValueError, OSError):  # embedded interpreter etc.
+                    pass
+        return self
+
+    def _record(self, signum, frame):
+        self.fired = signum
+
+    def __exit__(self, exc_type, exc, tb):
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        return False
 
 
 class BaseModule:
@@ -147,7 +190,8 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, param_sharding=None, compute_dtype=None,
             prefetch_to_device=None, prefetch_depth=2,
-            metric_sync_period=None, steps_per_call=None):
+            metric_sync_period=None, steps_per_call=None,
+            checkpoint=None, checkpoint_period=1, resume_from=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``), pipelined: by default the train iterator
         is wrapped in :class:`~mxnet_tpu.io.DevicePrefetchIter` so batch
@@ -170,13 +214,51 @@ class BaseModule:
         * ``steps_per_call`` — dispatch K optimizer steps as one device
           call (``lax.scan`` over a packed super-batch staged by the
           prefetcher); requires the fused step (``MXNET_STEPS_PER_CALL``).
+
+        fault tolerance (see ``docs/fault_tolerance.md``):
+
+        * ``checkpoint`` — a
+          :class:`~mxnet_tpu.checkpoint.CheckpointManager` (or a
+          directory path for one with defaults).  Epoch-end checkpoints
+          are written every ``checkpoint_period`` epochs, and a SIGTERM/
+          SIGINT arriving mid-run stops the loop at the next batch
+          boundary, writes a final mid-epoch checkpoint, and raises
+          :class:`~mxnet_tpu.base.TrainingPreempted`.
+        * ``resume_from`` — a ``CheckpointState``/``CheckpointManager``/
+          prefix string/``(prefix, epoch)`` pair (see
+          :func:`~mxnet_tpu.checkpoint.resolve_resume`): params, aux,
+          optimizer states and update counters are restored and the data
+          stream is fast-forwarded to the recorded position, so the run
+          continues the uninterrupted trajectory.
         """
         from ..base import get_env
         from ..initializer import Uniform
+        from .. import checkpoint as ckpt_mod
 
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             initializer = Uniform(0.01)
+
+        mgr = None
+        if checkpoint is not None:
+            mgr = checkpoint \
+                if isinstance(checkpoint, ckpt_mod.CheckpointManager) \
+                else ckpt_mod.CheckpointManager(str(checkpoint))
+
+        resume_state = None
+        if resume_from is not None:
+            resume_state = ckpt_mod.resolve_resume(resume_from)
+            # checkpointed params take over; whatever the caller passed
+            # was the cold-start initialization this run supersedes
+            arg_params = resume_state.arg_params
+            aux_params = resume_state.aux_params
+            force_init = True
+            begin_epoch = resume_state.epoch
+            self.logger.info(
+                "resuming fit from %r: epoch %d, batch offset %d, "
+                "num_update %d", resume_state.prefix or resume_from,
+                resume_state.epoch, resume_state.nbatch,
+                resume_state.num_update)
 
         K = max(1, int(steps_per_call if steps_per_call is not None
                        else get_env("MXNET_STEPS_PER_CALL", 1, int)))
@@ -205,6 +287,17 @@ class BaseModule:
             opt_kwargs["steps_per_call"] = K
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, **opt_kwargs)
+
+        if mgr is not None and mgr.kvstore is None:
+            # the manager inherits rank/barrier semantics from the store
+            # the fit actually trains against
+            mgr.kvstore = getattr(self, "_kvstore", None)
+        if resume_state is not None:
+            self._restore_from(resume_state)
+            # fast-forward the RAW iterator before the staging wrap: the
+            # prefetch worker starts pulling batches at construction
+            self._fast_forward_data(train_data, resume_state.epoch,
+                                    resume_state.nbatch)
 
         # wrap AFTER init_optimizer: staging placement follows the mesh
         # the optimizer decided on (kvstore type → mesh)
@@ -237,80 +330,191 @@ class BaseModule:
                              validation_metric, monitor,
                              batch_end_callback, epoch_end_callback,
                              eval_end_callback, eval_batch_end_callback,
-                             begin_epoch, num_epoch, K)
+                             begin_epoch, num_epoch, K,
+                             mgr=mgr, checkpoint_period=checkpoint_period,
+                             resume_nbatch=resume_state.nbatch
+                             if resume_state is not None else 0)
         finally:
             if fit_data is not train_data:
                 # the staging worker must not outlive fit: it would keep
                 # consuming the caller's iterator (stealing the batches a
                 # follow-up fit/score would read) and can sit inside a
                 # device_put when the interpreter tears the runtime down
-                fit_data.close()
+                in_flight = sys.exc_info()[0] is not None
+                try:
+                    fit_data.close()
+                except Exception:
+                    # close() re-raises worker errors the loop never saw;
+                    # surface them on a clean exit, but never let them
+                    # mask the exception already propagating
+                    if not in_flight:
+                        raise
+                    self.logger.exception(
+                        "prefetch close() failed during fit teardown; "
+                        "keeping the original error")
                 train_data.reset()
 
     def _fit_epochs(self, fit_data, eval_data, eval_metric,
                     validation_metric, monitor, batch_end_callback,
                     epoch_end_callback, eval_end_callback,
-                    eval_batch_end_callback, begin_epoch, num_epoch, K):
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(fit_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                # lookahead next() AFTER dispatch: pulling batch n+1 off
-                # the staging queue (and refilling it) overlaps the step
-                # that is still executing asynchronously on device
-                try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
-                if K > 1:
-                    outs = self.get_outputs()
-                    labels = data_batch.label or []
-                    for k in range(K):
-                        self.update_metric(eval_metric,
-                                           [l[k] for l in labels],
-                                           outputs=[o[k] for o in outs])
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    for cb in _as_list(batch_end_callback):
-                        cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                         eval_metric=eval_metric,
-                                         locals=locals()))
-                nbatch += K
+                    eval_batch_end_callback, begin_epoch, num_epoch, K,
+                    mgr=None, checkpoint_period=1, resume_nbatch=0):
+        period = max(1, int(checkpoint_period))
+        with _PreemptionGuard() as guard:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                # a resumed mid-epoch run keeps counting from its recorded
+                # offset so a second preemption checkpoints the true
+                # position (the metric only covers the replayed remainder)
+                nbatch = resume_nbatch if epoch == begin_epoch else 0
+                data_iter = iter(fit_data)
+                end_of_batch = False
+                next_data_batch = next(data_iter)
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    # lookahead next() AFTER dispatch: pulling batch n+1 off
+                    # the staging queue (and refilling it) overlaps the step
+                    # that is still executing asynchronously on device
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
+                    if K > 1:
+                        outs = self.get_outputs()
+                        labels = data_batch.label or []
+                        for k in range(K):
+                            self.update_metric(eval_metric,
+                                               [l[k] for l in labels],
+                                               outputs=[o[k] for o in outs])
+                    else:
+                        self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        for cb in _as_list(batch_end_callback):
+                            cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                             eval_metric=eval_metric,
+                                             locals=locals()))
+                    nbatch += K
+                    if guard.fired is not None:
+                        # batch boundary: params/optimizer state consistent
+                        self._preempt(guard.fired, fit_data, mgr,
+                                      epoch, nbatch)
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
 
-            self._epoch_end_sync()
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+                self._epoch_end_sync()
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
 
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_params_, aux_params_)
+                if mgr is not None and ((epoch + 1) % period == 0
+                                        or epoch + 1 == num_epoch):
+                    mgr.save(self, epoch=epoch + 1, nbatch=0)
 
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
-                                     epoch, name, val)
-            fit_data.reset()
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_params_, aux_params_)
+
+                if guard.fired is not None:
+                    # signal landed in the epoch tail: skip eval and stop
+                    # at the epoch boundary (tag = completed epochs)
+                    self._preempt(guard.fired, fit_data, mgr, epoch + 1, 0)
+
+                if eval_data is not None:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                fit_data.reset()
+
+    # -- fault tolerance hooks ------------------------------------------
+    def _preempt(self, signum, fit_data, mgr, epoch, nbatch):
+        """Shut the pipeline down, write the final checkpoint, and raise
+        :class:`TrainingPreempted` carrying the checkpointed position."""
+        self.logger.warning(
+            "signal %d received: stopping training at epoch %d, batch %d%s",
+            signum, epoch, nbatch,
+            "" if mgr is None else "; writing final checkpoint")
+        close = getattr(fit_data, "close", None)
+        if close is not None:
+            try:
+                # drain the staging worker first so the checkpoint write
+                # does not race an in-flight device_put
+                close()
+            except Exception:
+                self.logger.exception(
+                    "prefetch teardown failed during preemption; "
+                    "continuing to the checkpoint write")
+        if mgr is not None:
+            mgr.save(self, epoch=epoch, nbatch=nbatch)
+        raise TrainingPreempted(
+            "training preempted by signal %d at epoch %d, batch %d%s"
+            % (signum, epoch, nbatch,
+               "; checkpoint written under %r" % mgr.prefix
+               if mgr is not None else " (no checkpoint manager "
+               "configured — pass fit(checkpoint=...) to save on "
+               "preemption)"),
+            epoch=epoch, nbatch=nbatch, signum=signum)
+
+    def _restore_from(self, state):
+        """Apply the optimizer side of a resume after ``init_optimizer``:
+        load the states file, then pin the update counters on EVERY
+        optimizer copy (the module's, the worker-side updater's, and the
+        kvstore's pickled clone) so lr schedules and bias correction
+        continue from the checkpointed step instead of restarting — on
+        both the split path (counts via ``_index_update_count``) and the
+        fused path (reads ``num_update`` directly)."""
+        if state.states_path is not None and \
+                hasattr(self, "load_optimizer_states"):
+            self.load_optimizer_states(state.states_path)
+        n = int(state.num_update)
+        kv = getattr(self, "_kvstore", None)
+        opts = []
+        for o in (getattr(self, "_optimizer", None),
+                  getattr(getattr(self, "_updater", None), "optimizer",
+                          None),
+                  getattr(kv, "_optimizer", None),
+                  getattr(getattr(kv, "updater", None), "optimizer", None)):
+            if o is not None and not any(o is seen for seen in opts):
+                opts.append(o)
+        for o in opts:
+            o.begin_num_update = n
+            o.num_update = n
+            # lazily refilled from begin_num_update on the next update,
+            # which makes the next step number n + 1 on every path
+            o._index_update_count = {}
+
+    def _fast_forward_data(self, train_data, epochs, nbatch):
+        """Replay the raw data stream to a mid-run position: one
+        ``reset()`` per completed epoch reproduces the shuffle-RNG draw
+        sequence an uninterrupted run performs at its epoch boundaries
+        (given the same process-level seeding — see
+        ``docs/fault_tolerance.md``), then ``nbatch`` batches are drawn
+        and discarded."""
+        for _ in range(int(epochs)):
+            train_data.reset()
+        for skipped in range(int(nbatch)):
+            try:
+                train_data.next()
+            except StopIteration:
+                self.logger.warning(
+                    "resume fast-forward exhausted the epoch after %d of "
+                    "%d batches; continuing from the epoch boundary",
+                    skipped, nbatch)
+                break
 
     def install_monitor(self, monitor):
         raise NotImplementedError

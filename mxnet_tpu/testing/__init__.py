@@ -1,0 +1,11 @@
+"""Testing utilities: deterministic fault injection for resilience tests.
+
+The reference ships its chaos tooling as nightly scripts
+(``tests/nightly/test_kvstore.py`` restart loops); the TPU build makes
+fault injection a first-class, deterministic harness
+(:mod:`mxnet_tpu.testing.faults`, driven by ``MXNET_FAULT_INJECT``) so
+preemption, IO failure, and wedged-collective behavior are unit-testable.
+"""
+from . import faults
+
+__all__ = ["faults"]

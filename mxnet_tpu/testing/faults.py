@@ -1,0 +1,160 @@
+"""Deterministic fault injection, driven by ``MXNET_FAULT_INJECT``.
+
+Production code calls :func:`inject` at a named *site*; the env var
+decides whether anything happens there, so the hooks are free when the
+variable is unset.  Grammar (entries separated by ``,`` or ``;``, fields
+by ``:``)::
+
+    MXNET_FAULT_INJECT = "<site>:<action>[:key=value]*[,...]"
+
+Actions:
+
+* ``raise`` — raise :class:`FaultInjected` (an ``MXNetError``) at the
+  site.  In a prefetch worker this models a crashing decode thread whose
+  error must surface at the consumer's next ``next()``.
+* ``kill``  — raise :class:`WorkerKilled`.  Worker loops catch it
+  *explicitly* and return without enqueueing anything, modeling a thread
+  that dies silently (OOM-killed, segfaulted C extension); the consumer
+  must detect the dead worker instead of blocking on the queue forever.
+  ``WorkerKilled`` deliberately subclasses ``BaseException`` so generic
+  ``except Exception`` error-forwarding paths cannot swallow it into the
+  "clean error" channel.
+* ``delay`` — sleep ``seconds`` at the site, modeling a wedged peer or a
+  slow network; used to trip the ``MXNET_KV_TIMEOUT_S`` watchdogs.
+
+Keys:
+
+* ``after=N``  — fire on the Nth hit of the site (default 1).  Hits are
+  counted per spec entry, so ``prefetch:raise:after=3`` lets exactly two
+  batches through first — deterministic by construction.
+* ``seconds=S`` — sleep length for ``delay`` (default 1.0).
+* ``sticky=1`` — keep firing on every hit >= ``after`` instead of once.
+
+Sites instrumented today: ``device_prefetch`` / ``prefetch`` (the io.py
+worker loops), ``checkpoint_io`` (between temp-file write and the atomic
+rename), ``collective`` (kvstore DCN barrier / cross-replica sum).
+
+The parsed spec auto-refreshes when the env var string changes; call
+:func:`reset` to re-arm counters when reusing the same string (tests).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["FaultInjected", "WorkerKilled", "inject", "reset", "active"]
+
+ENV_VAR = "MXNET_FAULT_INJECT"
+
+_ACTIONS = ("raise", "kill", "delay")
+
+
+class FaultInjected(MXNetError):
+    """The error an injected ``raise`` fault throws (an ``MXNetError`` so
+    production error paths treat it exactly like an organic failure)."""
+
+
+class WorkerKilled(BaseException):
+    """Injected silent-death signal for worker threads.  BaseException on
+    purpose: it must bypass ``except Exception`` error-forwarding so the
+    worker dies without leaving a breadcrumb, like a real hard kill."""
+
+
+_lock = threading.RLock()
+_env_snapshot = None   # env string the current specs were parsed from
+_specs = []            # list of spec dicts
+_hits = []             # per-spec hit counters, parallel to _specs
+
+
+def _parse(raw):
+    specs = []
+    for entry in raw.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        fields = entry.split(":")
+        if len(fields) < 2 or not fields[0] or fields[1] not in _ACTIONS:
+            raise MXNetError(
+                "bad %s entry %r: want <site>:<action>[:key=value]* with "
+                "action one of %s" % (ENV_VAR, entry, ", ".join(_ACTIONS)))
+        spec = {"site": fields[0], "action": fields[1], "after": 1,
+                "seconds": 1.0, "sticky": False}
+        for kv in fields[2:]:
+            key, sep, val = kv.partition("=")
+            if key == "after" and sep:
+                spec["after"] = int(val)
+            elif key == "seconds" and sep:
+                spec["seconds"] = float(val)
+            elif key == "sticky" and sep:
+                spec["sticky"] = val not in ("0", "false", "False")
+            else:
+                raise MXNetError(
+                    "bad %s field %r in entry %r (want after=N, seconds=S "
+                    "or sticky=0/1)" % (ENV_VAR, kv, entry))
+        specs.append(spec)
+    return specs
+
+
+def _refresh_locked():
+    global _env_snapshot, _specs, _hits
+
+    raw = os.environ.get(ENV_VAR, "")
+    if raw != _env_snapshot:
+        # parse BEFORE committing the snapshot: a malformed spec must
+        # keep raising on every hook hit, not raise once and then be
+        # silently ignored because the snapshot already matched
+        specs = _parse(raw) if raw else []
+        _specs = specs
+        _hits = [0] * len(specs)
+        _env_snapshot = raw
+
+
+def reset():
+    """Re-parse the env var and zero all hit counters (tests re-arming
+    the same spec string between cases)."""
+    global _env_snapshot
+
+    with _lock:
+        _env_snapshot = None
+        _refresh_locked()
+
+
+def active(site=None):
+    """True when any fault spec (optionally: for ``site``) is armed."""
+    with _lock:
+        _refresh_locked()
+        return any(s["site"] == site or site is None for s in _specs)
+
+
+def inject(site):
+    """Fault hook.  No-op unless ``MXNET_FAULT_INJECT`` names ``site``;
+    otherwise counts the hit and fires the configured action when the
+    counter reaches ``after`` (every later hit too with ``sticky=1``).
+    """
+    if not os.environ.get(ENV_VAR) and _env_snapshot in (None, ""):
+        return  # fast path: nothing armed, nothing to refresh
+    delays = []
+    with _lock:
+        _refresh_locked()
+        for i, spec in enumerate(_specs):
+            if spec["site"] != site:
+                continue
+            _hits[i] += 1
+            n = _hits[i]
+            if n != spec["after"] and not (spec["sticky"] and
+                                           n > spec["after"]):
+                continue
+            if spec["action"] == "delay":
+                delays.append(spec["seconds"])
+            elif spec["action"] == "kill":
+                raise WorkerKilled(
+                    "injected worker kill at site %r (hit %d)" % (site, n))
+            else:
+                raise FaultInjected(
+                    "injected fault at site %r (hit %d, %s=%r)"
+                    % (site, n, ENV_VAR, _env_snapshot))
+    for s in delays:  # sleep outside the lock: a delay must not serialize
+        time.sleep(s)  # other sites behind it

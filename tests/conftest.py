@@ -24,6 +24,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-process tests excluded from the tier-1 run "
+        "(pytest -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def seed_rngs():
     import mxnet_tpu as mx

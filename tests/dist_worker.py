@@ -27,6 +27,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
+    import worker_guard
+
+    # a wedged rendezvous/collective must kill the worker (exit 70), not
+    # pin the whole test session on the runner's outer timeout
+    worker_guard.install(float(os.environ.get("TEST_WORKER_TIMEOUT_S",
+                                              "180")))
     if sys.argv[1] == "--from-env":
         outdir = sys.argv[2]
         coordinator = os.environ["MXNET_COORDINATOR"]
@@ -39,6 +45,12 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # recent jax CPU clients reject cross-process programs unless a
+    # collectives implementation is chosen before backend creation
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jax: no flag, multiprocess just works
+        pass
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_procs,
                                process_id=rank)

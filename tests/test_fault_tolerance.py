@@ -1,0 +1,470 @@
+"""Fault-tolerant training: atomic checkpoints, preemption-safe fit,
+bounded collectives, and the deterministic fault-injection harness.
+
+Covers the resilience subsystem end to end:
+
+* ``checkpoint.atomic_replace`` / ``save_checkpoint`` atomicity under an
+  injected IO failure (``MXNET_FAULT_INJECT=checkpoint_io:raise``),
+* ``load_checkpoint`` diagnosability (missing / corrupt files),
+* ``CheckpointManager`` save/load/latest/retention,
+* ``fit(checkpoint=..., resume_from=...)`` numerics (fit N epochs ==
+  fit k + resume N-k, bit-exact on the fused CPU path),
+* SIGTERM preemption → final checkpoint → resume (in-process and via a
+  real ``kill -TERM`` on a subprocess),
+* prefetch worker death surfaces as ``MXNetError`` instead of a hang,
+* kvstore optimizer-state round-trip and ``_run_bounded`` timeout/retry.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.testing import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    # this teardown runs before monkeypatch undoes env changes, so drop
+    # the var explicitly — reset() on a malformed spec would raise
+    os.environ.pop("MXNET_FAULT_INJECT", None)
+    faults.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _data(n=64):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 8).astype("float32")
+    w = rs.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    return X, y
+
+
+def _fit(num_epoch, X, y, batch_cb=None, **kw):
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True, seed=42)
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            batch_end_callback=batch_cb, **kw)
+    return mod
+
+
+def _params(mod):
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+# -- atomic writes -----------------------------------------------------
+
+def test_atomic_replace_failure_preserves_original(tmp_path):
+    path = str(tmp_path / "f.bin")
+    ckpt.atomic_replace(path, lambda tmp: open(tmp, "w").write("v1") and
+                        None)
+    assert open(path).read() == "v1"
+
+    def boom(tmp):
+        with open(tmp, "w") as f:
+            f.write("torn")
+        raise OSError("disk gone")
+
+    with pytest.raises(OSError):
+        ckpt.atomic_replace(path, boom)
+    assert open(path).read() == "v1"  # original untouched
+    assert [f for f in os.listdir(tmp_path) if ".tmp-" in f] == []
+
+
+def test_save_checkpoint_injected_io_failure_never_corrupts(tmp_path,
+                                                            monkeypatch):
+    prefix = str(tmp_path / "model")
+    sym = _mlp()
+    args = {"fc1_weight": mx.nd.ones((8, 8)), "fc1_bias": mx.nd.zeros((8,)),
+            "fc2_weight": mx.nd.ones((3, 8)), "fc2_bias": mx.nd.zeros((3,))}
+    mx.save_checkpoint(prefix, 0, sym, args, {})
+    before_sym, before_args, _ = mx.load_checkpoint(prefix, 0)
+
+    # the fault fires between the temp write and the os.replace publish:
+    # the worst possible crash point for a checkpoint writer
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "checkpoint_io:raise")
+    faults.reset()
+    new_args = {k: v + 1 for k, v in args.items()}
+    with pytest.raises(faults.FaultInjected):
+        mx.save_checkpoint(prefix, 0, sym, new_args, {})
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faults.reset()
+
+    _, after_args, _ = mx.load_checkpoint(prefix, 0)  # still loadable
+    for k in before_args:
+        np.testing.assert_array_equal(before_args[k].asnumpy(),
+                                      after_args[k].asnumpy())
+    assert [f for f in os.listdir(tmp_path) if ".tmp-" in f] == []
+
+
+def test_load_checkpoint_clear_errors(tmp_path):
+    prefix = str(tmp_path / "model")
+    with pytest.raises(MXNetError, match="no symbol file"):
+        mx.load_checkpoint(prefix, 0)
+    sym = _mlp()
+    sym.save(prefix + "-symbol.json")
+    with pytest.raises(MXNetError, match="no params for epoch 3"):
+        mx.load_checkpoint(prefix, 3)
+    with open(prefix + "-0007.params", "wb") as f:
+        f.write(b"not an npz")
+    with pytest.raises(MXNetError, match="corrupt"):
+        mx.load_checkpoint(prefix, 7)
+
+
+# -- CheckpointManager -------------------------------------------------
+
+def test_manager_save_load_latest_retention(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m", keep=2)
+    assert mgr.latest() is None
+    with pytest.raises(MXNetError, match="no checkpoint found"):
+        mgr.load()
+
+    sym = _mlp()
+    args = {"fc1_weight": mx.nd.ones((8, 8)), "fc1_bias": mx.nd.zeros((8,)),
+            "fc2_weight": mx.nd.ones((3, 8)), "fc2_bias": mx.nd.zeros((3,))}
+    for epoch in (1, 2, 3):
+        mgr.save(symbol=sym, arg_params=args, aux_params={}, epoch=epoch,
+                 nbatch=epoch * 5)
+    # keep=2: epoch 1 GC'd, symbol file survives
+    assert mgr.epochs() == [2, 3]
+    assert mgr.latest() == 3
+    assert os.path.exists(os.path.join(d, "m-symbol.json"))
+    assert not os.path.exists(os.path.join(d, "m-0001.params"))
+    assert not os.path.exists(os.path.join(d, "m-0001.meta.json"))
+
+    state = mgr.load()
+    assert state.epoch == 3 and state.nbatch == 15
+    state2 = mgr.load(epoch=2)
+    assert state2.nbatch == 10
+    np.testing.assert_array_equal(state.arg_params["fc1_weight"].asnumpy(),
+                                  args["fc1_weight"].asnumpy())
+
+
+def test_manager_save_from_module_records_states_and_meta(tmp_path):
+    X, y = _data()
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mod = _fit(1, X, y, checkpoint=mgr)
+    state = mgr.load()
+    assert state.epoch == 1 and state.nbatch == 0
+    assert state.num_update == 8  # 64 rows / batch 8 = 8 updates
+    assert state.states_path is not None and \
+        os.path.exists(state.states_path)
+    for k, v in _params(mod).items():
+        np.testing.assert_array_equal(v, state.arg_params[k].asnumpy())
+
+
+def test_resolve_resume_forms(tmp_path):
+    sym = _mlp()
+    args = {"fc1_weight": mx.nd.ones((8, 8))}
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    mgr.save(symbol=sym, arg_params=args, aux_params={}, epoch=2)
+    prefix = os.path.join(str(tmp_path), "m")
+    for spec in (mgr, mgr.load(), prefix, (prefix, 2)):
+        state = ckpt.resolve_resume(spec)
+        assert state.epoch == 2
+    with pytest.raises(MXNetError, match="resume_from"):
+        ckpt.resolve_resume(1.5)
+
+
+# -- resume numerics ---------------------------------------------------
+
+def test_resume_reproduces_uninterrupted_run(tmp_path):
+    X, y = _data()
+    ref = _params(_fit(3, X, y))
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    _fit(1, X, y, checkpoint=mgr)
+    res = _params(_fit(3, X, y, resume_from=mgr))
+    for k in ref:
+        np.testing.assert_allclose(ref[k], res[k], rtol=1e-6, atol=1e-7)
+
+
+def test_preemption_mid_epoch_checkpoint_and_resume(tmp_path):
+    X, y = _data()
+    ref = _params(_fit(2, X, y))
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+
+    count = [0]
+
+    def kill_self_at_3(param):
+        count[0] += 1
+        if count[0] == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(mx.TrainingPreempted) as ei:
+        _fit(2, X, y, batch_cb=kill_self_at_3, checkpoint=mgr)
+    assert ei.value.signum == signal.SIGTERM
+    assert (ei.value.epoch, ei.value.nbatch) == (0, 3)
+
+    state = mgr.load()
+    assert (state.epoch, state.nbatch, state.num_update) == (0, 3, 3)
+    res = _params(_fit(2, X, y, resume_from=mgr))
+    for k in ref:
+        np.testing.assert_allclose(ref[k], res[k], rtol=1e-6, atol=1e-7)
+
+
+def test_kill_term_subprocess_and_resume(tmp_path):
+    """Acceptance criterion: a real ``kill -TERM`` mid-fit leaves a
+    loadable checkpoint, and ``fit(resume_from=...)`` reproduces the
+    uninterrupted run's final params."""
+    workdir = str(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("MXNET_FAULT_INJECT", None)
+
+    def run(mode, check=True):
+        return subprocess.run(
+            [sys.executable, os.path.join(HERE, "ft_worker.py"), mode,
+             workdir], env=env, capture_output=True, text=True,
+            timeout=240, check=check)
+
+    run("full")
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "ft_worker.py"), "train",
+         workdir], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    sentinel = os.path.join(workdir, "started_rank0")
+    deadline = time.time() + 120
+    while not os.path.exists(sentinel):
+        assert proc.poll() is None, \
+            "worker died before first batch:\n%s" % proc.stderr.read()
+        assert time.time() < deadline, "worker never reached first batch"
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 0, "train worker failed:\n%s%s" % (out, err)
+    assert "PREEMPTED" in out, out
+
+    mgr = ckpt.CheckpointManager(os.path.join(workdir, "ckpt"), prefix="ft")
+    assert mgr.latest() is not None  # loadable checkpoint on disk
+    mgr.load()
+
+    run("resume")
+    full = np.load(os.path.join(workdir, "params_full_rank0.npz"))
+    res = np.load(os.path.join(workdir, "params_resume_rank0.npz"))
+    for k in full.files:
+        np.testing.assert_allclose(full[k], res[k], rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_two_process_kill_and_resume(tmp_path):
+    """Kill-and-resume across a two-process dist_tpu_sync pod: both ranks
+    self-SIGTERM at the same batch boundary, rank 0's checkpoint is the
+    resume point, and the resumed pod reproduces the uninterrupted
+    run."""
+    import socket
+
+    workdir = str(tmp_path)
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    coordinator = "127.0.0.1:%d" % port
+
+    def launch(mode, extra_env=None):
+        procs = []
+        for rank in range(2):
+            env = {**os.environ, **(extra_env or {})}
+            env.pop("XLA_FLAGS", None)
+            env.pop("MXNET_FAULT_INJECT", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(HERE, "ft_worker.py"), mode,
+                 workdir, coordinator, "2", str(rank)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=240) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, "rank failed:\n%s\n%s" % (out, err)
+        return outs
+
+    launch("full")
+    outs = launch("train", extra_env={"FT_KILL_AT_BATCH": "3"})
+    assert all("PREEMPTED" in out for out, _ in outs), outs
+    launch("resume")
+
+    for rank in range(2):
+        full = np.load(os.path.join(
+            workdir, "params_full_rank%d.npz" % rank))
+        res = np.load(os.path.join(
+            workdir, "params_resume_rank%d.npz" % rank))
+        for k in full.files:
+            np.testing.assert_allclose(full[k], res[k], rtol=1e-5,
+                                       atol=1e-6)
+
+
+# -- fault harness ------------------------------------------------------
+
+def test_fault_spec_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_INJECT",
+                       "prefetch:kill:after=2,collective:delay:seconds=0")
+    faults.reset()
+    assert faults.active("prefetch") and faults.active("collective")
+    assert not faults.active("checkpoint_io")
+    with pytest.raises(MXNetError, match="bad MXNET_FAULT_INJECT entry"):
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "site:badaction")
+        faults.reset()
+    # a malformed spec keeps raising on every hook hit, never silently
+    # disarms
+    with pytest.raises(MXNetError, match="bad MXNET_FAULT_INJECT entry"):
+        faults.inject("site")
+
+
+def test_injected_prefetch_error_surfaces(monkeypatch):
+    X, y = _data(32)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "device_prefetch:raise:after=2")
+    faults.reset()
+    it = mx.io.prefetch_to_device(
+        mx.io.NDArrayIter(X, y, batch_size=8))
+    with pytest.raises(faults.FaultInjected):
+        for _ in range(10):
+            it.next()
+    # the error sticks instead of hanging on the dead worker's queue
+    with pytest.raises(faults.FaultInjected):
+        it.next()
+    it.close()
+
+
+def test_killed_prefetch_worker_raises_not_hangs(monkeypatch):
+    """An injected silent worker kill (no sentinel, no forwarded error)
+    must surface as MXNetError at the consumer within the poll budget —
+    the deadlock this PR exists to remove."""
+    X, y = _data(32)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "device_prefetch:kill:after=2")
+    faults.reset()
+    it = mx.io.prefetch_to_device(
+        mx.io.NDArrayIter(X, y, batch_size=8))
+    tic = time.time()
+    with pytest.raises(MXNetError, match="worker thread died"):
+        for _ in range(10):
+            it.next()
+    assert time.time() - tic < 30
+    it.close()
+
+
+def test_prefetching_iter_close_reraises_pending_error(monkeypatch):
+    X, y = _data(32)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "prefetch:raise:after=1")
+    faults.reset()
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, y, batch_size=8))
+    # give the worker time to enqueue the error the consumer never reads
+    deadline = time.time() + 20
+    while it._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.02)
+    with pytest.raises(faults.FaultInjected):
+        it.close()
+    it.close()  # idempotent: the error was delivered once
+
+
+def test_close_idempotent_and_reset_restarts():
+    X, y = _data(32)
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, y, batch_size=8))
+    assert it.next() is not None
+    it.close()
+    assert not it.iter_next()  # exhausted after close, no hang
+    it.close()
+    it.reset()
+    assert it.next() is not None
+    it.close()
+
+
+# -- kvstore hardening -------------------------------------------------
+
+def test_kv_optimizer_states_roundtrip(tmp_path):
+    kv = mx.kv.create("local")
+    opt = mx.optimizer.create("sgd", learning_rate=0.5, momentum=0.9)
+    kv.set_optimizer(opt)
+    kv.init(0, mx.nd.zeros((4,)))
+    kv.push(0, mx.nd.ones((4,)))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+
+    kv2 = mx.kv.create("local")
+    kv2.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5,
+                                          momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    s1, s2 = kv.updater.states, kv2.updater.states
+    assert set(s1) == set(s2)
+    for k in s1:
+        np.testing.assert_array_equal(np.asarray(s1[k].asnumpy()),
+                                      np.asarray(s2[k].asnumpy()))
+
+
+def test_kv_optimizer_states_errors(tmp_path):
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError, match="worker-side updater"):
+        kv.save_optimizer_states(str(tmp_path / "x.states"))
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    with pytest.raises(MXNetError, match="does not exist"):
+        kv.load_optimizer_states(str(tmp_path / "missing.states"))
+
+
+def test_kv_optimizer_states_non_rank0_noop(tmp_path, monkeypatch):
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd"))
+    monkeypatch.setattr(type(kv), "rank", property(lambda self: 1))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)  # graceful no-op off rank 0
+    assert not os.path.exists(fname)
+
+
+def test_run_bounded_timeout_and_retry(monkeypatch):
+    from mxnet_tpu.kvstore import _run_bounded
+
+    with pytest.raises(MXNetError, match="did not complete within"):
+        _run_bounded(lambda: time.sleep(30), "wedged collective",
+                     timeout_s=0.2)
+
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert _run_bounded(flaky, "flaky init", timeout_s=5, retries=2,
+                        backoff_s=0.01) == "ok"
+    assert calls[0] == 3
+
+    def always_down():
+        raise OSError("x")
+
+    # exhausted retries surface as a diagnosable MXNetError chaining the
+    # last underlying failure
+    with pytest.raises(MXNetError, match="failed after 2 attempt"):
+        _run_bounded(always_down, "always down", timeout_s=5, retries=1,
+                     backoff_s=0.01)
+
+
+def test_collective_delay_injection(monkeypatch):
+    """A delayed collective under a tight MXNET_KV_TIMEOUT_S raises the
+    diagnosable wedged-peer error instead of blocking forever."""
+    from mxnet_tpu.kvstore import _run_bounded
+
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "collective:delay:seconds=5")
+    faults.reset()
+    with pytest.raises(MXNetError, match="MXNET_KV_TIMEOUT_S"):
+        _run_bounded(lambda: faults.inject("collective"),
+                     "KVStore.barrier (DCN rendezvous)", timeout_s=0.3)

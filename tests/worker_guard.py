@@ -1,0 +1,37 @@
+"""Watchdog for subprocess test workers.
+
+A wedged worker (deadlocked rendezvous, hung collective) would otherwise
+pin the test run until the session-level timeout; installing this guard
+makes the worker kill itself with a distinctive exit code instead, so
+the parent test fails fast with a diagnosable status.
+
+Exit code 70 (EX_SOFTWARE) marks a watchdog firing — runners should
+treat it as "worker hung", not as an assertion failure.
+"""
+import os
+import threading
+
+WATCHDOG_EXIT_CODE = 70
+
+
+def install(seconds=120.0):
+    """Arm a daemon timer that hard-exits the process after ``seconds``.
+
+    ``os._exit`` (not ``sys.exit``): the whole point is escaping a hang
+    that ordinary exception-based unwinding cannot reach — a thread
+    blocked in a native collective never sees a Python exception.
+    Returns the timer so a test that finishes early can ``.cancel()``.
+    """
+    def _fire():
+        import sys
+
+        print("WATCHDOG: worker pid %d still alive after %.0fs, "
+              "hard-exiting %d" % (os.getpid(), seconds,
+                                   WATCHDOG_EXIT_CODE), file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(WATCHDOG_EXIT_CODE)
+
+    timer = threading.Timer(seconds, _fire)
+    timer.daemon = True
+    timer.start()
+    return timer

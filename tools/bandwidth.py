@@ -119,6 +119,12 @@ def _dcn_worker(args):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # recent jax CPU clients reject cross-process programs unless a
+    # collectives implementation is chosen before backend creation
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jax: no flag, multiprocess just works
+        pass
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=int(nproc),
                                process_id=int(rank))
