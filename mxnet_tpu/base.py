@@ -12,8 +12,8 @@ from __future__ import annotations
 import logging
 import os
 
-__all__ = ["MXNetError", "TrainingPreempted", "get_env", "string_types",
-           "numeric_types", "logger"]
+__all__ = ["MXNetError", "TrainingPreempted", "TrainingDiverged",
+           "StepHung", "get_env", "string_types", "numeric_types", "logger"]
 
 logger = logging.getLogger("mxnet_tpu")
 
@@ -35,6 +35,38 @@ class TrainingPreempted(MXNetError):
         self.epoch = epoch
         self.nbatch = nbatch
         self.signum = signum
+
+
+class TrainingDiverged(MXNetError):
+    """Raised by the run-health sentinel when training is beyond
+    automatic recovery: N consecutive rollbacks (or skip-only policy
+    exhausted) without the numerics coming back.  ``epoch``/``nbatch``
+    name the position, ``reason`` the anomaly that exhausted the policy
+    (see ``docs/health_monitoring.md``)."""
+
+    def __init__(self, msg, epoch=None, nbatch=None, reason=None):
+        super().__init__(msg)
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.reason = reason
+
+
+class StepHung(MXNetError):
+    """Raised (asynchronously, by the step watchdog) when a training
+    step made no progress for ``MXNET_STEP_TIMEOUT_S`` seconds: a wedged
+    device call, deadlocked collective, or stuck input pipeline.  By the
+    time this surfaces the watchdog has already dumped all-thread stacks
+    and the last health stats to the artifact named in ``dump_path``
+    (pretty-print it with ``tools/diagnose.py``)."""
+
+    def __init__(self, msg="", note=None, dump_path=None):
+        # msg defaults to "" because the watchdog delivers this class
+        # through PyThreadState_SetAsyncExc, which instantiates it with
+        # no arguments; Module.fit re-raises it enriched with the
+        # stashed details (health.last_hang_details)
+        super().__init__(msg)
+        self.note = note
+        self.dump_path = dump_path
 
 
 string_types = (str,)

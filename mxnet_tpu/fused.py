@@ -35,6 +35,13 @@ Extra TPU-first knobs the reference exposes differently:
   ``(K, batch, …)`` super-batch and ``lax.scan``s K donated updates in
   ONE device call, amortizing Python dispatch for small models (fed by
   ``io.DevicePrefetchIter(steps_per_call=K)``; see docs/performance.md).
+* ``health=StepHealth(...)`` — run-health sentinel: the step
+  additionally returns a global gradient norm, an all-params non-finite
+  flag, and (with a :class:`~mxnet_tpu.health.DynamicLossScaler`) the
+  scaler state — all computed on-device and fused into the program; a
+  non-finite step keeps the old params bit-exactly via ``jnp.where``
+  (see docs/health_monitoring.md).  ``__call__`` keeps the 4-tuple
+  return; the stats land on ``self.last_health`` as device refs.
 """
 from __future__ import annotations
 
@@ -85,12 +92,13 @@ class TrainStep:
                  label_names=("softmax_label",), dtype="float32",
                  batch_sharding_axis="data", compute_dtype=None,
                  remat=None, fixed_param_names=(), param_sharding=None,
-                 steps_per_call=1):
+                 steps_per_call=1, health=None):
         import jax
         import jax.numpy as jnp
 
         from .executor import _trace_fn
         from . import optimizer as opt_mod
+        from .health import StepHealth
 
         self.symbol = symbol
         self._fwd_fn, self._arg_names, self._aux_names = _trace_fn(
@@ -139,11 +147,25 @@ class TrainStep:
         self._compute_dtype = compute_dtype
         frozen = fixed
 
+        if health is not None and not isinstance(health, StepHealth):
+            raise MXNetError("health must be a StepHealth (got %r)"
+                             % (health,))
+        self._health = health
+        self._hstate = None
+        self.last_health = None
+        scaler = health.scaler if health is not None else None
+        # scaler semantics REQUIRE the skip: an overflowed step must not
+        # reach the weights, whatever skip_nonfinite says
+        skip_on_bad = health is not None and (
+            health.skip_nonfinite or scaler is not None)
+        clip_gnorm = optimizer.clip_global_norm
+        rescale = optimizer.rescale_grad
+
         def cast_compute(x):
             return x.astype(cdtype) if jnp.issubdtype(
                 x.dtype, jnp.floating) else x
 
-        def step(params, aux, states, batch, rng, lr, t):
+        def core_step(params, aux, states, batch, rng, lr, t, hstate):
             def loss_fn(p):
                 args = dict(p)
                 args.update(batch)
@@ -155,24 +177,99 @@ class TrainStep:
                 if cdtype is not None:
                     new_aux = {k: v.astype(aux[k].dtype)
                                for k, v in new_aux.items()}
-                return _loss_from_outputs(outs), (outs, new_aux)
+                loss = _loss_from_outputs(outs)
+                if scaler is not None:
+                    # scale the loss BEFORE the backward: gradients come
+                    # back scaled out of the underflow-prone range
+                    loss = loss * hstate["loss_scale"]
+                return loss, (outs, new_aux)
 
-            grads, (outs, new_aux) = jax.grad(
+            (loss, (outs, new_aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_states = {}, {}
-            for i, k in enumerate(sorted(grads)):
-                g = grads[k]
-                if k in frozen:
-                    new_params[k] = params[k]
-                    new_states[k] = states[k]
-                    continue
-                new_params[k], new_states[k] = optimizer.fused_update(
-                    params[k], g, states[k],
-                    lr * lr_mults[k], base_wd * wd_mults[k], t,
-                    jax.random.fold_in(rng, i + 1))
+            live = [k for k in sorted(grads) if k not in frozen]
+            if scaler is not None:
+                inv = 1.0 / hstate["loss_scale"]
+                loss = loss * inv
+                grads = dict(grads)
+                for k in live:
+                    grads[k] = grads[k] * inv.astype(grads[k].dtype)
+            # health sentinel: one extra reduction per parameter, fused
+            # into compute that already reads every gradient.  A single
+            # NaN/Inf anywhere poisons the sum of squares, so the
+            # norm's finiteness doubles as the all-params flag.
+            gnorm = opt_mod.global_grad_norm(
+                [grads[k] for k in live], rescale)
+            nonfinite = ~(jnp.isfinite(loss) & jnp.isfinite(gnorm))
+            if clip_gnorm is not None:
+                factor = opt_mod.global_norm_scale(gnorm, clip_gnorm)
+                grads = dict(grads)
+                for k in live:
+                    grads[k] = grads[k] * factor.astype(grads[k].dtype)
+            def run_updates(_):
+                new_params, new_states = {}, {}
+                for i, k in enumerate(sorted(grads)):
+                    g = grads[k]
+                    if k in frozen:
+                        new_params[k] = params[k]
+                        new_states[k] = states[k]
+                        continue
+                    new_params[k], new_states[k] = optimizer.fused_update(
+                        params[k], g, states[k],
+                        lr * lr_mults[k], base_wd * wd_mults[k], t,
+                        jax.random.fold_in(rng, i + 1))
+                return new_params, new_states, new_aux
+
+            if skip_on_bad:
+                # the skip happens IN-PROGRAM: a conditional keeps the
+                # old buffers bit-exactly, so a poisoned batch is
+                # consumed with a zero update and async dispatch never
+                # stalls.  lax.cond (not jnp.where): the clean path
+                # executes only the update branch, so the sentinel adds
+                # no parameter-sized select pass to healthy steps.
+                new_params, new_states, new_aux = jax.lax.cond(
+                    nonfinite,
+                    lambda _: (params, states, aux),
+                    run_updates, None)
+            else:
+                new_params, new_states, new_aux = run_updates(None)
+            if scaler is not None:
+                good = jnp.where(nonfinite, 0,
+                                 hstate["good_steps"] + 1)
+                grow = good >= scaler.growth_interval
+                scale = jnp.where(
+                    nonfinite,
+                    jnp.maximum(hstate["loss_scale"] * scaler.backoff,
+                                scaler.min_scale),
+                    jnp.where(
+                        grow,
+                        jnp.minimum(hstate["loss_scale"] * scaler.growth,
+                                    scaler.max_scale),
+                        hstate["loss_scale"]))
+                new_hstate = {
+                    "loss_scale": scale.astype("float32"),
+                    "good_steps": jnp.where(grow, 0, good).astype("int32"),
+                }
+            else:
+                new_hstate = hstate
+            stats = {"loss": loss.astype("float32"), "grad_norm": gnorm,
+                     "nonfinite": nonfinite}
+            if scaler is not None:
+                stats["loss_scale"] = hstate["loss_scale"]
             # all outputs come back (multi-loss symbols run fused too);
             # a batch-sharded prefix sharding covers the whole tuple
-            return new_params, new_aux, new_states, outs
+            return new_params, new_aux, new_states, outs, new_hstate, stats
+
+        if health is not None:
+            step = core_step
+        else:
+            # legacy 7-arg / 4-output form: the discarded loss value,
+            # norm, and flag trace dead and XLA DCEs them — the compiled
+            # clean path is unchanged (clip_global_norm, if set, is live
+            # through the grads and survives)
+            def step(params, aux, states, batch, rng, lr, t):
+                p, a, s, outs, _, _ = core_step(
+                    params, aux, states, batch, rng, lr, t, {})
+                return p, a, s, outs
 
         K = int(steps_per_call)
         if K < 1:
@@ -187,21 +284,39 @@ class TrainStep:
             # consulted once per call); t advances per inner step so
             # bias-corrected optimizers stay exact; the per-call rng is
             # folded with the inner step index so dropout masks differ
-            # per step.  Outputs come back stacked (K, batch, …).
+            # per step.  Outputs come back stacked (K, batch, …); the
+            # health stats likewise carry one (K,) entry per inner step.
             base_step = step
 
-            def step(params, aux, states, batch, rng, lr, t):
-                def body(carry, xs):
-                    p, a, s, tk = carry
-                    bk, k = xs
-                    p, a, s, outs = base_step(
-                        p, a, s, bk, jax.random.fold_in(rng, k), lr, tk)
-                    return (p, a, s, tk + 1), outs
+            if health is not None:
+                def step(params, aux, states, batch, rng, lr, t, hstate):
+                    def body(carry, xs):
+                        p, a, s, tk, h = carry
+                        bk, k = xs
+                        p, a, s, outs, h, stats = base_step(
+                            p, a, s, bk, jax.random.fold_in(rng, k), lr,
+                            tk, h)
+                        return (p, a, s, tk + 1, h), (outs, stats)
 
-                (params, aux, states, _), outs = jax.lax.scan(
-                    body, (params, aux, states, t),
-                    (batch, jnp.arange(K)))
-                return params, aux, states, outs
+                    (params, aux, states, _, hstate), (outs, stats) = \
+                        jax.lax.scan(body,
+                                     (params, aux, states, t, hstate),
+                                     (batch, jnp.arange(K)))
+                    return params, aux, states, outs, hstate, stats
+            else:
+                def step(params, aux, states, batch, rng, lr, t):
+                    def body(carry, xs):
+                        p, a, s, tk = carry
+                        bk, k = xs
+                        p, a, s, outs = base_step(
+                            p, a, s, bk, jax.random.fold_in(rng, k), lr,
+                            tk)
+                        return (p, a, s, tk + 1), outs
+
+                    (params, aux, states, _), outs = jax.lax.scan(
+                        body, (params, aux, states, t),
+                        (batch, jnp.arange(K)))
+                    return params, aux, states, outs
 
         self._step_fn = step
         self._batch_sharding_axis = batch_sharding_axis
@@ -259,11 +374,15 @@ class TrainStep:
         if sshard is None:
             sshard = repl if not isinstance(pshard, dict) else pshard
         bdict = {n: bshard for n in self.data_names + self.label_names}
-        return jax.jit(
-            self._step_fn,
-            in_shardings=(pshard, repl, sshard, bdict, repl, None, None),
-            out_shardings=(pshard, repl, sshard, bshard),
-            donate_argnums=(0, 1, 2))
+        in_sh = (pshard, repl, sshard, bdict, repl, None, None)
+        out_sh = (pshard, repl, sshard, bshard)
+        if self._health is not None:
+            # + scaler state in, + scaler state / health stats out — all
+            # scalars, replicated everywhere
+            in_sh = in_sh + (repl,)
+            out_sh = out_sh + (repl, repl)
+        return jax.jit(self._step_fn, in_shardings=in_sh,
+                       out_shardings=out_sh, donate_argnums=(0, 1, 2))
 
     def _build_sharded_jit(self, params, states):
         """Resolve param_sharding rules against concrete shapes and jit.
@@ -331,9 +450,33 @@ class TrainStep:
             # once the donated outputs carry the sharding)
             params = jax.device_put(params, self._in_pshard)
             states = jax.device_put(states, self._in_sshard)
-        return self._jit_step(params, aux, states, batch, rng,
-                              self.lr if lr is None else lr,
-                              jnp.asarray(t, "int32"))
+        lr = self.lr if lr is None else lr
+        t = jnp.asarray(t, "int32")
+        if self._health is None:
+            return self._jit_step(params, aux, states, batch, rng, lr, t)
+        if self._hstate is None:
+            self._hstate = self._init_hstate()
+        (params, aux, states, outs, self._hstate,
+         self.last_health) = self._jit_step(
+            params, aux, states, batch, rng, lr, t, self._hstate)
+        return params, aux, states, outs
+
+    def _init_hstate(self):
+        import jax.numpy as jnp
+
+        scaler = self._health.scaler if self._health is not None else None
+        if scaler is None:
+            return {}
+        return {"loss_scale": jnp.asarray(scaler.init_scale, "float32"),
+                "good_steps": jnp.asarray(0, "int32")}
+
+    @property
+    def loss_scale(self):
+        """Current dynamic loss scale as a float (host sync), or None
+        when no scaler is configured."""
+        if self._hstate is None or "loss_scale" not in self._hstate:
+            return None
+        return float(self._hstate["loss_scale"])
 
     def init_state(self, shapes, dtype="float32", seed=0):
         """Allocate params/aux/optimizer-states as raw jax arrays via the

@@ -1,0 +1,675 @@
+"""Run-health sentinel: in-step numerical guards, skip/rollback policy,
+and hang watchdogs.
+
+A production run that *keeps going while silently diverging* — NaN/Inf
+gradients, a loss blow-up, a wedged collective — burns the whole TPU
+reservation without producing a model.  PR 2 made crashes survivable;
+this subsystem makes bad numerics and stalls survivable:
+
+* **In-step numerics** (``fused.TrainStep(health=...)``): the compiled
+  step additionally computes a global gradient norm and an all-params
+  non-finite flag *on device*.  Because the whole step is one fused XLA
+  program, these are a handful of extra reductions fused into compute
+  that is already reading the gradients — near-zero cost, zero extra
+  host round-trips.  A non-finite step is *skipped inside the program*
+  (``jnp.where`` keeps the old params/states/aux bit-exactly), so the
+  clean path stays fully async.
+* **Loss scaling** (:class:`DynamicLossScaler`): for low-precision
+  ``compute_dtype`` runs the loss is multiplied by a dynamic scale
+  before the backward and the gradients unscaled after; the scale and
+  its clean-streak counter live as device scalars threaded through the
+  step, so scale-up on clean streaks and scale-down+skip on overflow
+  also happen in-program.
+* **Policy engine** (:class:`HealthMonitor`): host-side EMA loss /
+  grad-norm statistics over *lagged* device values — stats from step
+  ``n - lag`` are realized while step ``n`` executes, so reading them
+  never stalls the pipeline.  Per anomaly it applies the configured
+  policy ladder ``warn`` → ``skip`` → ``rollback`` and raises
+  :class:`~mxnet_tpu.base.TrainingDiverged` when recovery is exhausted.
+* **Liveness**: :class:`StepWatchdog` (``MXNET_STEP_TIMEOUT_S``) dumps
+  all-thread stacks plus the last health stats to an artifact and
+  raises :class:`~mxnet_tpu.base.StepHung` in the training thread
+  instead of hanging forever; :class:`RankHeartbeat`
+  (``MXNET_HEARTBEAT_DIR``) lets a healthy rank *name* the dead peer
+  when a bounded collective times out.
+
+Everything is driven by ``MXNET_HEALTH_*`` env knobs (see
+``docs/health_monitoring.md`` and ``docs/env_vars.md``) or the
+``Module.fit(health=...)`` argument.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import tempfile
+import threading
+import time
+
+from .base import (MXNetError, StepHung, TrainingDiverged, get_env, logger)
+
+__all__ = ["HealthMonitor", "DynamicLossScaler", "StepHealth",
+           "StepWatchdog", "RankHeartbeat", "peer_report",
+           "resolve_monitor", "TrainingDiverged", "StepHung"]
+
+_POLICIES = ("warn", "skip", "rollback")
+
+# thread-name prefixes the pytest leak guard (tests/conftest.py) checks
+WATCHDOG_THREAD_PREFIX = "mxnet-step-watchdog"
+HEARTBEAT_THREAD_PREFIX = "mxnet-heartbeat"
+
+
+# ---------------------------------------------------------------------------
+# loss scaling
+
+
+class DynamicLossScaler:
+    """Dynamic loss-scale schedule for low-precision runs.
+
+    The *state* (current scale, clean-step streak) lives as device
+    scalars threaded through the fused step; this object only carries
+    the static schedule constants, which compile into the program:
+    on overflow the scale halves (``backoff``) and the step is skipped;
+    after ``growth_interval`` consecutive clean steps it doubles
+    (``growth``), bounded to [``min_scale``, ``max_scale``].
+
+    bf16 shares float32's exponent range, so TPU-default mixed precision
+    rarely overflows — the scaler exists for fp16 ``compute_dtype`` runs
+    and as a belt-and-braces guard for bf16 (``init_scale=1`` makes it a
+    pure overflow detector).
+    """
+
+    def __init__(self, init_scale=2.0 ** 15, growth=2.0, backoff=0.5,
+                 growth_interval=2000, min_scale=1.0, max_scale=2.0 ** 24):
+        if init_scale <= 0 or growth < 1.0 or not 0 < backoff <= 1.0:
+            raise MXNetError(
+                "DynamicLossScaler needs init_scale > 0, growth >= 1, "
+                "0 < backoff <= 1 (got %r, %r, %r)"
+                % (init_scale, growth, backoff))
+        self.init_scale = float(init_scale)
+        self.growth = float(growth)
+        self.backoff = float(backoff)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+
+    @staticmethod
+    def from_spec(spec):
+        """Resolve a ``fit(loss_scale=...)`` / ``MXNET_LOSS_SCALE``
+        value: ``'dynamic'`` → default dynamic scaler, a number → static
+        scale (growth/backoff disabled), None/'' → no scaling."""
+        if spec in (None, "", False):
+            return None
+        if isinstance(spec, DynamicLossScaler):
+            return spec
+        if isinstance(spec, str) and spec.lower() == "dynamic":
+            return DynamicLossScaler()
+        scale = float(spec)
+        return DynamicLossScaler(init_scale=scale, growth=1.0, backoff=1.0,
+                                 growth_interval=1 << 30, min_scale=scale,
+                                 max_scale=scale)
+
+
+class StepHealth:
+    """Static health configuration compiled into a ``TrainStep``.
+
+    ``skip_nonfinite`` — apply the zero-update skip inside the program
+    when any gradient (or the loss) is non-finite; ``scaler`` — an
+    optional :class:`DynamicLossScaler`.  The global grad norm and
+    non-finite flag are always computed (that is what makes the step a
+    sentinel); whether anything *acts* on them is policy."""
+
+    def __init__(self, skip_nonfinite=True, scaler=None):
+        self.skip_nonfinite = bool(skip_nonfinite)
+        self.scaler = scaler
+
+
+# ---------------------------------------------------------------------------
+# policy engine
+
+
+class HealthMonitor:
+    """EMA loss/grad-norm statistics + per-anomaly policy ladder.
+
+    ``tick(stats_ref)`` is called once per dispatched step with the
+    *device references* of that step's health stats; the monitor holds
+    them in a short queue and realizes only entries ``lag`` steps old —
+    by then the producing step has long finished, so the host read
+    costs nothing on the clean path.  ``observe`` classifies each
+    realized step and returns the strongest pending action:
+
+    * ``"ok"``   — nothing to do.
+    * ``"warn"`` — anomaly logged (always happens, whatever the policy).
+    * ``"skip"`` — a non-finite step; the device already applied the
+      zero update, the monitor accounts for it and escalates after
+      ``max_skips`` consecutive occurrences.
+    * ``"rollback"`` — reload last-good + LR backoff (the trainer owns
+      the mechanics); after ``max_rollbacks`` consecutive rollbacks
+      with no clean progress in between, :class:`TrainingDiverged`.
+
+    All thresholds default from ``MXNET_HEALTH_*`` env knobs so a
+    launcher can tune a run without code changes.
+    """
+
+    def __init__(self, policy=None, loss_spike=None, grad_spike=None,
+                 ema_decay=None, warmup=None, lag=None, max_skips=None,
+                 max_rollbacks=None, lr_backoff=None, logger_=None):
+        self.policy = policy if policy is not None else \
+            get_env("MXNET_HEALTH_POLICY", "skip", str)
+        if self.policy not in _POLICIES:
+            raise MXNetError("health policy must be one of %s (got %r)"
+                             % ("/".join(_POLICIES), self.policy))
+        self.loss_spike = loss_spike if loss_spike is not None else \
+            get_env("MXNET_HEALTH_LOSS_SPIKE", 10.0, float)
+        self.grad_spike = grad_spike if grad_spike is not None else \
+            get_env("MXNET_HEALTH_GRAD_SPIKE", 25.0, float)
+        self.ema_decay = ema_decay if ema_decay is not None else \
+            get_env("MXNET_HEALTH_EMA", 0.98, float)
+        self.warmup = warmup if warmup is not None else \
+            get_env("MXNET_HEALTH_WARMUP", 20, int)
+        self.lag = lag if lag is not None else \
+            get_env("MXNET_HEALTH_LAG", 2, int)
+        self.max_skips = max_skips if max_skips is not None else \
+            get_env("MXNET_HEALTH_MAX_SKIPS", 10, int)
+        self.max_rollbacks = max_rollbacks if max_rollbacks is not None \
+            else get_env("MXNET_HEALTH_MAX_ROLLBACKS", 3, int)
+        self.lr_backoff = lr_backoff if lr_backoff is not None else \
+            get_env("MXNET_HEALTH_LR_BACKOFF", 0.5, float)
+        self.logger = logger_ or logger
+        self._pending = []      # [(step, stats_ref)] not yet realized
+        self.reset()
+
+    # -- lifecycle ------------------------------------------------------
+    def reset(self):
+        """Forget statistics (fresh fit).  Rollback accounting survives
+        ``soft_reset`` (post-rollback) but not this."""
+        self._pending = []
+        self.soft_reset()
+        self.consecutive_rollbacks = 0
+        self.total_rollbacks = 0
+        self.total_skips = 0
+        self.total_warnings = 0
+
+    def soft_reset(self):
+        """Drop the EMA state and streak counters but keep lifetime /
+        rollback accounting — called after a rollback restores old
+        params (the old EMA described the diverged trajectory)."""
+        self._pending = []
+        self.loss_ema = None
+        self.grad_ema = None
+        self.observed = 0
+        self.consecutive_skips = 0
+        self._clean_since_rollback = 0
+        self.last_stats = None
+
+    # -- per-step entry points -----------------------------------------
+    def tick(self, stats_ref, step=None):
+        """Queue this step's device stats; realize + classify entries
+        ``lag`` steps old.  Returns the strongest action among the
+        entries realized this call."""
+        if stats_ref is not None:
+            self._pending.append((step, stats_ref))
+        action = "ok"
+        while len(self._pending) > self.lag:
+            s, ref = self._pending.pop(0)
+            action = _stronger(action, self._realize(s, ref))
+        return action
+
+    def flush(self):
+        """Realize every queued entry (epoch end / teardown).  Returns
+        the strongest action found."""
+        action = "ok"
+        while self._pending:
+            s, ref = self._pending.pop(0)
+            action = _stronger(action, self._realize(s, ref))
+        return action
+
+    def _realize(self, step, ref):
+        import numpy as np
+
+        try:
+            import jax
+
+            vals = jax.device_get(ref)
+        except Exception:
+            vals = {k: np.asarray(v) for k, v in ref.items()}
+        # a steps_per_call=K stats entry carries (K,) arrays: one
+        # observation per inner step
+        loss = np.atleast_1d(np.asarray(vals.get("loss", np.nan),
+                                        "float64"))
+        gnorm = np.atleast_1d(np.asarray(vals.get("grad_norm", np.nan),
+                                         "float64"))
+        bad = np.atleast_1d(np.asarray(vals.get("nonfinite", 0)))
+        action = "ok"
+        for k in range(loss.shape[0]):
+            action = _stronger(action, self.observe(
+                step=step, loss=float(loss[k]),
+                grad_norm=float(gnorm[min(k, gnorm.shape[0] - 1)]),
+                nonfinite=bool(bad[min(k, bad.shape[0] - 1)])))
+        return action
+
+    def observe(self, step=None, loss=None, grad_norm=None,
+                nonfinite=False):
+        """Classify one realized step.  Pure host logic — unit-testable
+        without a device."""
+        import math
+
+        self.last_stats = {"step": step, "loss": loss,
+                           "grad_norm": grad_norm,
+                           "nonfinite": bool(nonfinite)}
+        if nonfinite or (loss is not None and not math.isfinite(loss)) \
+                or (grad_norm is not None
+                    and not math.isfinite(grad_norm)):
+            self.consecutive_skips += 1
+            self.total_skips += 1
+            self._clean_since_rollback = 0
+            self.logger.warning(
+                "health: non-finite step%s (consecutive %d/%d) — update "
+                "skipped on device",
+                "" if step is None else " %s" % (step,),
+                self.consecutive_skips, self.max_skips)
+            if self.policy == "warn":
+                self.total_warnings += 1
+                return "warn"
+            if self.consecutive_skips >= self.max_skips:
+                self.consecutive_skips = 0
+                return self._escalate(
+                    step, "%d consecutive non-finite steps"
+                    % self.max_skips)
+            return "skip"
+        # finite step: update streaks first, then spike-check against
+        # the EMA of the PREVIOUS steps
+        self.consecutive_skips = 0
+        self._clean_since_rollback += 1
+        if self._clean_since_rollback >= max(1, self.warmup):
+            self.consecutive_rollbacks = 0
+        anomaly = None
+        if self.observed >= self.warmup:
+            if loss is not None and self.loss_ema is not None and \
+                    abs(loss) > self.loss_spike * (abs(self.loss_ema)
+                                                   + 1e-8):
+                anomaly = "loss %.4g spiked > %gx EMA %.4g" % (
+                    loss, self.loss_spike, self.loss_ema)
+            elif grad_norm is not None and self.grad_ema is not None and \
+                    grad_norm > self.grad_spike * (self.grad_ema + 1e-8):
+                anomaly = "grad norm %.4g spiked > %gx EMA %.4g" % (
+                    grad_norm, self.grad_spike, self.grad_ema)
+        d = self.ema_decay
+        if loss is not None:
+            self.loss_ema = loss if self.loss_ema is None else \
+                d * self.loss_ema + (1 - d) * loss
+        if grad_norm is not None:
+            self.grad_ema = grad_norm if self.grad_ema is None else \
+                d * self.grad_ema + (1 - d) * grad_norm
+        self.observed += 1
+        if anomaly is None:
+            return "ok"
+        self.total_warnings += 1
+        self.logger.warning(
+            "health: %s%s", anomaly,
+            "" if step is None else " at step %s" % (step,))
+        if self.policy == "rollback":
+            return self._escalate(step, anomaly)
+        return "warn"
+
+    def _escalate(self, step, reason):
+        """Promote an exhausted-skip streak or a sustained spike to a
+        rollback request — or to :class:`TrainingDiverged` when the
+        policy forbids rollback or rollbacks are exhausted."""
+        if self.policy != "rollback":
+            raise TrainingDiverged(
+                "training diverged: %s and policy %r cannot roll back "
+                "(set MXNET_HEALTH_POLICY=rollback and pass "
+                "fit(checkpoint=...) for automatic recovery)"
+                % (reason, self.policy), reason=reason)
+        if self.consecutive_rollbacks >= self.max_rollbacks:
+            raise TrainingDiverged(
+                "training diverged: %s after %d consecutive rollbacks "
+                "(MXNET_HEALTH_MAX_ROLLBACKS) — the run does not recover "
+                "from the last-good checkpoint; inspect the data stream "
+                "and hyperparameters" % (reason,
+                                         self.consecutive_rollbacks),
+                reason=reason)
+        self._last_anomaly = reason
+        return "rollback"
+
+    def note_rollback(self, step=None):
+        """Account for a rollback the trainer just performed."""
+        self.consecutive_rollbacks += 1
+        self.total_rollbacks += 1
+        self._clean_since_rollback = 0
+
+    # -- diagnostics ----------------------------------------------------
+    def snapshot(self):
+        """JSON-able state for the watchdog dump / diagnose tooling."""
+        return {
+            "policy": self.policy,
+            "observed": self.observed,
+            "loss_ema": self.loss_ema,
+            "grad_ema": self.grad_ema,
+            "last_stats": self.last_stats,
+            "consecutive_skips": self.consecutive_skips,
+            "consecutive_rollbacks": self.consecutive_rollbacks,
+            "total_skips": self.total_skips,
+            "total_rollbacks": self.total_rollbacks,
+            "total_warnings": self.total_warnings,
+        }
+
+
+def _stronger(a, b):
+    order = ("ok", "warn", "skip", "rollback")
+    return a if order.index(a) >= order.index(b) else b
+
+
+def resolve_monitor(spec):
+    """Normalize ``fit(health=...)`` / ``MXNET_HEALTH_MONITOR``:
+    None → env switch, True → default monitor, a policy string →
+    ``HealthMonitor(policy=...)``, an instance → itself, falsy → off."""
+    if spec is None:
+        spec = get_env("MXNET_HEALTH_MONITOR", False, bool)
+    if not spec:
+        return None
+    if isinstance(spec, HealthMonitor):
+        return spec
+    if isinstance(spec, str):
+        return HealthMonitor(policy=spec)
+    return HealthMonitor()
+
+
+# ---------------------------------------------------------------------------
+# liveness: step watchdog
+
+
+class StepWatchdog:
+    """Daemon thread that fires when the training loop stops making
+    progress.
+
+    The loop calls :meth:`kick` at every dispatch boundary; if no kick
+    arrives for ``timeout_s`` the watchdog (1) dumps all-thread stacks
+    via ``faulthandler`` plus the last health stats to a JSON artifact
+    under ``MXNET_HEALTH_DIR`` (and mirrors the stacks to stderr),
+    then (2) delivers :class:`~mxnet_tpu.base.StepHung` into the
+    training thread with ``PyThreadState_SetAsyncExc`` so the run fails
+    diagnosably instead of hanging.  A hang blocked inside a C call
+    surfaces when the call returns; for calls that never return, set
+    ``MXNET_STEP_TIMEOUT_EXIT=1`` to hard-exit (code 70) one extra
+    ``timeout_s`` after the dump — the stacks are already on disk.
+    """
+
+    def __init__(self, timeout_s, stats_cb=None, dump_dir=None,
+                 target_thread=None):
+        self.timeout_s = float(timeout_s)
+        if self.timeout_s <= 0:
+            raise MXNetError("StepWatchdog timeout must be > 0 (got %r)"
+                             % timeout_s)
+        self._stats_cb = stats_cb
+        self._dump_dir = dump_dir or get_env(
+            "MXNET_HEALTH_DIR", tempfile.gettempdir(), str)
+        self._target = target_thread or threading.current_thread()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._last_kick = time.monotonic()
+        self._note = "startup (no step dispatched yet)"
+        self._paused = False
+        self.fired = False
+        self.dump_path = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="%s-%d" % (WATCHDOG_THREAD_PREFIX, os.getpid()))
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def kick(self, note=None):
+        """Record progress (cheap: one lock + clock read).  Also resumes
+        a paused watchdog — the first step of the next epoch rearms it."""
+        with self._lock:
+            self._last_kick = time.monotonic()
+            self._paused = False
+            if note is not None:
+                self._note = note
+
+    def pause(self):
+        """Stop timing until the next :meth:`kick` — for epoch tails
+        (eval pass, checkpoint write, callbacks) whose duration is
+        unrelated to per-step progress."""
+        with self._lock:
+            self._paused = True
+
+    def stop(self, join_timeout=5.0):
+        self._stop.set()
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=join_timeout)
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()
+
+    def _run(self):
+        # poll at a fraction of the timeout: the watchdog must notice a
+        # stall within ~timeout + poll ("grace"), not 2x timeout
+        poll = max(0.05, min(self.timeout_s / 4.0, 2.0))
+        while not self._stop.wait(poll):
+            with self._lock:
+                if self._paused:
+                    self._last_kick = time.monotonic()
+                    continue
+                stalled = time.monotonic() - self._last_kick
+                note = self._note
+            if stalled >= self.timeout_s:
+                self._fire(stalled, note)
+                return
+
+    def _fire(self, stalled, note):
+        self.fired = True
+        try:
+            self.dump_path = self._dump(stalled, note)
+        except Exception as e:  # the dump must never mask the raise
+            logger.error("watchdog dump failed: %s", e)
+        msg = ("training step made no progress for %.1fs "
+               "(MXNET_STEP_TIMEOUT_S=%.0f) at %s — a wedged device "
+               "call, deadlocked collective, or stuck input pipeline; "
+               "all-thread stacks dumped to %r (pretty-print with "
+               "tools/diagnose.py)"
+               % (stalled, self.timeout_s, note, self.dump_path))
+        logger.critical(msg)
+        delivered = _async_raise(self._target, StepHung)
+        if delivered:
+            # stash the details where the raising thread can find them:
+            # SetAsyncExc instantiates the class with no arguments
+            _last_hang["msg"] = msg
+            _last_hang["note"] = note
+            _last_hang["dump_path"] = self.dump_path
+        if get_env("MXNET_STEP_TIMEOUT_EXIT", False, bool):
+            # a thread wedged inside C never sees the async exception;
+            # give it one more timeout, then fail the process loudly —
+            # the diagnostics are already on disk
+            if not self._stop.wait(self.timeout_s):
+                logger.critical(
+                    "watchdog: thread still wedged %.0fs after the "
+                    "dump; hard-exiting 70", self.timeout_s)
+                os._exit(70)
+
+    def _dump(self, stalled, note):
+        import faulthandler
+        import sys
+
+        os.makedirs(self._dump_dir, exist_ok=True)
+        path = os.path.join(
+            self._dump_dir,
+            "watchdog-%d-%d.json" % (os.getpid(), int(time.time())))
+        with tempfile.TemporaryFile(mode="w+") as tf:
+            faulthandler.dump_traceback(file=tf, all_threads=True)
+            tf.seek(0)
+            stacks = tf.read()
+        stats = None
+        if self._stats_cb is not None:
+            try:
+                stats = self._stats_cb()
+            except Exception as e:
+                stats = {"error": "stats_cb failed: %s" % e}
+        payload = {
+            "kind": "mxnet_tpu-watchdog-dump",
+            "pid": os.getpid(),
+            "time": time.time(),
+            "stalled_s": stalled,
+            "timeout_s": self.timeout_s,
+            "note": note,
+            "health": stats,
+            "traceback": stacks,
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+        print("WATCHDOG: no step progress for %.1fs at %s; stacks:\n%s"
+              % (stalled, note, stacks), file=sys.stderr)
+        sys.stderr.flush()
+        return path
+
+
+# details of the most recent watchdog firing, read by the zero-arg
+# StepHung that PyThreadState_SetAsyncExc constructs
+_last_hang = {}
+
+
+def last_hang_details():
+    return dict(_last_hang)
+
+
+def _async_raise(thread, exc_type):
+    """Deliver ``exc_type`` asynchronously into ``thread``.  Returns
+    True when the interpreter accepted the request (the exception lands
+    at the thread's next bytecode boundary)."""
+    tid = getattr(thread, "ident", None)
+    if tid is None or not thread.is_alive():
+        return False
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(exc_type))
+    if res > 1:  # undefined state: revoke
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid),
+                                                   None)
+        return False
+    return res == 1
+
+
+# ---------------------------------------------------------------------------
+# liveness: rank heartbeats
+
+
+class RankHeartbeat:
+    """Periodic per-rank liveness beacons over a shared directory.
+
+    Each rank rewrites ``<dir>/heartbeat_rank<k>.json`` every
+    ``interval_s``; when a bounded collective times out, the survivor
+    reads every peer's beacon and *names* the dead/stale rank in the
+    error instead of timing out anonymously.  The directory
+    (``MXNET_HEARTBEAT_DIR``) is typically the same shared filesystem
+    the checkpoints live on."""
+
+    def __init__(self, directory, rank, num_workers, interval_s=None):
+        self.directory = str(directory)
+        self.rank = int(rank)
+        self.num_workers = int(num_workers)
+        self.interval_s = interval_s if interval_s is not None else \
+            get_env("MXNET_HEARTBEAT_INTERVAL_S", 5.0, float)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="%s-rank%d" % (HEARTBEAT_THREAD_PREFIX, self.rank))
+
+    @staticmethod
+    def path_for(directory, rank):
+        return os.path.join(str(directory), "heartbeat_rank%d.json" % rank)
+
+    @staticmethod
+    def maybe_start(rank, num_workers):
+        """Start a heartbeat when ``MXNET_HEARTBEAT_DIR`` is configured
+        and the job is actually multi-rank; otherwise None."""
+        directory = get_env("MXNET_HEARTBEAT_DIR", "", str)
+        if not directory or num_workers <= 1:
+            return None
+        hb = RankHeartbeat(directory, rank, num_workers)
+        hb.start()
+        return hb
+
+    def start(self):
+        os.makedirs(self.directory, exist_ok=True)
+        self._beat()
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout=5.0):
+        self._stop.set()
+        if self._thread.is_alive() and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=join_timeout)
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self._beat()
+
+    def _beat(self):
+        path = self.path_for(self.directory, self.rank)
+        tmp = "%s.tmp-%d" % (path, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"rank": self.rank, "pid": os.getpid(),
+                           "time": time.time()}, f)
+            os.replace(tmp, path)
+        except OSError as e:  # heartbeats must never kill training
+            logger.warning("heartbeat write failed: %s", e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def stale_peers(directory, num_workers, stale_s=None, self_rank=None,
+                now=None):
+    """Name the ranks whose heartbeat is stale or missing.
+
+    Returns ``[(rank, description), ...]`` — empty when every peer is
+    live (or heartbeats are unconfigured)."""
+    if not directory:
+        return []
+    if stale_s is None:
+        stale_s = get_env("MXNET_HEARTBEAT_STALE_S",
+                          3 * get_env("MXNET_HEARTBEAT_INTERVAL_S", 5.0,
+                                      float), float)
+    now = time.time() if now is None else now
+    out = []
+    for rank in range(int(num_workers)):
+        if self_rank is not None and rank == self_rank:
+            continue
+        path = RankHeartbeat.path_for(directory, rank)
+        try:
+            with open(path) as f:
+                beat = json.load(f)
+            age = now - float(beat.get("time", 0))
+            if age > stale_s:
+                out.append((rank, "rank %d (pid %s) last heartbeat "
+                            "%.1fs ago" % (rank, beat.get("pid", "?"),
+                                           age)))
+        except (OSError, ValueError):
+            out.append((rank, "rank %d never wrote a heartbeat under %r"
+                        % (rank, directory)))
+    return out
+
+
+def peer_report(num_workers, self_rank=None):
+    """One-line peer liveness summary for timeout diagnostics, or ''
+    when heartbeats are unconfigured."""
+    directory = get_env("MXNET_HEARTBEAT_DIR", "", str)
+    if not directory or num_workers <= 1:
+        return ""
+    dead = stale_peers(directory, num_workers, self_rank=self_rank)
+    if not dead:
+        return ("; peer heartbeats under %r are all current — the "
+                "stall is local (device queue or network), not a dead "
+                "peer" % directory)
+    return "; dead/stale peers: " + ", ".join(d for _, d in dead)

@@ -38,7 +38,8 @@ from .ndarray import NDArray, zeros, imperative_invoke
 __all__ = ["KVStore", "create"]
 
 
-def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0):
+def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0,
+                 diagnose=None):
     """Run ``fn()`` under a wall-clock bound with retry/backoff.
 
     The DCN rendezvous and collectives block inside C calls with no
@@ -50,7 +51,11 @@ def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0):
     ``retries`` times (``MXNET_KV_RETRIES``) with exponential backoff —
     rendezvous races at job start are the common case.  The abandoned
     helper thread cannot be killed; it is left daemonized (the process
-    is about to fail loudly anyway, which is the point)."""
+    is about to fail loudly anyway, which is the point).
+
+    ``diagnose``: optional zero-arg callable returning extra text for
+    the timeout error — the heartbeat wiring uses it so the survivor
+    NAMES the dead/stale peer instead of timing out anonymously."""
     import threading
     import time
 
@@ -71,11 +76,17 @@ def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0):
         t.start()
         t.join(timeout=timeout_s if timeout_s and timeout_s > 0 else None)
         if t.is_alive():
+            extra = ""
+            if diagnose is not None:
+                try:
+                    extra = diagnose() or ""
+                except Exception as e:  # diagnosis must not mask the timeout
+                    extra = "; peer diagnosis failed: %s" % e
             raise MXNetError(
                 "%s did not complete within %.0fs (MXNET_KV_TIMEOUT_S); "
                 "a peer process is likely wedged, dead, or unreachable — "
-                "check every worker's log before restarting the job"
-                % (what, timeout_s))
+                "check every worker's log before restarting the job%s"
+                % (what, timeout_s, extra))
         if "error" not in box:
             return box.get("value")
         err = box["error"]
@@ -130,6 +141,13 @@ class KVStore:
                          "KVStore %r init (jax.distributed rendezvous)"
                          % kv_type,
                          retries=get_env("MXNET_KV_RETRIES", 2, int))
+            # liveness beacons: each rank rewrites a heartbeat file
+            # under MXNET_HEARTBEAT_DIR so a survivor of a timed-out
+            # collective can NAME the dead peer (no-op unconfigured)
+            from .health import RankHeartbeat
+
+            self._heartbeat = RankHeartbeat.maybe_start(
+                self.rank, self.num_workers)
         self._is_async = "async" in kv_type
         if self._is_async:
             # The reference's dist_async servers apply each worker's
@@ -411,7 +429,24 @@ class KVStore:
 
             multihost_utils.process_allgather(np.zeros((1,), "int32"))
 
-        _run_bounded(_rendezvous, "KVStore.barrier (DCN rendezvous)")
+        _run_bounded(_rendezvous, "KVStore.barrier (DCN rendezvous)",
+                     diagnose=self._peer_diagnose)
+
+    def _peer_diagnose(self):
+        """Heartbeat-based liveness summary appended to collective
+        timeout errors ('' when heartbeats are unconfigured)."""
+        from .health import peer_report
+
+        return peer_report(self.num_workers, self_rank=self.rank)
+
+    def close(self):
+        """Stop background liveness machinery (the heartbeat thread).
+        Safe to call multiple times; the store stays usable for local
+        ops afterwards."""
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            hb.stop()
+            self._heartbeat = None
 
     def _bounded_collective(self, fn, what, retries=None):
         """Run a cross-process collective under the KV timeout (identity
@@ -433,7 +468,8 @@ class KVStore:
 
         if retries is None:
             retries = get_env("MXNET_KV_RETRIES", 2, int)
-        return _run_bounded(_go, what, retries=retries)
+        return _run_bounded(_go, what, retries=retries,
+                            diagnose=self._peer_diagnose)
 
     def _send_command_to_servers(self, head, body):
         pass  # no servers in the TPU design

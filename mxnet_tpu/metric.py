@@ -6,12 +6,15 @@ np() wrapper, create() registry.
 """
 from __future__ import annotations
 
+import logging
 import math
 
 import numpy as _np
 
 from .base import MXNetError, _Registry
 from .ndarray import NDArray
+
+_logger = logging.getLogger(__name__)
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "LazyEvalMetric",
            "Accuracy", "TopKAccuracy",
@@ -61,6 +64,7 @@ class EvalMetric:
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
+        self.num_nonfinite = 0  # subclasses may override reset()
         self.reset()
 
     def update_dict(self, label, pred):
@@ -77,9 +81,27 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
+    def _accumulate(self, sum_inc, num_inc):
+        """Fold one increment into the running sums — unless it is
+        non-finite.  A single NaN batch would otherwise poison
+        ``sum_metric`` for the rest of the epoch (nan + x == nan), so a
+        bad increment is *dropped* and counted in ``num_nonfinite``
+        instead, with a throttled warning so the drop is visible."""
+        if not _np.all(_np.isfinite(sum_inc)):
+            self.num_nonfinite += 1
+            if self.num_nonfinite == 1 or self.num_nonfinite % 100 == 0:
+                _logger.warning(
+                    "metric %s: dropped non-finite update #%d (value %r); "
+                    "the running metric excludes these batches",
+                    self.name, self.num_nonfinite, sum_inc)
+            return
+        self.sum_metric += sum_inc
+        self.num_inst += num_inc
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self.num_nonfinite = 0
 
     def get(self):
         if self.num_inst == 0:
@@ -261,8 +283,11 @@ class Perplexity(EvalMetric):
                 num -= ignore.sum()
             loss += -_np.log(_np.maximum(1e-10, probs)).sum()
             num += label.shape[0]
-        self.sum_metric += math.exp(loss / max(1, num))
-        self.num_inst += 1
+        try:
+            ppl = math.exp(loss / max(1, num))
+        except OverflowError:  # exp(huge finite loss) — treat as inf
+            ppl = float("inf")
+        self._accumulate(ppl, 1)
 
 
 @register
@@ -278,8 +303,7 @@ class MAE(EvalMetric):
                 label = label.reshape(label.shape[0], 1)
             if pred.ndim == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += _np.abs(label - pred).mean()
-            self.num_inst += 1
+            self._accumulate(_np.abs(label - pred).mean(), 1)
 
 
 @register
@@ -295,8 +319,7 @@ class MSE(EvalMetric):
                 label = label.reshape(label.shape[0], 1)
             if pred.ndim == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+            self._accumulate(((label - pred) ** 2.0).mean(), 1)
 
 
 @register
@@ -312,8 +335,7 @@ class RMSE(EvalMetric):
                 label = label.reshape(label.shape[0], 1)
             if pred.ndim == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+            self._accumulate(_np.sqrt(((label - pred) ** 2.0).mean()), 1)
 
 
 @register
@@ -329,8 +351,8 @@ class CrossEntropy(EvalMetric):
             label = label.ravel()
             assert label.shape[0] == pred.shape[0]
             prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
-            self.sum_metric += (-_np.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+            self._accumulate((-_np.log(prob + self.eps)).sum(),
+                             label.shape[0])
 
 
 @register
@@ -343,8 +365,7 @@ class Loss(EvalMetric):
     def update(self, _, preds):
         for pred in preds:
             pred = _as_numpy(pred)
-            self.sum_metric += pred.sum()
-            self.num_inst += pred.size
+            self._accumulate(pred.sum(), pred.size)
 
 
 @register
@@ -366,11 +387,9 @@ class CustomMetric(EvalMetric):
             reval = self._feval(label, pred)
             if isinstance(reval, tuple):
                 sum_metric, num_inst = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+                self._accumulate(sum_metric, num_inst)
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                self._accumulate(reval, 1)
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

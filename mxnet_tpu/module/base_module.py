@@ -13,7 +13,7 @@ import sys
 import threading
 import time
 
-from ..base import MXNetError, TrainingPreempted
+from ..base import MXNetError, StepHung, TrainingDiverged, TrainingPreempted
 from .. import metric as metric_mod
 from .. import io as io_mod
 from ..ndarray import NDArray
@@ -191,7 +191,8 @@ class BaseModule:
             monitor=None, param_sharding=None, compute_dtype=None,
             prefetch_to_device=None, prefetch_depth=2,
             metric_sync_period=None, steps_per_call=None,
-            checkpoint=None, checkpoint_period=1, resume_from=None):
+            checkpoint=None, checkpoint_period=1, resume_from=None,
+            health=None, loss_scale=None, step_timeout_s=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``), pipelined: by default the train iterator
         is wrapped in :class:`~mxnet_tpu.io.DevicePrefetchIter` so batch
@@ -230,6 +231,26 @@ class BaseModule:
           optimizer states and update counters are restored and the data
           stream is fast-forwarded to the recorded position, so the run
           continues the uninterrupted trajectory.
+
+        run health (see ``docs/health_monitoring.md``):
+
+        * ``health`` — enable the run-health sentinel: True, a policy
+          string ('warn'/'skip'/'rollback'), or a configured
+          :class:`~mxnet_tpu.health.HealthMonitor`
+          (``MXNET_HEALTH_MONITOR=1``).  The fused step then computes a
+          global grad norm + non-finite flag on-device, skips poisoned
+          steps bit-exactly, and — under the 'rollback' policy with a
+          ``checkpoint`` manager — reloads last-good and backs off the
+          learning rate on sustained divergence, raising
+          :class:`~mxnet_tpu.base.TrainingDiverged` when recovery is
+          exhausted.
+        * ``loss_scale`` — 'dynamic', a fixed scale, or a
+          :class:`~mxnet_tpu.health.DynamicLossScaler` for low-precision
+          ``compute_dtype`` runs (``MXNET_LOSS_SCALE``).
+        * ``step_timeout_s`` — arm a step watchdog
+          (``MXNET_STEP_TIMEOUT_S``): a step making no progress for this
+          long dumps all-thread stacks + health stats to an artifact and
+          raises :class:`~mxnet_tpu.base.StepHung` instead of hanging.
         """
         from ..base import get_env
         from ..initializer import Uniform
@@ -285,8 +306,16 @@ class BaseModule:
             opt_kwargs["compute_dtype"] = compute_dtype
         if K > 1:
             opt_kwargs["steps_per_call"] = K
+        if health is not None:
+            opt_kwargs["health"] = health
+        if loss_scale is not None:
+            opt_kwargs["loss_scale"] = loss_scale
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, **opt_kwargs)
+        # env-driven activation (MXNET_HEALTH_MONITOR=1) happens inside
+        # Module.init_optimizer; modules without health support simply
+        # have no monitor
+        hmon = getattr(self, "_health_monitor", None)
 
         if mgr is not None and mgr.kvstore is None:
             # the manager inherits rank/barrier semantics from the store
@@ -325,6 +354,17 @@ class BaseModule:
             eval_metric = metric_mod.LazyEvalMetric(eval_metric,
                                                     sync_period=sync)
 
+        timeout = float(step_timeout_s if step_timeout_s is not None
+                        else get_env("MXNET_STEP_TIMEOUT_S", 0.0, float))
+        watchdog = None
+        if timeout > 0:
+            from ..health import StepWatchdog
+
+            watchdog = StepWatchdog(
+                timeout,
+                stats_cb=hmon.snapshot if hmon is not None else None)
+            watchdog.start()
+
         try:
             self._fit_epochs(fit_data, eval_data, eval_metric,
                              validation_metric, monitor,
@@ -333,8 +373,25 @@ class BaseModule:
                              begin_epoch, num_epoch, K,
                              mgr=mgr, checkpoint_period=checkpoint_period,
                              resume_nbatch=resume_state.nbatch
-                             if resume_state is not None else 0)
+                             if resume_state is not None else 0,
+                             hmon=hmon, watchdog=watchdog)
+        except StepHung as e:
+            # the watchdog delivers a BARE StepHung through
+            # PyThreadState_SetAsyncExc (the C API cannot pass
+            # arguments); rehydrate the message and artifact path it
+            # recorded before raising
+            if e.args and e.args[0]:
+                raise
+            from ..health import last_hang_details
+
+            d = last_hang_details()
+            raise StepHung(
+                d.get("msg") or "training step made no progress (step "
+                "watchdog fired)", note=d.get("note"),
+                dump_path=d.get("dump_path")) from None
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             if fit_data is not train_data:
                 # the staging worker must not outlive fit: it would keep
                 # consuming the caller's iterator (stealing the batches a
@@ -358,7 +415,10 @@ class BaseModule:
                     validation_metric, monitor, batch_end_callback,
                     epoch_end_callback, eval_end_callback,
                     eval_batch_end_callback, begin_epoch, num_epoch, K,
-                    mgr=None, checkpoint_period=1, resume_nbatch=0):
+                    mgr=None, checkpoint_period=1, resume_nbatch=0,
+                    hmon=None, watchdog=None):
+        from ..testing import faults
+
         period = max(1, int(checkpoint_period))
         with _PreemptionGuard() as guard:
             for epoch in range(begin_epoch, num_epoch):
@@ -373,10 +433,19 @@ class BaseModule:
                 next_data_batch = next(data_iter)
                 while not end_of_batch:
                     data_batch = next_data_batch
+                    if watchdog is not None:
+                        watchdog.kick("epoch %d batch %d" % (epoch, nbatch))
+                    faults.inject("step")
                     if monitor is not None:
                         monitor.tic()
                     self.forward_backward(data_batch)
                     self.update()
+                    if hmon is not None:
+                        # dispatch boundary: feed the monitor this step's
+                        # device stats refs; it realizes LAGGED entries
+                        # (already finished on device — free reads) and
+                        # may request a rollback
+                        self._health_tick(hmon, mgr, epoch, nbatch)
                     # lookahead next() AFTER dispatch: pulling batch n+1 off
                     # the staging queue (and refilling it) overlaps the step
                     # that is still executing asynchronously on device
@@ -405,6 +474,18 @@ class BaseModule:
                         # batch boundary: params/optimizer state consistent
                         self._preempt(guard.fired, fit_data, mgr,
                                       epoch, nbatch)
+
+                if watchdog is not None:
+                    # the epoch tail (eval pass, checkpoint write,
+                    # callbacks) is not step progress; the first kick of
+                    # the next epoch rearms the timer
+                    watchdog.pause()
+                if hmon is not None:
+                    # drain the lag queue BEFORE the epoch checkpoint: a
+                    # pending rollback must not see a freshly saved
+                    # diverged state as "last good"
+                    self._health_tick(hmon, mgr, epoch, nbatch,
+                                      flush=True)
 
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
@@ -481,6 +562,17 @@ class BaseModule:
                 hasattr(self, "load_optimizer_states"):
             self.load_optimizer_states(state.states_path)
         n = int(state.num_update)
+        for o in self._optimizer_copies():
+            o.begin_num_update = n
+            o.num_update = n
+            # lazily refilled from begin_num_update on the next update,
+            # which makes the next step number n + 1 on every path
+            o._index_update_count = {}
+
+    def _optimizer_copies(self):
+        """Every live optimizer object a state change must reach: the
+        module's, the worker-side updater's, and the kvstore's pickled
+        clone (deduped by identity)."""
         kv = getattr(self, "_kvstore", None)
         opts = []
         for o in (getattr(self, "_optimizer", None),
@@ -490,12 +582,66 @@ class BaseModule:
                   getattr(getattr(kv, "updater", None), "optimizer", None)):
             if o is not None and not any(o is seen for seen in opts):
                 opts.append(o)
-        for o in opts:
-            o.begin_num_update = n
-            o.num_update = n
-            # lazily refilled from begin_num_update on the next update,
-            # which makes the next step number n + 1 on every path
-            o._index_update_count = {}
+        return opts
+
+    # -- run-health hooks -----------------------------------------------
+    def _health_tick(self, hmon, mgr, epoch, nbatch, flush=False):
+        """Feed the health monitor at a dispatch boundary and act on its
+        verdict.  'skip' needs no action here — the device already kept
+        the old params bit-exactly; 'rollback' reloads last-good."""
+        stats = getattr(self, "_last_health_stats", None)
+        self._last_health_stats = None
+        try:
+            if flush:
+                if stats is not None:
+                    hmon.tick(stats, step=(epoch, nbatch))
+                action = hmon.flush()
+            else:
+                action = hmon.tick(stats, step=(epoch, nbatch))
+        except TrainingDiverged as e:
+            e.epoch, e.nbatch = epoch, nbatch
+            raise
+        if action == "rollback":
+            self._health_rollback(hmon, mgr, epoch, nbatch)
+
+    def _health_rollback(self, hmon, mgr, epoch, nbatch):
+        """Reload the last-good checkpoint, back the learning rate off,
+        and continue from the CURRENT stream position — the poison
+        window is consumed, not replayed (replaying it would diverge
+        identically).  No manager or no checkpoint on disk means there
+        is nothing to roll back to: typed :class:`TrainingDiverged`."""
+        reason = getattr(hmon, "_last_anomaly", "sustained divergence")
+        if mgr is None or mgr.latest() is None:
+            raise TrainingDiverged(
+                "health policy requested a rollback at epoch %d batch %d "
+                "(%s) but no checkpoint is available — pass "
+                "fit(checkpoint=...) so there is a last-good state to "
+                "reload" % (epoch, nbatch, reason),
+                epoch=epoch, nbatch=nbatch, reason=reason)
+        state = mgr.load()
+        hmon.note_rollback(step=(epoch, nbatch))
+        factor = hmon.lr_backoff
+        self.logger.warning(
+            "health: rollback %d/%d at epoch %d batch %d (%s) — "
+            "restoring checkpoint epoch %d (num_update %d), learning "
+            "rate x%g", hmon.consecutive_rollbacks, hmon.max_rollbacks,
+            epoch, nbatch, reason, state.epoch, state.num_update, factor)
+        self.set_params(state.arg_params, state.aux_params)
+        self._restore_from(state)
+        for o in self._optimizer_copies():
+            o.lr *= factor
+            sch = getattr(o, "lr_scheduler", None)
+            if sch is not None:
+                # FactorScheduler reads base_lr; Poly/Cosine recompute
+                # from base_lr_orig — back both off so every schedule
+                # family honors the reduction
+                if getattr(sch, "base_lr", None) is not None:
+                    sch.base_lr *= factor
+                if getattr(sch, "base_lr_orig", None) is not None:
+                    sch.base_lr_orig *= factor
+        # the restored trajectory has different statistics; the stale
+        # EMA/lag state must not re-trigger on it
+        hmon.soft_reset()
 
     def _fast_forward_data(self, train_data, epochs, nbatch):
         """Replay the raw data stream to a mid-run position: one

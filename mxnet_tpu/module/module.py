@@ -222,7 +222,8 @@ class Module(BaseModule):
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False, param_sharding=None,
-                       compute_dtype=None, steps_per_call=None):
+                       compute_dtype=None, steps_per_call=None,
+                       health=None, loss_scale=None):
         """``param_sharding``: 'replicated' (default), 'fsdp', 'tp', or a
         rule list (see ``parallel.sharding.param_sharding_rules``) —
         applied to the fused step's parameter/optimizer-state layouts
@@ -236,12 +237,25 @@ class Module(BaseModule):
         ``steps_per_call=K``: multi-step dispatch — the fused step scans
         K donated updates over a packed (K, batch, …) super-batch per
         device call (``fit`` packs via ``DevicePrefetchIter``).  Also
-        settable via ``MXNET_STEPS_PER_CALL``."""
+        settable via ``MXNET_STEPS_PER_CALL``.
+
+        ``health``: run-health sentinel — True / a policy string / a
+        :class:`~mxnet_tpu.health.HealthMonitor` (also via
+        ``MXNET_HEALTH_MONITOR=1``); ``loss_scale``: 'dynamic', a fixed
+        number, or a :class:`~mxnet_tpu.health.DynamicLossScaler` for
+        low-precision runs (also via ``MXNET_LOSS_SCALE``).  See
+        docs/health_monitoring.md."""
         from ..base import get_env
+        from ..health import DynamicLossScaler, resolve_monitor
 
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        self._health_monitor = resolve_monitor(health)
+        if loss_scale is None:
+            loss_scale = get_env("MXNET_LOSS_SCALE", "", str) or None
+        self._loss_scaler = DynamicLossScaler.from_spec(loss_scale)
+        self._last_health_stats = None
         if param_sharding is None:
             param_sharding = get_env("MXNET_PARAM_SHARDING", "", str) \
                 or None
@@ -367,6 +381,13 @@ class Module(BaseModule):
                 raise MXNetError(
                     "steps_per_call=%d was requested but the fused step "
                     "is unavailable: %s" % (self._steps_per_call, reason))
+            # and loss scaling: the split path cannot thread scaler state
+            # through per-parameter updates, so silently training
+            # unscaled would defeat the overflow protection asked for
+            if getattr(self, "_loss_scaler", None) is not None:
+                raise MXNetError(
+                    "loss_scale was requested but the fused step is "
+                    "unavailable: %s" % (reason,))
 
         if self._pipeline_stages > 1:
             if getattr(self, "_steps_per_call", 1) > 1:
@@ -374,6 +395,18 @@ class Module(BaseModule):
                     "steps_per_call cannot combine with pipeline_stages "
                     "(the pipelined step already runs its own microbatch "
                     "wave per call)")
+            if getattr(self, "_loss_scaler", None) is not None:
+                raise MXNetError(
+                    "loss_scale cannot combine with pipeline_stages (the "
+                    "pipelined step does not thread scaler state)")
+            if getattr(self, "_health_monitor", None) is not None:
+                # the pipelined step computes no in-step stats; the
+                # liveness side (watchdog, heartbeats) still applies
+                self.logger.warning(
+                    "health monitor: in-step numerics are unavailable "
+                    "with pipeline_stages — disabling the monitor "
+                    "(step watchdog and heartbeats remain active)")
+                self._health_monitor = None
             # an EXPLICIT pipeline request never falls back silently
             from ..parallel.pipeline import PipelineTrainStep
 
@@ -440,22 +473,33 @@ class Module(BaseModule):
             return
         try:
             from ..fused import TrainStep
+            from ..health import StepHealth
 
             remat = "full" if get_env("MXNET_BACKWARD_DO_MIRROR", False,
                                       bool) else None
+            scaler = getattr(self, "_loss_scaler", None)
+            step_health = None
+            if scaler is not None or \
+                    getattr(self, "_health_monitor", None) is not None:
+                step_health = StepHealth(scaler=scaler)
             self._fused = TrainStep(
                 self._symbol, optimizer=o, mesh=self._mesh,
                 data_names=self._data_names, label_names=self._label_names,
                 fixed_param_names=self._fixed_param_names, remat=remat,
                 param_sharding=getattr(self, "_param_sharding", None),
                 compute_dtype=getattr(self, "_compute_dtype", None),
-                steps_per_call=getattr(self, "_steps_per_call", 1))
+                steps_per_call=getattr(self, "_steps_per_call", 1),
+                health=step_health)
         except Exception as e:  # fall back to the split path
             if getattr(self, "_compute_dtype", None) is not None:
                 raise MXNetError(
                     "compute_dtype=%r was requested but the fused step "
                     "could not be built: %s"
                     % (self._compute_dtype, e)) from e
+            if getattr(self, "_loss_scaler", None) is not None:
+                raise MXNetError(
+                    "loss_scale was requested but the fused step could "
+                    "not be built: %s" % (e,)) from e
             if getattr(self, "_steps_per_call", 1) > 1:
                 raise MXNetError(
                     "steps_per_call=%d was requested but the fused step "
@@ -531,6 +575,19 @@ class Module(BaseModule):
 
             dev = self._context[0].jax_device
             batch = {k: jax.device_put(v, dev) for k, v in batch.items()}
+        from ..testing import faults
+
+        poison = faults.inject("numerics")
+        if poison is not None:
+            # poison one element of the first data tensor: the NaN/Inf
+            # flows through forward AND backward, exercising the on-step
+            # non-finite sentinel end to end (deterministic via
+            # MXNET_FAULT_INJECT=numerics:nan:after=N)
+            name = self._data_names[0]
+            v = batch[name]
+            v = v.at[(0,) * v.ndim].set(poison)
+            batch = dict(batch)
+            batch[name] = v
         # split-path parity: the scheduler is consulted at the
         # PRE-increment num_update (Optimizer.update calls _get_lr before
         # _update_count); bias-correction t is the POST-increment count.
@@ -543,6 +600,7 @@ class Module(BaseModule):
         t = o.num_update - K + 1
         new_params, new_aux, self._fused_states, outs = self._fused(
             params, aux, self._fused_states, batch, _rnd.next_key(), lr, t)
+        self._last_health_stats = getattr(self._fused, "last_health", None)
         from ..parallel.pipeline import PipelineTrainStep
 
         if isinstance(self._fused, PipelineTrainStep):
@@ -601,6 +659,11 @@ class Module(BaseModule):
             self._fused_ran = False  # fused step already applied the update
             self._async_tick()
             return
+        o = self._optimizer
+        if o is not None and (getattr(o, "clip_global_norm", None)
+                              or getattr(self, "_health_monitor", None)
+                              is not None):
+            self._split_health_pass()
         if self._kvstore:
             # one batched push in priority order (priority=-i: earliest
             # layers first, the reference's overlap hint order,
@@ -626,6 +689,35 @@ class Module(BaseModule):
                 if g is not None:
                     self._updater(i, g, w)
         self._async_tick()
+
+    def _split_health_pass(self):
+        """Split-path analogue of the in-step sentinel: one lazy pass
+        over ``grad_dict`` computing the global norm, applying
+        ``clip_global_norm``, and zeroing the gradients on a non-finite
+        batch so the update is skipped.  All ops trace asynchronously —
+        no host sync.  Unlike the fused path the skip is APPROXIMATE:
+        momentum still decays and weight decay still applies over the
+        zeroed gradients (the bit-exact guarantee is the fused path's)."""
+        import jax.numpy as jnp
+
+        o = self._optimizer
+        names = [n for n in self._param_names
+                 if self._exec.grad_dict.get(n) is not None]
+        if not names:
+            return
+        grads = {n: self._exec.grad_dict[n]._data for n in names}
+        gnorm = opt.global_grad_norm(grads, o.rescale_grad)
+        finite = jnp.isfinite(gnorm)
+        factor = jnp.asarray(1.0, "float32")
+        if getattr(o, "clip_global_norm", None):
+            factor = opt.global_norm_scale(gnorm, o.clip_global_norm)
+        if getattr(self, "_health_monitor", None) is not None:
+            factor = jnp.where(finite, factor, 0.0)
+            self._last_health_stats = {"grad_norm": gnorm,
+                                       "nonfinite": ~finite}
+        for n in names:
+            g = grads[n]
+            self._exec.grad_dict[n]._set_data(g * factor.astype(g.dtype))
 
     def _async_params(self):
         # aux states (BN moving stats) average too — per-shard moving

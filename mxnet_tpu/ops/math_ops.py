@@ -71,7 +71,9 @@ def _make_loss(attrs, x):
         return v, v.shape
 
     def bwd(shape, g):
-        return (jnp.full(shape, scale, dtype=g.dtype),)
+        # grad_scale times the head cotangent (ones under the reference
+        # seeding; the fused step's loss scale rides it)
+        return (jnp.full(shape, scale, dtype=g.dtype) * g,)
 
     f.defvjp(fwd, bwd)
     return f(x)

@@ -11,9 +11,11 @@ TPU-first notes:
   physical layout — no hand transposes.
 * Losses with fused backwards in the reference (SoftmaxOutput, the
   regression outputs) keep their exact gradient contract via
-  ``jax.custom_vjp``: backward emits ``(p - label) * grad_scale`` ignoring
-  head gradients, matching ``src/operator/softmax_output-inl.h`` /
-  ``regression_output-inl.h``.
+  ``jax.custom_vjp``: backward emits ``(p - label) * grad_scale`` times
+  the head cotangent (ones under the reference's ``Executor.backward``
+  seeding, so results match ``src/operator/softmax_output-inl.h`` /
+  ``regression_output-inl.h`` exactly; a dynamic loss scale from the
+  run-health sentinel rides the cotangent into the backward chain).
 * Stateful normalization (BatchNorm moving stats) threads state functionally:
   the op returns updated stats and the invoke layer rebinds the aux
   NDArrays — replacing the reference's in-place aux mutation.
@@ -334,6 +336,10 @@ def _softmax_output(attrs, data, label):
             valid = jnp.maximum(jnp.sum((l != ignore_label)), 1)
             grad = grad / valid.astype(p.dtype)
         grad = grad * scale
+        # ride the head cotangent: the reference seeds ones (identical
+        # result); the fused step's dynamic loss scale arrives here as a
+        # constant cotangent and scales the whole downstream backward
+        grad = grad * g.astype(grad.dtype)
         return grad, jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)
@@ -357,6 +363,7 @@ def _regression_output(transform, grad):
             for s in d.shape[1:]:
                 num *= s
             gd = grad(transform(d), l.reshape(d.shape)) * (grad_scale / num)
+            gd = gd * g.astype(gd.dtype)  # ones-seeded: identity
             return gd, jnp.zeros_like(l)
 
         f.defvjp(fwd, bwd)
@@ -397,6 +404,7 @@ def _svm_output(attrs, data, label):
         else:
             m = jnp.maximum(0., d - score_correct + margin) * (1 - onehot)
             gd = reg * 2 * (m - onehot * jnp.sum(m, axis=-1, keepdims=True))
+        gd = gd * g.astype(gd.dtype)  # ones-seeded: identity
         return gd, jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)

@@ -44,7 +44,7 @@ class Optimizer:
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
-                 sym=None, begin_num_update=0):
+                 sym=None, begin_num_update=0, clip_global_norm=None):
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
@@ -52,6 +52,16 @@ class Optimizer:
             self.lr_scheduler.base_lr = learning_rate
         self.wd = wd
         self.clip_gradient = clip_gradient
+        if clip_global_norm is not None and clip_global_norm <= 0:
+            raise MXNetError("clip_global_norm must be > 0 (got %r)"
+                             % (clip_global_norm,))
+        # true global-norm clipping: the whole gradient vector is scaled
+        # by min(1, clip/||g||) — norm taken over ALL trainable params
+        # jointly (after rescale_grad, before the per-element
+        # clip_gradient).  Applied by the fused step / Module.update,
+        # not per-parameter `update()` calls, because the norm spans
+        # parameters.
+        self.clip_global_norm = clip_global_norm
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
@@ -159,6 +169,27 @@ class Optimizer:
         """Convert a ``create_state``-structured state (NDArrays) to the
         fused raw-jax pytree."""
         return _tree_nd_to_jax(state)
+
+
+def global_grad_norm(grads, rescale_grad=1.0):
+    """Global L2 norm over a list/dict of raw-jax gradients, as the
+    optimizer will see them (i.e. scaled by ``rescale_grad``).  Pure and
+    traceable — the fused step inlines it; ``Module.update`` calls it on
+    the split path."""
+    import jax.numpy as jnp
+
+    leaves = list(grads.values()) if isinstance(grads, dict) else list(grads)
+    sq = jnp.asarray(0.0, "float32")
+    for g in leaves:
+        sq = sq + jnp.sum(jnp.square(g.astype("float32")))
+    return jnp.sqrt(sq) * abs(rescale_grad)
+
+
+def global_norm_scale(norm, max_norm, dtype="float32"):
+    """Traceable min(1, max_norm/||g||) clip factor (eps-guarded)."""
+    import jax.numpy as jnp
+
+    return jnp.minimum(1.0, max_norm / (norm + 1e-12)).astype(dtype)
 
 
 def _tree_jax_to_nd(x, ctx):

@@ -21,6 +21,17 @@ Actions:
   "clean error" channel.
 * ``delay`` — sleep ``seconds`` at the site, modeling a wedged peer or a
   slow network; used to trip the ``MXNET_KV_TIMEOUT_S`` watchdogs.
+* ``hang`` — sleep ``seconds`` in short slices, modeling a wedged step;
+  used to trip the ``MXNET_STEP_TIMEOUT_S`` step watchdog.  The sliced
+  sleep gives the watchdog's asynchronously-raised
+  :class:`~mxnet_tpu.base.StepHung` a bytecode boundary to land on, so
+  the "hung" thread dies the way a wedged-but-interruptible one would.
+* ``nan`` / ``inf`` — *value* injection: :func:`inject` RETURNS
+  ``float('nan')`` / ``float('inf')`` instead of raising, and the site
+  folds it into its data (``Module`` poisons one element of the batch at
+  site ``numerics``, which flows through forward/backward into the loss
+  and every gradient).  Callers that ignore the return value are
+  unaffected.
 
 Keys:
 
@@ -32,7 +43,10 @@ Keys:
 
 Sites instrumented today: ``device_prefetch`` / ``prefetch`` (the io.py
 worker loops), ``checkpoint_io`` (between temp-file write and the atomic
-rename), ``collective`` (kvstore DCN barrier / cross-replica sum).
+rename), ``collective`` (kvstore DCN barrier / cross-replica sum),
+``numerics`` (Module's fused step — poison one batch element with the
+returned nan/inf), ``step`` (top of every fit batch — ``hang`` here
+trips the step watchdog).
 
 The parsed spec auto-refreshes when the env var string changes; call
 :func:`reset` to re-arm counters when reusing the same string (tests).
@@ -49,7 +63,7 @@ __all__ = ["FaultInjected", "WorkerKilled", "inject", "reset", "active"]
 
 ENV_VAR = "MXNET_FAULT_INJECT"
 
-_ACTIONS = ("raise", "kill", "delay")
+_ACTIONS = ("raise", "kill", "delay", "hang", "nan", "inf")
 
 
 class FaultInjected(MXNetError):
@@ -133,10 +147,13 @@ def inject(site):
     """Fault hook.  No-op unless ``MXNET_FAULT_INJECT`` names ``site``;
     otherwise counts the hit and fires the configured action when the
     counter reaches ``after`` (every later hit too with ``sticky=1``).
+    Returns the poison value for ``nan``/``inf`` actions, else None.
     """
     if not os.environ.get(ENV_VAR) and _env_snapshot in (None, ""):
-        return  # fast path: nothing armed, nothing to refresh
+        return None  # fast path: nothing armed, nothing to refresh
     delays = []
+    hangs = []
+    poison = None
     with _lock:
         _refresh_locked()
         for i, spec in enumerate(_specs):
@@ -149,6 +166,12 @@ def inject(site):
                 continue
             if spec["action"] == "delay":
                 delays.append(spec["seconds"])
+            elif spec["action"] == "hang":
+                hangs.append(spec["seconds"])
+            elif spec["action"] == "nan":
+                poison = float("nan")
+            elif spec["action"] == "inf":
+                poison = float("inf")
             elif spec["action"] == "kill":
                 raise WorkerKilled(
                     "injected worker kill at site %r (hit %d)" % (site, n))
@@ -158,3 +181,12 @@ def inject(site):
                     % (site, n, ENV_VAR, _env_snapshot))
     for s in delays:  # sleep outside the lock: a delay must not serialize
         time.sleep(s)  # other sites behind it
+    for s in hangs:
+        # sliced sleep: the step watchdog delivers StepHung with
+        # PyThreadState_SetAsyncExc, which lands at the next bytecode
+        # boundary — a single long time.sleep would swallow it until
+        # the full hang elapsed
+        deadline = time.monotonic() + s
+        while time.monotonic() < deadline:
+            time.sleep(0.02)
+    return poison

@@ -38,3 +38,20 @@ def seed_rngs():
     np.random.seed(0)
     mx.random.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def no_health_thread_leaks():
+    """Every watchdog/heartbeat thread must be stopped by the code that
+    started it (fit's finally block, kv.close, explicit stop()) — a
+    leaked poller would keep firing into later tests."""
+    yield
+    import threading
+
+    from mxnet_tpu.health import (HEARTBEAT_THREAD_PREFIX,
+                                  WATCHDOG_THREAD_PREFIX)
+
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith((WATCHDOG_THREAD_PREFIX,
+                                    HEARTBEAT_THREAD_PREFIX))]
+    assert not leaked, "leaked run-health threads: %s" % leaked

@@ -16,6 +16,7 @@ and saves the final params for the runner to compare.
 import json
 import os
 import sys
+import time
 
 # one CPU device per process; the split Module path is the multi-process
 # contract under test (grads ride kvstore push/pull over DCN)
@@ -62,9 +63,34 @@ def main():
 
     results = {}
 
+    # rank heartbeats ride the dist kvstore when the directory is set
+    hb_dir = os.path.join(outdir, "heartbeats")
+    os.environ["MXNET_HEARTBEAT_DIR"] = hb_dir
+
     # 1) dense push/pull across processes
     kv = mx.kv.create("dist_tpu_sync")
     assert kv.rank == rank and kv.num_workers == num_procs
+
+    # 1b) heartbeat liveness + dead-peer naming: every live rank's
+    # beacon appears; a phantom rank is NAMED as never having written
+    from mxnet_tpu import health
+
+    assert kv._heartbeat is not None and kv._heartbeat.alive
+    assert os.path.exists(health.RankHeartbeat.path_for(hb_dir, rank))
+    deadline = time.time() + 60
+    while any(not os.path.exists(health.RankHeartbeat.path_for(hb_dir, r))
+              for r in range(num_procs)):
+        assert time.time() < deadline, "peer heartbeat never appeared"
+        time.sleep(0.05)
+    assert health.stale_peers(hb_dir, num_procs, stale_s=1e9,
+                              self_rank=rank) == []
+    ghost = health.stale_peers(hb_dir, num_procs + 1, stale_s=1e9,
+                               self_rank=rank)
+    assert [g for g, _ in ghost] == [num_procs], ghost
+    assert "never wrote a heartbeat" in ghost[0][1]
+    report = health.peer_report(num_procs, self_rank=rank)
+    assert "all current" in report, report
+    results["heartbeat"] = "ok"
     kv.init("w", mx.nd.zeros((4, 3)))
     grad = mx.nd.array(np.full((4, 3), float(rank + 1), "float32"))
     kv.push("w", grad)

@@ -1,0 +1,631 @@
+"""Run-health sentinel: in-step numerical guards, skip/rollback
+recovery, and hang watchdogs.
+
+Covers the health subsystem end to end:
+
+* fused-step skip semantics: a poisoned (NaN) batch leaves params,
+  optimizer states and aux bit-identical, and the dynamic loss scaler
+  backs off,
+* ``clip_global_norm`` true global-norm clipping on the fused path,
+* ``HealthMonitor`` policy ladder (warn/skip/rollback), lag queue, EMA
+  spike detection, and escalation to ``TrainingDiverged``,
+* ``fit(health=...)`` with injected numerics: skip-and-continue,
+  auto-rollback to the last-good checkpoint with LR backoff, typed
+  divergence errors when recovery is impossible or exhausted,
+* ``StepWatchdog``: an injected hang produces a stack-dump artifact and
+  a typed ``StepHung`` within the timeout + grace instead of a CI hang,
+* ``RankHeartbeat`` / ``stale_peers`` / ``peer_report`` dead-peer
+  naming and the ``_run_bounded(diagnose=...)`` wiring,
+* the ``Monitor`` ``nan_count`` stat func and batched ``toc()``,
+* ``EvalMetric`` non-finite guard.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import health
+from mxnet_tpu.base import MXNetError, StepHung, TrainingDiverged
+from mxnet_tpu.health import (DynamicLossScaler, HealthMonitor, StepHealth)
+from mxnet_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    os.environ.pop("MXNET_FAULT_INJECT", None)
+    faults.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _data(n=64):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 8).astype("float32")
+    w = rs.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    return X, y
+
+
+def _fit(num_epoch, X, y, **kw):
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True, seed=42)
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9}, **kw)
+    return mod
+
+
+def _accuracy(mod, X, y):
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    return dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+
+
+def _quiet_monitor(**kw):
+    """A monitor with spike detection effectively off (tiny test losses
+    jitter hard) and no realization lag (deterministic tests)."""
+    kw.setdefault("loss_spike", 1e9)
+    kw.setdefault("grad_spike", 1e9)
+    kw.setdefault("lag", 0)
+    kw.setdefault("warmup", 2)
+    return HealthMonitor(**kw)
+
+
+# -- DynamicLossScaler --------------------------------------------------
+
+def test_loss_scaler_from_spec():
+    assert DynamicLossScaler.from_spec(None) is None
+    assert DynamicLossScaler.from_spec("") is None
+    dyn = DynamicLossScaler.from_spec("dynamic")
+    assert dyn.init_scale == 2.0 ** 15 and dyn.growth == 2.0
+    static = DynamicLossScaler.from_spec(128)
+    assert static.init_scale == 128.0
+    assert static.min_scale == static.max_scale == 128.0  # never moves
+    scaler = DynamicLossScaler(init_scale=4.0)
+    assert DynamicLossScaler.from_spec(scaler) is scaler
+    with pytest.raises(MXNetError, match="init_scale"):
+        DynamicLossScaler(init_scale=-1)
+
+
+# -- fused-step in-step numerics ---------------------------------------
+
+def _make_step(**kw):
+    from mxnet_tpu.fused import TrainStep
+
+    import jax
+
+    kw.setdefault("optimizer_params", {"learning_rate": 0.1})
+    step = TrainStep(_mlp(), optimizer="sgd", **kw)
+    params, aux, states = step.init_state(
+        {"data": (16, 8), "softmax_label": (16,)})
+    rng = jax.random.PRNGKey(0)
+    X = np.asarray(jax.random.normal(rng, (16, 8), "float32"))
+    batch = {"data": X, "softmax_label": np.zeros((16,), "float32")}
+    return step, params, aux, states, batch, rng
+
+
+def _snap(tree):
+    import jax
+
+    return jax.tree.map(lambda v: np.asarray(jax.device_get(v)), tree)
+
+
+def test_fused_health_stats_reported():
+    import jax
+
+    step, params, aux, states, batch, rng = _make_step(
+        health=StepHealth())
+    params, aux, states, outs = step(params, aux, states, batch, rng)
+    stats = jax.device_get(step.last_health)
+    assert float(stats["grad_norm"]) > 0
+    assert np.isfinite(float(stats["loss"]))
+    assert not bool(stats["nonfinite"])
+
+
+def test_fused_skip_is_bit_exact():
+    """A NaN-poisoned batch must leave params AND optimizer states
+    bit-identical — the device-side ``jnp.where`` skip, not a
+    small-update approximation."""
+    step, params, aux, states, batch, rng = _make_step(
+        health=StepHealth())
+    params, aux, states, _ = step(params, aux, states, batch, rng)
+    psnap, ssnap = _snap(params), _snap(states)  # before donation
+
+    bad = dict(batch)
+    bad["data"] = np.array(batch["data"])
+    bad["data"][0, 0] = np.nan
+    params, aux, states, _ = step(params, aux, states, bad, rng)
+    import jax
+
+    assert bool(jax.device_get(step.last_health)["nonfinite"])
+    for k, v in _snap(params).items():
+        np.testing.assert_array_equal(v, psnap[k], err_msg=k)
+    import jax.tree_util as jtu
+
+    for a, b in zip(jtu.tree_leaves(_snap(states)),
+                    jtu.tree_leaves(ssnap)):
+        np.testing.assert_array_equal(a, b)
+
+    # and the step still trains on the next clean batch
+    params, aux, states, _ = step(params, aux, states, batch, rng)
+    assert not np.array_equal(_snap(params)["fc1_weight"],
+                              psnap["fc1_weight"])
+
+
+def test_fused_loss_scaler_grows_and_backs_off():
+    scaler = DynamicLossScaler(init_scale=8.0, growth=2.0, backoff=0.5,
+                               growth_interval=2, min_scale=1.0,
+                               max_scale=64.0)
+    step, params, aux, states, batch, rng = _make_step(
+        health=StepHealth(scaler=scaler))
+    params, aux, states, _ = step(params, aux, states, batch, rng)
+    params, aux, states, _ = step(params, aux, states, batch, rng)
+    # two clean steps == one growth_interval: 8 -> 16
+    assert step.loss_scale == 16.0
+
+    psnap = _snap(params)
+    bad = dict(batch)
+    bad["data"] = np.array(batch["data"])
+    bad["data"][0, 0] = np.nan
+    params, aux, states, _ = step(params, aux, states, bad, rng)
+    assert step.loss_scale == 8.0  # overflow: backoff, and ...
+    for k, v in _snap(params).items():
+        np.testing.assert_array_equal(v, psnap[k], err_msg=k)  # ... skip
+
+
+def test_fused_scaled_matches_unscaled():
+    """Static loss scaling must be numerically invisible: scale the loss
+    up, unscale the grads — same trajectory as no scaler."""
+    step, params, aux, states, batch, rng = _make_step()
+    for _ in range(3):
+        params, aux, states, _ = step(params, aux, states, batch, rng)
+    ref = _snap(params)
+
+    scaler = DynamicLossScaler.from_spec(1024.0)
+    step2, params2, aux2, states2, batch2, rng2 = _make_step(
+        health=StepHealth(scaler=scaler))
+    for _ in range(3):
+        params2, aux2, states2, _ = step2(params2, aux2, states2, batch2,
+                                          rng2)
+    for k, v in _snap(params2).items():
+        np.testing.assert_allclose(v, ref[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_clip_global_norm_fused():
+    import jax
+
+    from mxnet_tpu import optimizer as opt_mod
+
+    # helper math
+    import jax.numpy as jnp
+
+    grads = [jnp.asarray([3.0, 4.0]), jnp.asarray([12.0])]
+    norm = float(opt_mod.global_grad_norm(grads))
+    assert norm == pytest.approx(13.0)  # sqrt(9+16+144)
+    assert float(opt_mod.global_norm_scale(10.0, 5.0)) == \
+        pytest.approx(0.5, rel=1e-5)
+    assert float(opt_mod.global_norm_scale(2.0, 5.0)) == 1.0  # no-op below
+
+    # fused integration: clipping at half the raw norm exactly halves a
+    # plain-SGD update (the update is linear in the gradients)
+    step, params, aux, states, batch, rng = _make_step(
+        health=StepHealth())
+    p0 = _snap(params)
+    pa, _, _, _ = step(params, aux, states, batch, rng)
+    gnorm = float(jax.device_get(step.last_health)["grad_norm"])
+    delta = {k: _snap(pa)[k] - p0[k] for k in p0}
+
+    step2, params2, aux2, states2, _, _ = _make_step(
+        health=StepHealth(),
+        optimizer_params={"learning_rate": 0.1,
+                          "clip_global_norm": gnorm / 2.0})
+    params2 = {k: jnp.asarray(v) for k, v in p0.items()}  # same start
+    pb, _, _, _ = step2(params2, aux2, states2, batch, rng)
+    # reported norm is PRE-clip: unchanged
+    assert float(jax.device_get(step2.last_health)["grad_norm"]) == \
+        pytest.approx(gnorm, rel=1e-5)
+    for k in p0:
+        np.testing.assert_allclose(_snap(pb)[k] - p0[k], delta[k] / 2.0,
+                                   rtol=1e-4, atol=1e-7, err_msg=k)
+
+
+# -- HealthMonitor policy engine ---------------------------------------
+
+def test_monitor_skip_accounting_and_escalation():
+    mon = _quiet_monitor(policy="skip", max_skips=3)
+    assert mon.observe(loss=1.0, grad_norm=1.0) == "ok"
+    assert mon.observe(loss=float("nan"), grad_norm=1.0) == "skip"
+    assert mon.observe(nonfinite=True) == "skip"
+    assert mon.consecutive_skips == 2 and mon.total_skips == 2
+    assert mon.observe(loss=1.0, grad_norm=1.0) == "ok"
+    assert mon.consecutive_skips == 0  # clean step clears the streak
+    for _ in range(2):
+        mon.observe(nonfinite=True)
+    with pytest.raises(TrainingDiverged, match="consecutive non-finite"):
+        mon.observe(nonfinite=True)  # 3rd consecutive: policy can't roll back
+
+
+def test_monitor_warn_policy_never_raises():
+    mon = _quiet_monitor(policy="warn", max_skips=2)
+    for _ in range(10):
+        assert mon.observe(nonfinite=True) == "warn"
+    assert mon.total_skips == 10
+
+
+def test_monitor_rollback_policy_and_exhaustion():
+    mon = _quiet_monitor(policy="rollback", max_skips=2, max_rollbacks=2)
+    mon.observe(nonfinite=True)
+    assert mon.observe(nonfinite=True) == "rollback"
+    assert "consecutive non-finite" in mon._last_anomaly
+    mon.note_rollback()
+    mon.soft_reset()
+    mon.observe(nonfinite=True)
+    assert mon.observe(nonfinite=True) == "rollback"
+    mon.note_rollback()
+    mon.soft_reset()
+    assert mon.consecutive_rollbacks == 2
+    mon.observe(nonfinite=True)
+    with pytest.raises(TrainingDiverged, match="consecutive rollbacks"):
+        mon.observe(nonfinite=True)
+
+
+def test_monitor_spike_detection():
+    mon = HealthMonitor(policy="skip", loss_spike=10.0, grad_spike=1e9,
+                        warmup=3, lag=0, ema_decay=0.5)
+    for _ in range(5):
+        assert mon.observe(loss=1.0, grad_norm=1.0) == "ok"
+    assert mon.observe(loss=100.0, grad_norm=1.0) == "warn"
+    assert mon.total_warnings == 1
+    # rollback policy escalates the same spike
+    mon2 = _quiet_monitor(policy="rollback", loss_spike=10.0, warmup=2)
+    for _ in range(4):
+        mon2.observe(loss=1.0, grad_norm=1.0)
+    assert mon2.observe(loss=100.0, grad_norm=1.0) == "rollback"
+
+
+def test_monitor_lag_queue_and_flush():
+    mon = _quiet_monitor(policy="skip", lag=2)
+    bad = {"loss": np.float32("nan"), "grad_norm": np.float32(1.0),
+           "nonfinite": np.asarray(True)}
+    assert mon.tick(bad, step=0) == "ok"      # queued, not realized
+    assert mon.tick(bad, step=1) == "ok"      # still within lag
+    assert mon.observed == 0 and mon.total_skips == 0
+    assert mon.tick(bad, step=2) == "skip"    # step 0 realized
+    assert mon.flush() == "skip"              # drains 1 and 2
+    assert mon.total_skips == 3
+
+
+def test_monitor_realizes_scan_stacked_stats():
+    """steps_per_call=K stats arrive as (K,) arrays — one observation
+    per inner step."""
+    mon = _quiet_monitor(policy="skip", lag=0)
+    stacked = {"loss": np.asarray([1.0, np.nan, 1.0], "float32"),
+               "grad_norm": np.ones((3,), "float32"),
+               "nonfinite": np.asarray([False, True, False])}
+    assert mon.tick(stacked, step=0) == "skip"
+    assert mon.observed == 2 and mon.total_skips == 1
+
+
+def test_resolve_monitor_forms(monkeypatch):
+    monkeypatch.delenv("MXNET_HEALTH_MONITOR", raising=False)
+    assert health.resolve_monitor(None) is None
+    assert health.resolve_monitor(False) is None
+    mon = health.resolve_monitor("rollback")
+    assert isinstance(mon, HealthMonitor) and mon.policy == "rollback"
+    assert health.resolve_monitor(mon) is mon
+    monkeypatch.setenv("MXNET_HEALTH_MONITOR", "1")
+    monkeypatch.setenv("MXNET_HEALTH_POLICY", "warn")
+    auto = health.resolve_monitor(None)
+    assert isinstance(auto, HealthMonitor) and auto.policy == "warn"
+    with pytest.raises(MXNetError, match="policy"):
+        HealthMonitor(policy="explode")
+
+
+# -- fit(health=...) end to end ----------------------------------------
+
+def test_fit_skips_poisoned_step_and_completes(monkeypatch):
+    """Acceptance: MXNET_FAULT_INJECT=numerics:nan poisons one batch;
+    the run skips it bit-exactly on device, accounts for it, and still
+    converges."""
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "numerics:nan:after=5")
+    faults.reset()
+    X, y = _data()
+    mon = _quiet_monitor(policy="skip")
+    mod = _fit(6, X, y, health=mon)
+    assert mon.total_skips == 1 and mon.consecutive_skips == 0
+    params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k, v in params.items():
+        assert np.isfinite(v).all(), k
+    assert _accuracy(mod, X, y) > 0.8
+
+
+def test_fit_diverges_typed_after_max_skips(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_INJECT",
+                       "numerics:nan:after=3:sticky=1")
+    faults.reset()
+    X, y = _data()
+    with pytest.raises(TrainingDiverged) as ei:
+        _fit(2, X, y, health=_quiet_monitor(policy="skip", max_skips=2))
+    assert ei.value.epoch == 0 and ei.value.nbatch == 3
+    assert "MXNET_HEALTH_POLICY=rollback" in str(ei.value)
+
+
+def test_fit_rollback_restores_and_converges(tmp_path, monkeypatch):
+    """Acceptance: sustained divergence under the rollback policy
+    reloads the last-good checkpoint, backs off the LR, fast-forwards
+    past the poison window, and still reaches the uninterrupted run's
+    quality."""
+    X, y = _data()
+    ref_acc = _accuracy(_fit(8, X, y), X, y)
+
+    # 4 consecutive poisoned batches starting at epoch 1 batch 3
+    monkeypatch.setenv(
+        "MXNET_FAULT_INJECT",
+        "numerics:nan:after=12,numerics:nan:after=13,"
+        "numerics:nan:after=14,numerics:nan:after=15")
+    faults.reset()
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    mon = _quiet_monitor(policy="rollback", max_skips=3, max_rollbacks=3,
+                         lr_backoff=0.8)
+    mod = _fit(8, X, y, health=mon, checkpoint=mgr)
+
+    assert mon.total_rollbacks == 1
+    assert mod._optimizer.lr == pytest.approx(0.1 * 0.8)
+    acc = _accuracy(mod, X, y)
+    assert acc >= ref_acc - 0.15, (acc, ref_acc)
+
+
+def test_fit_rollback_without_checkpoint_is_typed(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_INJECT",
+                       "numerics:nan:after=3:sticky=1")
+    faults.reset()
+    X, y = _data()
+    with pytest.raises(TrainingDiverged, match="checkpoint"):
+        _fit(2, X, y,
+             health=_quiet_monitor(policy="rollback", max_skips=2))
+
+
+def test_fit_dynamic_loss_scale_trains():
+    X, y = _data()
+    mod = _fit(6, X, y, loss_scale="dynamic")
+    assert mod._fused is not None and mod._fused.loss_scale is not None
+    assert _accuracy(mod, X, y) > 0.8
+
+
+# -- step watchdog ------------------------------------------------------
+
+def test_watchdog_fires_dumps_and_raises(tmp_path):
+    caught = {}
+
+    def victim():
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                time.sleep(0.02)
+            caught["timeout"] = True
+        except StepHung:
+            caught["hung"] = True
+
+    t = threading.Thread(target=victim)
+    wd = health.StepWatchdog(0.5, stats_cb=lambda: {"observed": 7},
+                             dump_dir=str(tmp_path), target_thread=t)
+    t.start()
+    wd.start()
+    t.join(timeout=20)
+    assert caught.get("hung") and not t.is_alive()
+    assert wd.fired and wd.dump_path and os.path.exists(wd.dump_path)
+    with open(wd.dump_path) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "mxnet_tpu-watchdog-dump"
+    assert payload["health"] == {"observed": 7}
+    assert "Thread" in payload["traceback"]  # faulthandler stacks
+    assert health.last_hang_details()["dump_path"] == wd.dump_path
+    wd.stop()
+    assert not wd.alive
+
+
+def test_watchdog_kick_and_pause_prevent_firing():
+    wd = health.StepWatchdog(0.6).start()
+    try:
+        for _ in range(4):  # steady kicks: never fires
+            time.sleep(0.2)
+            wd.kick("step")
+        wd.pause()          # epoch tail: long gap, still no fire
+        time.sleep(1.0)
+        assert not wd.fired
+    finally:
+        wd.stop()
+    assert not wd.alive
+
+
+def test_fit_injected_hang_raises_stephung(tmp_path, monkeypatch):
+    """Acceptance: an injected hang produces a stack-dump artifact and a
+    typed StepHung within MXNET_STEP_TIMEOUT_S + grace — not a CI
+    hang."""
+    monkeypatch.setenv("MXNET_HEALTH_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "step:hang:seconds=60:after=3")
+    faults.reset()
+    X, y = _data()
+    tic = time.monotonic()
+    with pytest.raises(StepHung) as ei:
+        _fit(1, X, y, step_timeout_s=1.0)
+    assert time.monotonic() - tic < 30  # << the 60s injected hang
+    msg = str(ei.value)
+    assert "MXNET_STEP_TIMEOUT_S" in msg and "tools/diagnose.py" in msg
+    assert ei.value.note and "batch" in ei.value.note
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+    dumps = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("watchdog-")]
+    assert dumps
+
+
+# -- rank heartbeats ----------------------------------------------------
+
+def test_heartbeat_writes_and_stale_peer_naming(tmp_path):
+    d = str(tmp_path)
+    hb = health.RankHeartbeat(d, rank=0, num_workers=2, interval_s=0.05)
+    hb.start()
+    try:
+        assert os.path.exists(health.RankHeartbeat.path_for(d, 0))
+        # peer 1 never wrote: named as missing
+        dead = health.stale_peers(d, 2, stale_s=100, self_rank=0)
+        assert [r for r, _ in dead] == [1]
+        assert "never wrote" in dead[0][1]
+        # peer 1 beats once, then goes silent: named as stale with age
+        health.RankHeartbeat(d, rank=1, num_workers=2)._beat()
+        assert health.stale_peers(d, 2, stale_s=100, self_rank=0) == []
+        dead = health.stale_peers(d, 2, stale_s=0.0, self_rank=0,
+                                  now=time.time() + 10)
+        assert [r for r, _ in dead] == [1]
+        assert "last heartbeat" in dead[0][1]
+    finally:
+        hb.stop()
+    assert not hb.alive
+
+
+def test_peer_report_and_maybe_start(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_HEARTBEAT_DIR", raising=False)
+    assert health.peer_report(2) == ""          # unconfigured
+    assert health.RankHeartbeat.maybe_start(0, 2) is None
+    monkeypatch.setenv("MXNET_HEARTBEAT_DIR", str(tmp_path))
+    assert health.RankHeartbeat.maybe_start(0, 1) is None  # single rank
+    rep = health.peer_report(2, self_rank=0)    # rank 1 missing
+    assert "dead/stale peers" in rep and "rank 1" in rep
+    health.RankHeartbeat(str(tmp_path), rank=1, num_workers=2)._beat()
+    assert "all current" in health.peer_report(2, self_rank=0)
+    hb = health.RankHeartbeat.maybe_start(0, 2)
+    assert hb is not None and hb.alive
+    hb.stop()
+
+
+def test_run_bounded_timeout_includes_peer_diagnosis():
+    from mxnet_tpu.kvstore import _run_bounded
+
+    with pytest.raises(MXNetError, match="dead/stale peers: rank 1"):
+        _run_bounded(lambda: time.sleep(30), "wedged barrier",
+                     timeout_s=0.2,
+                     diagnose=lambda: "; dead/stale peers: rank 1 (pid "
+                                      "123) last heartbeat 42.0s ago")
+
+    # a crashing diagnose callback must never mask the timeout itself
+    def boom():
+        raise RuntimeError("heartbeat dir gone")
+
+    with pytest.raises(MXNetError, match="did not complete within"):
+        _run_bounded(lambda: time.sleep(30), "wedged barrier",
+                     timeout_s=0.2, diagnose=boom)
+
+
+# -- Monitor nan_count + batched toc -----------------------------------
+
+def test_monitor_nan_count_stat_func():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.monitor import STAT_FUNCS, Monitor
+
+    assert set(STAT_FUNCS) >= {"mean_abs", "nan_count"}
+    m = Monitor(1, stat_func="nan_count")
+    m.tic()
+    m.stat_helper("act", jnp.asarray([1.0, float("nan"), float("inf")]))
+    m.stat_helper("ints", jnp.asarray([1, 2, 3]))  # integer: always 0
+    res = {name: int(v) for _, name, v in m.toc()}
+    assert res == {"act": 2, "ints": 0}
+    with pytest.raises(MXNetError, match="unknown stat_func"):
+        Monitor(1, stat_func="no_such_stat")
+
+
+def test_monitor_toc_batches_device_gets(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.monitor import Monitor
+
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(1)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    m = Monitor(1)
+    m.tic()
+    for i in range(10):
+        m.stat_helper("n%d" % i, jnp.asarray([float(i)]))
+    assert len(m.toc()) == 10
+    assert len(calls) == 1  # ONE batched transfer for the whole queue
+
+
+# -- EvalMetric non-finite guard ---------------------------------------
+
+def test_metric_guard_drops_nonfinite_updates():
+    m = mx.metric.MAE()
+    m.update([mx.nd.array([1.0])], [mx.nd.array([float("nan")])])
+    assert m.num_inst == 0 and m.num_nonfinite == 1
+    m.update([mx.nd.array([1.0])], [mx.nd.array([3.0])])
+    name, val = m.get()
+    assert val == pytest.approx(2.0)  # clean update only
+    assert m.num_nonfinite == 1
+    m.reset()
+    assert m.num_nonfinite == 0
+
+
+def test_metric_guard_covers_loss_and_custom():
+    loss = mx.metric.Loss()
+    loss.update(None, [mx.nd.array([float("inf"), 1.0])])
+    assert loss.num_inst == 0 and loss.num_nonfinite == 1
+    loss.update(None, [mx.nd.array([2.0, 4.0])])
+    assert loss.get()[1] == pytest.approx(3.0)
+
+    cm = mx.metric.CustomMetric(lambda l, p: float("nan"), name="c")
+    cm.update([mx.nd.array([1.0])], [mx.nd.array([1.0])])
+    assert cm.num_inst == 0 and cm.num_nonfinite == 1
+
+
+# -- tools/diagnose.py --------------------------------------------------
+
+def test_diagnose_tool_pretty_prints_artifacts(tmp_path):
+    """The offline pretty-printer must round-trip the REAL artifacts the
+    sentinel writes: a StepWatchdog dump and a rank heartbeat."""
+    import subprocess
+    import sys as _sys
+
+    wd = health.StepWatchdog(timeout_s=100.0,
+                             stats_cb=lambda: {"loss_ema": 2.0},
+                             dump_dir=str(tmp_path))
+    wd._dump(7.5, "epoch 1 batch 9")
+    hb = health.RankHeartbeat(str(tmp_path), rank=0, num_workers=2)
+    hb._beat()
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "diagnose.py")
+    res = subprocess.run([_sys.executable, tool, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "WATCHDOG DUMP" in res.stdout
+    assert "epoch 1 batch 9" in res.stdout
+    assert "loss_ema" in res.stdout
+    assert "HEARTBEAT  rank 0" in res.stdout
+
+    # an empty directory is a clean non-zero "nothing recognized"
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    res = subprocess.run([_sys.executable, tool, str(empty)],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1
+    assert "nothing recognized" in res.stderr

@@ -46,8 +46,9 @@ def test_two_process_kvstore_and_fit(tmp_path):
     for rank in range(2):
         with open(str(tmp_path / ("result_rank%d.json" % rank))) as f:
             res = json.load(f)
-        assert res == {"dense_push_pull": "ok", "row_sparse_push": "ok",
-                       "row_sparse_pull": "ok", "fit": "ok"}, res
+        assert res == {"dense_push_pull": "ok", "heartbeat": "ok",
+                       "row_sparse_push": "ok", "row_sparse_pull": "ok",
+                       "fit": "ok"}, res
 
     p0 = dict(np.load(str(tmp_path / "params_rank0.npz")))
     p1 = dict(np.load(str(tmp_path / "params_rank1.npz")))

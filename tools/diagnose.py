@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Pretty-print run-health artifacts (docs/health_monitoring.md).
+
+Usage::
+
+    python tools/diagnose.py <file-or-dir> [...]
+    python tools/diagnose.py            # scans $MXNET_HEALTH_DIR / tmpdir
+
+Understands the two JSON artifact kinds the sentinel writes:
+
+* ``watchdog-<pid>-<time>.json`` — the StepWatchdog's all-thread stack
+  dump plus the last HealthMonitor snapshot, written when a training
+  step stalls past ``MXNET_STEP_TIMEOUT_S``.
+* ``heartbeat_rank<k>.json`` — per-rank liveness beacons under
+  ``MXNET_HEARTBEAT_DIR``.
+
+Stdlib only: this must run on the stripped coordinator image where the
+training venv is gone but the dump survived.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _fmt_time(ts):
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+    except (TypeError, ValueError, OverflowError):
+        return repr(ts)
+
+
+def _print_health(stats, indent="  "):
+    if not stats:
+        print(indent + "health stats: (none recorded)")
+        return
+    print(indent + "health stats:")
+    for key in sorted(stats):
+        print("%s  %-22s %r" % (indent, key, stats[key]))
+
+
+def print_watchdog(path, payload):
+    print("=" * 72)
+    print("WATCHDOG DUMP  %s" % path)
+    print("  pid %s at %s" % (payload.get("pid", "?"),
+                              _fmt_time(payload.get("time"))))
+    print("  stalled %.1fs (MXNET_STEP_TIMEOUT_S=%s) at %s"
+          % (float(payload.get("stalled_s", 0) or 0),
+             payload.get("timeout_s", "?"),
+             payload.get("note") or "<no batch note>"))
+    _print_health(payload.get("health"))
+    tb = payload.get("traceback") or ""
+    print("  threads at stall time:")
+    for line in tb.rstrip().splitlines():
+        print("    " + line)
+
+
+def print_heartbeat(path, payload, now=None):
+    now = time.time() if now is None else now
+    age = now - float(payload.get("time", 0) or 0)
+    print("HEARTBEAT  rank %-4s pid %-8s last beat %s (%.1fs ago)  %s"
+          % (payload.get("rank", "?"), payload.get("pid", "?"),
+             _fmt_time(payload.get("time")), age, path))
+
+
+def diagnose_file(path):
+    """Returns True when the file was a recognized artifact."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        print("%s: unreadable (%s)" % (path, e), file=sys.stderr)
+        return False
+    if not isinstance(payload, dict):
+        return False
+    name = os.path.basename(path)
+    if payload.get("kind") == "mxnet_tpu-watchdog-dump":
+        print_watchdog(path, payload)
+        return True
+    if name.startswith("heartbeat_rank") and "rank" in payload:
+        print_heartbeat(path, payload)
+        return True
+    return False
+
+
+def gather(target):
+    if os.path.isdir(target):
+        found = (glob.glob(os.path.join(target, "watchdog-*.json"))
+                 + glob.glob(os.path.join(target, "heartbeat_rank*.json")))
+        return sorted(found)
+    return [target]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pretty-print mxnet_tpu watchdog dumps and rank "
+                    "heartbeats")
+    ap.add_argument("paths", nargs="*",
+                    help="artifact files or directories to scan "
+                         "(default: $MXNET_HEALTH_DIR, else the tmpdir)")
+    args = ap.parse_args(argv)
+    targets = args.paths or [os.environ.get("MXNET_HEALTH_DIR")
+                             or tempfile.gettempdir()]
+    shown = 0
+    for target in targets:
+        files = gather(target)
+        if not files:
+            print("%s: no watchdog/heartbeat artifacts" % target,
+                  file=sys.stderr)
+        for path in files:
+            shown += diagnose_file(path)
+    if not shown:
+        print("nothing recognized — expected watchdog-*.json or "
+              "heartbeat_rank*.json (see docs/health_monitoring.md)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
