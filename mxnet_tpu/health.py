@@ -234,17 +234,27 @@ class HealthMonitor:
         except Exception:
             vals = {k: np.asarray(v) for k, v in ref.items()}
         # a steps_per_call=K stats entry carries (K,) arrays: one
-        # observation per inner step
+        # observation per inner step.  A stat a producer didn't measure
+        # (e.g. the split path has no loss) must become None, not NaN —
+        # observe() reads NaN as a non-finite step and would count every
+        # healthy step as a skip
+        has_loss = "loss" in vals
+        has_gnorm = "grad_norm" in vals
         loss = np.atleast_1d(np.asarray(vals.get("loss", np.nan),
                                         "float64"))
         gnorm = np.atleast_1d(np.asarray(vals.get("grad_norm", np.nan),
                                          "float64"))
         bad = np.atleast_1d(np.asarray(vals.get("nonfinite", 0)))
         action = "ok"
-        for k in range(loss.shape[0]):
+        n = max(loss.shape[0] if has_loss else 1,
+                gnorm.shape[0] if has_gnorm else 1, bad.shape[0])
+        for k in range(n):
             action = _stronger(action, self.observe(
-                step=step, loss=float(loss[k]),
-                grad_norm=float(gnorm[min(k, gnorm.shape[0] - 1)]),
+                step=step,
+                loss=float(loss[min(k, loss.shape[0] - 1)])
+                if has_loss else None,
+                grad_norm=float(gnorm[min(k, gnorm.shape[0] - 1)])
+                if has_gnorm else None,
                 nonfinite=bool(bad[min(k, bad.shape[0] - 1)])))
         return action
 
@@ -475,13 +485,16 @@ class StepWatchdog:
                "tools/diagnose.py)"
                % (stalled, self.timeout_s, note, self.dump_path))
         logger.critical(msg)
+        # stash the details where the raising thread can find them BEFORE
+        # delivery: SetAsyncExc instantiates the class with no arguments,
+        # and the target can catch it and read last_hang_details()
+        # immediately
+        _last_hang["msg"] = msg
+        _last_hang["note"] = note
+        _last_hang["dump_path"] = self.dump_path
         delivered = _async_raise(self._target, StepHung)
-        if delivered:
-            # stash the details where the raising thread can find them:
-            # SetAsyncExc instantiates the class with no arguments
-            _last_hang["msg"] = msg
-            _last_hang["note"] = note
-            _last_hang["dump_path"] = self.dump_path
+        if not delivered:
+            _last_hang.clear()
         if get_env("MXNET_STEP_TIMEOUT_EXIT", False, bool):
             # a thread wedged inside C never sees the async exception;
             # give it one more timeout, then fail the process loudly —

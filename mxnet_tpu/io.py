@@ -270,7 +270,56 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class PrefetchingIter(DataIter):
+class _ThreadedPrefetchTeardown(object):
+    """Shared drain/stop/join teardown for the queue+thread prefetchers
+    (:class:`PrefetchingIter`, :class:`DevicePrefetchIter`) — a dead- or
+    wedged-worker fix lands once here, not per class."""
+
+    def _drain(self, capture_error=False):
+        """Empty the queue; with ``capture_error`` return the first
+        pending worker exception found (an error the consumer never got
+        to see), else None."""
+        pending = None
+        try:
+            while True:
+                item = self._queue.get_nowait()
+                if capture_error and pending is None and \
+                        isinstance(item, Exception):
+                    pending = item
+        except queue.Empty:
+            pass
+        return pending
+
+    def close(self, timeout=5):
+        """Stop the worker WITHOUT restarting it (``reset`` is
+        stop-then-restart): signal stop, drain so a worker blocked on
+        the full queue can exit, join with ``timeout``, and RE-RAISE any
+        worker exception still pending in the queue — an error the
+        consumer never observed must not vanish on teardown.  After
+        ``close`` the iterator reports exhaustion until ``reset``; any
+        inner iterators are left untouched for the caller to reuse."""
+        self._stop.set()
+        pending = self._drain(capture_error=True)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                import logging
+
+                logging.warning("%s worker did not exit within %ss on "
+                                "close()", type(self).__name__, timeout)
+            self._thread = None
+        pending = pending or self._drain(capture_error=True)
+        self._exhausted = True
+        if pending is not None and pending is not self._worker_error:
+            self._worker_error = pending
+            raise pending
+
+    def __del__(self):
+        self._stop.set()
+
+
+class PrefetchingIter(_ThreadedPrefetchTeardown, DataIter):
     """Background-thread prefetcher over one or more iterators (reference
     ``PrefetchingIter``, ``io.py:341`` ≈ ``PrefetcherIter``/
     ``dmlc::ThreadedIter`` in C++).  Overlaps host batch prep with device
@@ -345,21 +394,6 @@ class PrefetchingIter(DataIter):
             i.reset()
         self._start()
 
-    def _drain(self, capture_error=False):
-        """Empty the queue; with ``capture_error`` return the first
-        pending worker exception found (an error the consumer never got
-        to see), else None."""
-        pending = None
-        try:
-            while True:
-                item = self._queue.get_nowait()
-                if capture_error and pending is None and \
-                        isinstance(item, Exception):
-                    pending = item
-        except queue.Empty:
-            pass
-        return pending
-
     def iter_next(self):
         if self._worker_error is not None:
             # the worker died on this error; keep surfacing it (a fresh
@@ -403,35 +437,8 @@ class PrefetchingIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
-    def close(self, timeout=5):
-        """Stop the worker WITHOUT restarting it (``reset`` is
-        stop-then-restart): signal stop, drain so a worker blocked on
-        the full queue can exit, join with ``timeout``, and RE-RAISE any
-        worker exception still pending in the queue — an error the
-        consumer never observed must not vanish on teardown.  After
-        ``close`` the iterator reports exhaustion until ``reset``."""
-        self._stop.set()
-        pending = self._drain(capture_error=True)
-        t = self._thread
-        if t is not None:
-            t.join(timeout=timeout)
-            if t.is_alive():
-                import logging
 
-                logging.warning("%s worker did not exit within %ss on "
-                                "close()", type(self).__name__, timeout)
-            self._thread = None
-        pending = pending or self._drain(capture_error=True)
-        self._exhausted = True
-        if pending is not None and pending is not self._worker_error:
-            self._worker_error = pending
-            raise pending
-
-    def __del__(self):
-        self._stop.set()
-
-
-class DevicePrefetchIter(DataIter):
+class DevicePrefetchIter(_ThreadedPrefetchTeardown, DataIter):
     """Async *device*-staging prefetcher: the second pipeline stage on top
     of :class:`PrefetchingIter`'s host double-buffer.
 
@@ -594,18 +601,6 @@ class DevicePrefetchIter(DataIter):
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
-    def _drain(self, capture_error=False):
-        pending = None
-        try:
-            while True:
-                item = self._queue.get_nowait()
-                if capture_error and pending is None and \
-                        isinstance(item, Exception):
-                    pending = item
-        except queue.Empty:
-            pass
-        return pending
-
     def reset(self):
         # same protocol as PrefetchingIter.reset: stop, drain so a worker
         # blocked on the full queue can exit, join, drain the batch it
@@ -661,34 +656,6 @@ class DevicePrefetchIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
-
-    def close(self, timeout=5):
-        """Stop the staging thread WITHOUT restarting it (``reset`` is
-        stop-then-restart): signal stop, drain so a worker blocked on
-        the full queue can exit, join with ``timeout``, and RE-RAISE any
-        worker exception still pending in the queue — an error the
-        consumer never observed must not vanish on teardown.  After
-        ``close`` the iterator reports exhaustion until ``reset``; the
-        inner iterators are left untouched for the caller to reuse."""
-        self._stop.set()
-        pending = self._drain(capture_error=True)
-        t = self._thread
-        if t is not None:
-            t.join(timeout=timeout)
-            if t.is_alive():
-                import logging
-
-                logging.warning("DevicePrefetchIter staging worker did "
-                                "not exit within %ss on close()", timeout)
-            self._thread = None
-        pending = pending or self._drain(capture_error=True)
-        self._exhausted = True
-        if pending is not None and pending is not self._worker_error:
-            self._worker_error = pending
-            raise pending
-
-    def __del__(self):
-        self._stop.set()
 
 
 def prefetch_to_device(iters, prefetch_depth=2, mesh=None, context=None,

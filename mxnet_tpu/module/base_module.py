@@ -430,7 +430,13 @@ class BaseModule:
                 nbatch = resume_nbatch if epoch == begin_epoch else 0
                 data_iter = iter(fit_data)
                 end_of_batch = False
-                next_data_batch = next(data_iter)
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    # a resume checkpoint taken right after an epoch's
+                    # final batch fast-forwards past the whole epoch;
+                    # run the epoch tail and move on
+                    end_of_batch = True
                 while not end_of_batch:
                     data_batch = next_data_batch
                     if watchdog is not None:

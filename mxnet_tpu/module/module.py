@@ -711,13 +711,17 @@ class Module(BaseModule):
         factor = jnp.asarray(1.0, "float32")
         if getattr(o, "clip_global_norm", None):
             factor = opt.global_norm_scale(gnorm, o.clip_global_norm)
-        if getattr(self, "_health_monitor", None) is not None:
-            factor = jnp.where(finite, factor, 0.0)
+        zero_bad = getattr(self, "_health_monitor", None) is not None
+        if zero_bad:
             self._last_health_stats = {"grad_norm": gnorm,
                                        "nonfinite": ~finite}
         for n in names:
-            g = grads[n]
-            self._exec.grad_dict[n]._set_data(g * factor.astype(g.dtype))
+            g = grads[n] * factor.astype(grads[n].dtype)
+            if zero_bad:
+                # 0 * NaN is NaN — a multiplicative skip would leak the
+                # poison into the optimizer state, so select instead
+                g = jnp.where(finite, g, jnp.zeros_like(g))
+            self._exec.grad_dict[n]._set_data(g)
 
     def _async_params(self):
         # aux states (BN moving stats) average too — per-shard moving

@@ -73,7 +73,9 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    # psum of a constant folds to the static axis size on every jax
+    # version; lax.axis_size only exists on newer releases
+    n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     t_local = q.shape[-2]
     d = q.shape[-1]

@@ -226,6 +226,29 @@ def test_preemption_mid_epoch_checkpoint_and_resume(tmp_path):
         np.testing.assert_allclose(ref[k], res[k], rtol=1e-6, atol=1e-7)
 
 
+def test_preemption_at_epoch_boundary_resume(tmp_path):
+    """SIGTERM on the epoch's LAST batch checkpoints nbatch == the full
+    epoch; resume must fast-forward past the whole epoch and start the
+    next one instead of dying on the first ``next()`` (StopIteration)."""
+    X, y = _data()  # 64 samples / batch 8 = 8 batches per epoch
+    ref = _params(_fit(2, X, y))
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+
+    count = [0]
+
+    def kill_self_at_8(param):
+        count[0] += 1
+        if count[0] == 8:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(mx.TrainingPreempted) as ei:
+        _fit(2, X, y, batch_cb=kill_self_at_8, checkpoint=mgr)
+    assert (ei.value.epoch, ei.value.nbatch) == (0, 8)
+    res = _params(_fit(2, X, y, resume_from=mgr))
+    for k in ref:
+        np.testing.assert_allclose(ref[k], res[k], rtol=1e-6, atol=1e-7)
+
+
 def test_kill_term_subprocess_and_resume(tmp_path):
     """Acceptance criterion: a real ``kill -TERM`` mid-fit leaves a
     loadable checkpoint, and ``fit(resume_from=...)`` reproduces the

@@ -321,6 +321,49 @@ def test_monitor_realizes_scan_stacked_stats():
     assert mon.observed == 2 and mon.total_skips == 1
 
 
+def test_monitor_realize_split_stats_without_loss():
+    """Split-path stats carry no loss: a missing stat must be treated
+    as unmeasured (None), not NaN — otherwise every healthy step counts
+    as a non-finite skip and the run falsely diverges."""
+    mon = _quiet_monitor(policy="skip")
+    clean = {"grad_norm": np.float32(1.0), "nonfinite": np.asarray(False)}
+    for step in range(3 * mon.max_skips):  # far past the skip budget
+        assert mon.tick(dict(clean), step=step) == "ok"
+    assert mon.total_skips == 0
+    # a genuinely bad split-path step still classifies as a skip
+    bad = {"grad_norm": np.float32("nan"), "nonfinite": np.asarray(True)}
+    assert mon.tick(bad, step=99) == "skip"
+
+
+def test_split_health_pass_zeroes_nonfinite_grads(monkeypatch):
+    """The split-path skip must select-zero poisoned gradients: a
+    multiplicative 0 * NaN skip would leak NaN into the optimizer."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")  # force the split path
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1},
+                       kvstore=None, health="skip")
+    mod.forward_backward(next(iter(it)))
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    g = mod._exec.grad_dict["fc1_weight"]
+    g._set_data(jnp.full(g.shape, jnp.nan, dtype=g._data.dtype))
+    mod.update()
+    after = mod.get_params()[0]
+    for name, arr in after.items():
+        got = arr.asnumpy()
+        assert np.isfinite(got).all(), name
+        np.testing.assert_array_equal(got, before[name], err_msg=name)
+    stats = {k: np.asarray(v)
+             for k, v in mod._last_health_stats.items()}
+    assert bool(stats["nonfinite"])
+
+
 def test_resolve_monitor_forms(monkeypatch):
     monkeypatch.delenv("MXNET_HEALTH_MONITOR", raising=False)
     assert health.resolve_monitor(None) is None
