@@ -34,6 +34,11 @@ import time
 
 sys.path.insert(0, ".")
 
+import bench_util
+
+# phase-by-phase partial result for the MXNET_BENCH_BUDGET_S emitter
+_RESULT = {"metric": "fit_images_per_sec"}
+
 
 def _flag_value(name, default):
     if name in sys.argv:
@@ -65,6 +70,10 @@ def measure_pure_step(sym, batch, feat, iters=60):
                      optimizer_params={"learning_rate": 0.01,
                                        "rescale_grad": 1.0 / batch})
     shapes = {"data": (batch, feat), "softmax_label": (batch,)}
+    # compile measured apart from the step rate (and served from the
+    # persistent cache on a repeat run)
+    bench_util.timed_compile(step, shapes, _RESULT,
+                             key="pure_step_compile_s")
     params, aux, states = step.init_state(shapes)
     rng = jax.random.PRNGKey(0)
     bd = {"data": jax.random.normal(rng, shapes["data"], "float32"),
@@ -111,8 +120,11 @@ def make_host_work_iter(base, repeats):
 
 def measure_fit(sym, X, y, batch, epochs, pipeline, steps_per_call,
                 metric_sync, host_work=0):
-    """img/s of the full Module.fit loop, timed over the epochs after the
-    first (epoch 0 absorbs bind/compile)."""
+    """img/s of the full Module.fit loop, timed over the epochs after
+    the first.  Compile no longer hides in epoch 0 — fit's AOT warmup
+    thread compiles before the epoch loop and the wall time lands in
+    ``compile_s`` (profiler.compile_events) — but epoch 0 stays excluded
+    so prefetch-ring and metric warmup don't skew the steady rate."""
     import mxnet_tpu as mx
 
     it = mx.io.NDArrayIter(X, y, batch_size=batch)
@@ -144,6 +156,7 @@ def main():
 
     import jax
 
+    bench_util.arm_budget(_RESULT)
     positional = [a for i, a in enumerate(sys.argv[1:], 1)
                   if not a.startswith("--")
                   and sys.argv[i - 1] not in ("--steps-per-call",
@@ -177,10 +190,15 @@ def main():
     host_ms = (time.perf_counter() - t0) * 1e3
 
     pure_s = measure_pure_step(sym, batch, feat)
+    _RESULT.update({
+        "pure_step_images_per_sec": round(pure_s, 2),
+        "pure_step_s": round(batch / pure_s, 6),
+    })
     fit_s = measure_fit(sym, X, y, batch, epochs, pipeline=True,
                         steps_per_call=steps_per_call,
                         metric_sync=metric_sync, host_work=host_work)
-    result = {
+    result = _RESULT
+    result.update({
         "metric": "fit_images_per_sec",
         "value": round(fit_s, 2),
         "unit": "img/s",
@@ -193,7 +211,7 @@ def main():
         "epochs_timed": epochs - 1,
         "batches_per_epoch": n_batches,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
-    }
+    })
     if "--skip-nopipe" not in sys.argv:
         nopipe_s = measure_fit(sym, X, y, batch, epochs, pipeline=False,
                                steps_per_call=1, metric_sync=1,
@@ -201,6 +219,9 @@ def main():
         result["fit_nopipeline_images_per_sec"] = round(nopipe_s, 2)
         result["nopipeline_efficiency"] = round(nopipe_s / pure_s, 4)
         result["pipeline_speedup"] = round(fit_s / nopipe_s, 4)
+    # compile_s/step_s split + cache counters (fit's AOT warmup and the
+    # pure-step AOT compile both record through profiler.compile_event)
+    result.update(bench_util.compile_summary())
     print(json.dumps(result))
 
 

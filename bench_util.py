@@ -1,0 +1,101 @@
+"""Shared bench-script plumbing: wall-clock budget + compile accounting.
+
+Every bench script prints ONE final JSON line on stdout.  Before this
+module existed, a harness timeout (rc 124) killed the process mid-phase
+and the artifact parsed as null — rounds 1-5 of BENCH/MULTICHIP all died
+that way.  ``arm_budget`` bounds the run from the inside instead:
+``MXNET_BENCH_BUDGET_S`` seconds after arming, the shared result dict —
+filled phase by phase by the script — is printed as the final stdout
+line (marked ``"partial": true``) and the process exits 0, so a budgeted
+run still produces a parseable artifact with whatever phases finished.
+
+``compile_summary`` splits compile time out of the measured rates: the
+scripts AOT-compile through ``TrainStep.compile``/``Module.fit`` warmup,
+so every XLA compile lands in ``mxnet_tpu.profiler.compile_events`` and
+the persistent-cache hit/miss counters (see docs/compilation.md).
+"""
+import json
+import os
+import sys
+import threading
+
+
+def budget_seconds():
+    """The configured bench budget (0 = unbounded)."""
+    for key in ("MXTPU_BENCH_BUDGET_S", "MXNET_BENCH_BUDGET_S"):
+        raw = os.environ.get(key)
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+    return 0.0
+
+
+def arm_budget(result, seconds=None):
+    """Arm the wall-clock budget for this bench process.
+
+    ``result`` is the script's shared phase-by-phase dict; on expiry it
+    is finalized with ``partial``/``budget_s`` plus the compile summary,
+    printed to stdout as the one JSON line, and the process exits 0 (a
+    budgeted run IS a successful run — it reports what finished).
+    Returns the armed Timer, or None when no budget is configured."""
+    if seconds is None:
+        seconds = budget_seconds()
+    if seconds <= 0:
+        return None
+
+    def fire():
+        result["partial"] = True
+        result["budget_s"] = seconds
+        try:
+            result.update(compile_summary())
+        except Exception:
+            pass
+        print(json.dumps(result), flush=True)
+        # stdout is line-buffered under pipes; make sure the line left
+        sys.stdout.flush()
+        os._exit(0)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def compile_summary():
+    """Process-wide compile accounting for the final result line:
+    total ``compile_s``, persistent-cache counters, and any callable
+    the recompile guard saw trace more than once."""
+    out = {}
+    try:
+        from mxnet_tpu import compile_cache, profiler
+
+        out["compile_s"] = round(profiler.total_compile_s(), 3)
+        cs = compile_cache.cache_stats()
+        out["compile_cache"] = {
+            k: cs[k] for k in ("enabled", "hits", "misses", "entries",
+                               "bytes")}
+        retraced = {name: snap["traces"]
+                    for name, snap in compile_cache.registry.report().items()
+                    if snap["traces"] > 1}
+        if retraced:
+            out["recompiles"] = retraced
+    except Exception as e:  # accounting must never sink the benchmark
+        out["compile_stats_error"] = str(e)[:160]
+    return out
+
+
+def timed_compile(step, shapes, result=None, key="compile_s"):
+    """AOT-compile ``step`` for ``shapes`` and return the compile wall
+    seconds (also accumulated into ``result[key]`` when given).  Falls
+    back to 0.0 when the step has no AOT form — the caller's first
+    dispatch then absorbs the (lazy) compile as before."""
+    try:
+        stats = step.compile(shapes)
+        dt = float(stats["duration_s"])
+    except Exception:
+        return 0.0
+    if result is not None:
+        result[key] = round(result.get(key, 0.0) + dt, 3)
+    return dt

@@ -23,9 +23,10 @@ if _os.environ.get("JAX_PLATFORMS") == "cpu":
         pass
 
 from . import base
+from . import compile_cache
 from . import attribute
 from .attribute import AttrScope
-from .base import MXNetError, TrainingPreempted
+from .base import MXNetError, TrainingPreempted, RecompileStorm
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context
 from . import random

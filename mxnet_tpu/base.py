@@ -13,7 +13,8 @@ import logging
 import os
 
 __all__ = ["MXNetError", "TrainingPreempted", "TrainingDiverged",
-           "StepHung", "get_env", "string_types", "numeric_types", "logger"]
+           "StepHung", "RecompileStorm", "get_env", "string_types",
+           "numeric_types", "logger"]
 
 logger = logging.getLogger("mxnet_tpu")
 
@@ -67,6 +68,23 @@ class StepHung(MXNetError):
         super().__init__(msg)
         self.note = note
         self.dump_path = dump_path
+
+
+class RecompileStorm(MXNetError):
+    """Raised (under ``MXNET_RECOMPILE_ERROR=1``) when one jitted
+    callable has been traced for more distinct input signatures than
+    ``MXNET_RECOMPILE_WARN`` allows: the classic silent performance
+    cliff where an uncommitted array, a python-scalar weak type, or a
+    drifting batch tail recompiles the whole program every step.
+    ``name`` is the registered owner, ``signatures`` the distinct count,
+    ``diff`` the leaf-level difference against the previous trace (see
+    ``mxnet_tpu.compile_cache`` and docs/compilation.md)."""
+
+    def __init__(self, msg, name=None, signatures=None, diff=None):
+        super().__init__(msg)
+        self.name = name
+        self.signatures = signatures
+        self.diff = diff
 
 
 string_types = (str,)

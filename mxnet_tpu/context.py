@@ -74,6 +74,12 @@ class Context:
         Context names a device of THIS worker)."""
         import jax
 
+        # first device lookup doubles as the lazy hook for the
+        # persistent compilation cache: anything about to jit resolves a
+        # device first, so the cache config lands before the first trace
+        from .compile_cache import ensure_initialized
+
+        ensure_initialized()
         kind = self.device_type
         if kind in ("cpu", "cpu_pinned"):
             devs = jax.local_devices(backend="cpu") if _has_platform("cpu") \

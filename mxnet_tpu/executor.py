@@ -90,6 +90,9 @@ class Executor:
     def __init__(self, sym, ctx, arg_dict, grad_dict, grad_req, aux_dict):
         import jax
 
+        from .compile_cache import ensure_initialized, registry
+
+        ensure_initialized()
         self._symbol = sym
         self._ctx = ctx
         self.arg_dict = arg_dict          # OrderedDict name -> NDArray
@@ -136,6 +139,13 @@ class Executor:
 
         self._jit_fwd_vjp = jax.jit(fwd_vjp)
         self._jit_bwd = jax.jit(bwd)
+        # one guard covers all four jits; reusing the name means a
+        # rebound/reshaped executor for the same symbol keeps
+        # accumulating into the same counter (Executor.reshape storms
+        # are exactly what the guard exists to surface)
+        self._recompile_guard = registry.guard(
+            "Executor(%s)" % (getattr(sym, "name", None) or "graph"))
+        self._seen_sigs = set()
         self._last_vjp = None  # (vjp Partial, new_aux dict)
         # graphs holding a mesh-spanning program (shard_map, e.g.
         # seq_parallel attention) need inputs replicated over the mesh
@@ -195,6 +205,15 @@ class Executor:
             # NaiveEngine synchronous debug mode in one — each op runs
             # and materializes before the next
             return self._forward_eager(args, aux, rng, is_train)
+        from .compile_cache import signature_of
+
+        mode = ("fwd_vjp" if is_train and self._grad_args
+                else "train" if is_train else "eval")
+        sig = ((".mode", mode),) + signature_of(args, aux)
+        # a freshly (re)bound executor retraces even for a signature the
+        # guard has seen before (jits are per-instance) — force-count it
+        self._recompile_guard.observe(sig, force=sig not in self._seen_sigs)
+        self._seen_sigs.add(sig)
         if is_train and self._grad_args:
             # release the previous step's residuals before the new forward
             # (holding them would double peak activation memory)

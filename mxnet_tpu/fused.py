@@ -46,6 +46,7 @@ Extra TPU-first knobs the reference exposes differently:
 from __future__ import annotations
 
 from .base import MXNetError
+from .compile_cache import signature_of as _signature_of
 
 __all__ = ["compile_train_step", "TrainStep"]
 
@@ -98,8 +99,13 @@ class TrainStep:
 
         from .executor import _trace_fn
         from . import optimizer as opt_mod
+        from .compile_cache import ensure_initialized, registry
         from .health import StepHealth
 
+        # first jit owner in the hot path: wire the persistent XLA cache
+        # before anything lowers, so this process's compiles are
+        # reusable by the next one
+        ensure_initialized()
         self.symbol = symbol
         self._fwd_fn, self._arg_names, self._aux_names = _trace_fn(
             symbol, is_train=True)
@@ -342,6 +348,16 @@ class TrainStep:
         else:
             self._jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
         self._t = 0
+        # recompile guardrail: one guard per symbol name, shared across
+        # rebuilt instances so a per-batch reconstruction storm is
+        # visible as one counter
+        self._recompile_guard = registry.guard(
+            "TrainStep(%s)" % (getattr(symbol, "name", None) or "graph"))
+        # AOT state (compile()): the ready executable, its input
+        # signature, and the recorded stats
+        self._aot = None
+        self._aot_sig = None
+        self.compile_stats = None
 
     def _build_jit(self, pshard=None, sshard=None):
         """jit the step with parameter/state shardings resolved.
@@ -413,6 +429,99 @@ class TrainStep:
         self._in_sshard = sshard
         return self._build_jit(pshard, sshard)
 
+    def _abstract_inputs(self, shapes, dtype="float32"):
+        """Abstract (params, aux, states, batch, rng, lr, t[, hstate])
+        matching what ``__call__`` dispatches for per-step ``shapes``:
+        parameter/aux avals from the shape-inference pass, optimizer
+        states via ``eval_shape``, the super-batch leading K axis when
+        ``steps_per_call > 1``, a concrete rng key, the python-float lr
+        (weak type, exactly like the live call), and the int32 step."""
+        import jax
+        import jax.numpy as jnp
+
+        from .symbol.symbol import _infer_param_shapes
+
+        shapes = {k: tuple(v) for k, v in dict(shapes).items()}
+        all_shapes = _infer_param_shapes(self.symbol, dict(shapes))
+        S = jax.ShapeDtypeStruct
+        params = {n: S(tuple(all_shapes[n]), jnp.dtype(dtype))
+                  for n in self.param_names}
+        aux = {n: S(tuple(all_shapes[n]), jnp.dtype("float32"))
+               for n in self._aux_names}
+        states = {n: jax.eval_shape(self.optimizer.init_fused_state,
+                                    params[n])
+                  for n in self.param_names}
+        K = self._steps_per_call
+        batch = {}
+        for n in self.data_names + self.label_names:
+            if n not in shapes:
+                raise MXNetError("compile(shapes) is missing a shape "
+                                 "for input %r" % n)
+            shp = ((K,) + shapes[n]) if K > 1 else shapes[n]
+            batch[n] = S(shp, jnp.dtype("float32"))
+        args = (params, aux, states, batch, jax.random.PRNGKey(0),
+                float(self.lr), jnp.asarray(1, "int32"))
+        if self._health is not None:
+            args = args + (self._init_hstate(),)
+        return args
+
+    def compile(self, shapes, dtype="float32"):
+        """AOT warmup: lower and compile the step for ``shapes`` NOW.
+
+        ``shapes`` maps each data/label name to its per-step shape (the
+        same dict ``init_state`` takes); the leading ``steps_per_call``
+        axis is added internally.  The resulting executable is kept and
+        used directly by ``__call__`` whenever the live inputs match the
+        compiled signature, so the first training step pays zero
+        compile; a mismatch falls back to the lazily-jitted path (which
+        still hits the persistent cache).  Compile wall time, FLOPs, and
+        executable size are recorded as a profiler compile event and
+        returned (also kept on ``self.compile_stats``)."""
+        import time
+
+        from . import profiler
+        from .compile_cache import cache_stats
+
+        if self._jit_step is None:
+            raise MXNetError(
+                "AOT compile is unavailable with shape-dependent "
+                "param_sharding=%r: the sharded jit resolves against "
+                "concrete parameters on the first call"
+                % (self._param_sharding,))
+        args = self._abstract_inputs(shapes, dtype=dtype)
+        hits_before = cache_stats()["hits"]
+        t0 = time.perf_counter()
+        lowered = self._jit_step.lower(*args)
+        lower_s = time.perf_counter() - t0
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        flops = None
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, list) else ca
+            flops = float(ca.get("flops", 0.0)) or None
+        except Exception:
+            pass
+        exe_bytes = None
+        try:
+            mem = compiled.memory_analysis()
+            exe_bytes = int(getattr(mem, "generated_code_size_in_bytes",
+                                    0)) or None
+        except Exception:
+            pass
+        cache_hit = cache_stats()["hits"] > hits_before
+        sig = _signature_of(*args)
+        self._aot = compiled
+        self._aot_sig = sig
+        # seed the guard so the first matching live call is not counted
+        # as a second trace
+        self._recompile_guard.observe(sig)
+        self.compile_stats = profiler.compile_event(
+            self._recompile_guard.name, compile_s, flops=flops,
+            executable_bytes=exe_bytes, cache_hit=cache_hit,
+            lower_s=round(lower_s, 6), aot=True)
+        return self.compile_stats
+
     def __call__(self, params, aux, states, batch, rng, lr=None, t=None):
         import jax
         import jax.numpy as jnp
@@ -453,12 +562,31 @@ class TrainStep:
         lr = self.lr if lr is None else lr
         t = jnp.asarray(t, "int32")
         if self._health is None:
-            return self._jit_step(params, aux, states, batch, rng, lr, t)
-        if self._hstate is None:
-            self._hstate = self._init_hstate()
+            call_args = (params, aux, states, batch, rng, lr, t)
+        else:
+            if self._hstate is None:
+                self._hstate = self._init_hstate()
+            call_args = (params, aux, states, batch, rng, lr, t,
+                         self._hstate)
+        sig = _signature_of(*call_args)
+        self._recompile_guard.observe(sig)
+        out = None
+        if self._aot is not None and sig == self._aot_sig:
+            try:
+                out = self._aot(*call_args)
+            except Exception:
+                # Compiled executables validate avals/shardings before
+                # running (donation has not happened yet), so falling
+                # back to the lazy jit is safe; drop the AOT executable
+                # for good rather than re-failing every step.
+                self._aot = None
+                out = None
+        if out is None:
+            out = self._jit_step(*call_args)
+        if self._health is None:
+            return out
         (params, aux, states, outs, self._hstate,
-         self.last_health) = self._jit_step(
-            params, aux, states, batch, rng, lr, t, self._hstate)
+         self.last_health) = out
         return params, aux, states, outs
 
     def _init_hstate(self):

@@ -328,6 +328,27 @@ class BaseModule:
             self._fast_forward_data(train_data, resume_state.epoch,
                                     resume_state.nbatch)
 
+        # AOT warmup: lower+compile the fused step in the background so
+        # XLA compilation overlaps the prefetch-iterator spin-up below
+        # instead of landing serially inside the first step
+        # (MXNET_AOT_WARMUP=0 restores the lazy first-call compile)
+        compile_thread = None
+        if get_env("MXNET_AOT_WARMUP", True, bool) and \
+                hasattr(self, "prepare_compiled"):
+            import threading
+
+            def _warmup():
+                try:
+                    self.prepare_compiled()
+                except Exception as e:
+                    # warmup is an optimization: the lazy path compiles
+                    # on the first step exactly as before
+                    self.logger.debug("AOT warmup unavailable: %s", e)
+
+            compile_thread = threading.Thread(
+                target=_warmup, name="mxtpu-aot-compile", daemon=True)
+            compile_thread.start()
+
         # wrap AFTER init_optimizer: staging placement follows the mesh
         # the optimizer decided on (kvstore type → mesh)
         pipeline = prefetch_to_device
@@ -364,6 +385,13 @@ class BaseModule:
                 timeout,
                 stats_cb=hmon.snapshot if hmon is not None else None)
             watchdog.start()
+
+        if compile_thread is not None:
+            # the first step needs the compiled executable anyway; a
+            # bounded join keeps a wedged compile from hanging fit
+            # silently (the watchdog covers the in-step hang case)
+            compile_thread.join(
+                get_env("MXNET_AOT_WARMUP_TIMEOUT_S", 600.0, float))
 
         try:
             self._fit_epochs(fit_data, eval_data, eval_metric,

@@ -157,6 +157,12 @@ class BucketingModule(BaseModule):
         the default module's parameters if unseen."""
         assert self.binded, "call bind before switching bucket"
         if bucket_key not in self._buckets:
+            from ..compile_cache import registry
+
+            # every unseen bucket binds (and compiles) a fresh executor:
+            # exactly the per-shape retrace the recompile guard counts
+            registry.guard("BucketingModule").observe(
+                ((".bucket", (repr(bucket_key)[:120],)),), force=True)
             symbol, data_names, label_names = self._call_sym_gen(bucket_key)
             module = Module(symbol, data_names=data_names,
                             label_names=label_names, logger=self.logger,

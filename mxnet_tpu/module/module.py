@@ -536,6 +536,31 @@ class Module(BaseModule):
                 states[n] = o.init_fused_state(self._exec.arg_dict[n]._data)
         return states
 
+    def prepare_compiled(self, dtype="float32"):
+        """AOT warmup: lower-and-compile the fused train step for the
+        bound shapes NOW instead of inside the first ``forward_backward``
+        (``Module.fit`` runs this in a background thread that overlaps
+        ``DevicePrefetchIter`` spin-up; see docs/compilation.md).
+
+        Returns the compile stats dict (also on
+        ``self._fused.compile_stats``), or None when no AOT-compilable
+        fused step exists (split path, pipeline step, or shape-dependent
+        sharding) — those paths keep their lazy first-call compile."""
+        assert self.binded, "call bind before prepare_compiled"
+        fused = getattr(self, "_fused", None)
+        if fused is None or not hasattr(fused, "compile") or \
+                getattr(fused, "_jit_step", None) is None:
+            return None
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        shapes.update({l.name: l.shape
+                       for l in (self._label_shapes or [])})
+        stats = fused.compile(shapes, dtype=dtype)
+        self.logger.debug("AOT compile %s: %.2fs%s", stats.get("name"),
+                          stats.get("duration_s", 0.0),
+                          " (persistent-cache hit)"
+                          if stats.get("cache_hit") else "")
+        return stats
+
     def _fused_forward_backward_update(self, data_batch):
         import jax.numpy as jnp
 

@@ -9,10 +9,18 @@ XLA program per (shapes, dtypes, train-mode) key — the same inversion as
 dispatch.  Under ``autograd.record()`` the invocation lands on the tape
 as ONE entry whose replay is the traced graph function, so
 ``autograd.backward`` differentiates through it exactly.
+
+The per-signature jit cache is LRU-bounded by
+``MXNET_CACHED_OP_CACHE_SIZE`` (default 32) and registered with the
+process-wide recompile registry (``mxnet_tpu.compile_cache``): a
+CachedOp fed drifting shapes warns past ``MXNET_RECOMPILE_WARN``
+distinct signatures instead of silently recompiling forever.
 """
 from __future__ import annotations
 
-from ..base import MXNetError
+from collections import OrderedDict
+
+from ..base import MXNetError, get_env
 
 __all__ = ["CachedOp"]
 
@@ -44,10 +52,18 @@ class CachedOp:
     aux NDArrays, mirroring the reference's mutable-input contract."""
 
     def __init__(self, sym):
+        from ..compile_cache import ensure_initialized, registry
+
+        ensure_initialized()
         self._sym = sym
         self._arg_names = list(sym.list_arguments())
         self._aux_names = list(sym.list_auxiliary_states())
-        self._jit_cache = {}
+        # LRU-bounded: one jit per (train-mode, shapes, dtypes) signature
+        self._jit_cache = OrderedDict()
+        self._jit_cache_size = max(
+            1, get_env("MXNET_CACHED_OP_CACHE_SIZE", 32, int))
+        self._recompile_guard = registry.guard(
+            "CachedOp(%s)" % (getattr(sym, "name", None) or "graph"))
         self._trace_cache = {}
 
     @property
@@ -104,6 +120,10 @@ class CachedOp:
 
         key = (is_train,) + tuple(
             (tuple(x.shape), str(x.dtype)) for x in nds)
+        names = self._arg_names + self._aux_names
+        sig = ((".train", (is_train,)),) + tuple(
+            (names[i], (tuple(x.shape), str(x.dtype), False))
+            for i, x in enumerate(nds))
         if key not in self._jit_cache:
             fn = self._traced(is_train)
 
@@ -112,7 +132,15 @@ class CachedOp:
                 aux_d = dict(zip(self._aux_names, aux_bufs))
                 return fn(args_d, aux_d, k)
 
+            # force=True: a rebuild after LRU eviction re-traces even
+            # though the guard has seen this signature before
+            self._recompile_guard.observe(sig, force=True)
             self._jit_cache[key] = jax.jit(run)
+            while len(self._jit_cache) > self._jit_cache_size:
+                self._jit_cache.popitem(last=False)
+        else:
+            self._jit_cache.move_to_end(key)
+            self._recompile_guard.observe(sig)
         outs, new_aux = self._jit_cache[key](
             [x._data for x in arg_nds], [x._data for x in aux_nds], rng)
         # reference FMutateInputs contract: aux inputs are updated

@@ -14,6 +14,7 @@ from __future__ import annotations
 import functools
 
 from ..base import MXNetError
+from ..compile_cache import track_lru
 
 __all__ = ["allreduce_nd", "psum", "all_gather", "ppermute",
            "reduce_scatter"]
@@ -49,6 +50,7 @@ def reduce_scatter(x, axis_name, scatter_dimension=0):
 
 # -- imperative-boundary allreduce (KVStore push path) ---------------------
 
+@track_lru("parallel._stacked_sum")
 @functools.lru_cache(maxsize=8)
 def _stacked_sum(mesh):
     """Per-mesh cached executable summing stacked partial gradients to a

@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 
 from ..base import MXNetError
+from ..compile_cache import track_lru
 from .mesh import current_mesh
 
 __all__ = ["moe_ffn", "routed_moe_ffn"]
@@ -50,6 +51,7 @@ def moe_ffn(x, gate_w, w1, w2, top_k=None, mesh=None, axis="expert"):
     return _moe_fn(mesh, axis, top_k)(x, gate_w, w1, w2)
 
 
+@track_lru("parallel._moe_fn")
 @functools.lru_cache(maxsize=32)
 def _moe_fn(mesh, axis, top_k):
     import jax
@@ -225,6 +227,7 @@ def _routed_body(x, gate_w, w1_local, w2_local, top_k, capacity, n_dev,
     return out, aux.astype(jnp.float32)
 
 
+@track_lru("parallel._routed_local_fn")
 @functools.lru_cache(maxsize=32)
 def _routed_local_fn(top_k, capacity):
     import jax
@@ -235,6 +238,7 @@ def _routed_local_fn(top_k, capacity):
     return jax.jit(fn)
 
 
+@track_lru("parallel._routed_fn")
 @functools.lru_cache(maxsize=32)
 def _routed_fn(mesh, axis, top_k, capacity):
     import jax

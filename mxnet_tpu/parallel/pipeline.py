@@ -44,6 +44,7 @@ from __future__ import annotations
 import functools
 
 from ..base import MXNetError
+from ..compile_cache import track_lru
 from .mesh import current_mesh
 
 __all__ = ["pipeline_apply", "split_symbol", "PipelineTrainStep"]
@@ -82,6 +83,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh=None,
         stage_params, microbatches)
 
 
+@track_lru("parallel._pipeline_fn")
 @functools.lru_cache(maxsize=32)
 def _pipeline_fn(mesh, axis, stage_fn, params_treedef):
     import jax

@@ -27,6 +27,7 @@ from __future__ import annotations
 import functools
 
 from ..base import MXNetError
+from ..compile_cache import track_lru
 from .mesh import current_mesh
 
 __all__ = ["ring_attention", "sequence_parallel_attention"]
@@ -139,6 +140,7 @@ def sequence_parallel_attention(q, k, v, causal=False, mesh=None,
     return _sp_attention_fn(mesh, axis, causal)(q, k, v)
 
 
+@track_lru("parallel._sp_attention_fn")
 @functools.lru_cache(maxsize=32)
 def _sp_attention_fn(mesh, axis, causal):
     """Cached jitted shard_map program per (mesh, axis, causal): jit

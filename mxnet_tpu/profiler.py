@@ -12,6 +12,14 @@ API surface: ``profiler_set_config(filename=...)``,
 ``profiler_set_state('run'|'stop')`` (aliases ``set_config``/
 ``set_state``), ``dump()``; env ``MXNET_PROFILER_AUTOSTART=1`` starts
 tracing at import (reference ``env_var.md`` autostart contract).
+
+Compile-time events are first-class here too: XLA compilation dominates
+time-to-first-step on this platform, so every AOT/JIT compile the
+framework performs is recorded via :func:`compile_event` (wall seconds,
+FLOPs estimate, executable size) and retrievable with
+:func:`compile_events` / summed with :func:`total_compile_s` — the
+numbers ``TrainStep.compile_stats`` and the bench scripts' ``compile_s``
+field surface (see docs/compilation.md).
 """
 from __future__ import annotations
 
@@ -20,14 +28,19 @@ import gzip
 import os
 import shutil
 import tempfile
+import threading
+import time as _time
 
 from .base import MXNetError, get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "set_config",
-           "set_state", "dump", "dump_profile", "state"]
+           "set_state", "dump", "dump_profile", "state",
+           "compile_event", "compile_events", "total_compile_s"]
 
 _config = {"filename": "profile.json", "profile_all": False}
 _state = {"running": False, "tmpdir": None, "dumped": False}
+_compile_events = []
+_compile_lock = threading.Lock()
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json", **kwargs):
@@ -88,6 +101,41 @@ def dump(finished=True):
 
 
 dump_profile = dump
+
+
+# -- compile-time events ----------------------------------------------------
+
+def compile_event(name, duration_s, flops=None, executable_bytes=None,
+                  cache_hit=None, **extra):
+    """Record one compilation: ``name`` identifies the callable (e.g.
+    ``TrainStep(softmax)``), ``duration_s`` the end-to-end lower+compile
+    wall time; ``flops`` (XLA cost analysis), ``executable_bytes``
+    (generated code size), and ``cache_hit`` (persistent-cache) are
+    best-effort.  Returns the recorded event dict."""
+    event = {"name": name, "duration_s": float(duration_s),
+             "time": _time.time()}
+    if flops is not None:
+        event["flops"] = float(flops)
+    if executable_bytes is not None:
+        event["executable_bytes"] = int(executable_bytes)
+    if cache_hit is not None:
+        event["cache_hit"] = bool(cache_hit)
+    event.update(extra)
+    with _compile_lock:
+        _compile_events.append(event)
+    return event
+
+
+def compile_events():
+    """All compile events recorded in this process (copies)."""
+    with _compile_lock:
+        return [dict(e) for e in _compile_events]
+
+
+def total_compile_s():
+    """Total wall seconds this process spent in recorded compilations."""
+    with _compile_lock:
+        return sum(e["duration_s"] for e in _compile_events)
 
 if get_env("MXNET_PROFILER_AUTOSTART", False, bool):
     profiler_set_state("run")

@@ -15,6 +15,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Keep the persistent compilation cache out of ~/.cache during tests:
+# each test session gets its own throwaway directory (tests that need a
+# specific dir — the round-trip subprocess tests — override per-process).
+if "MXNET_COMPILE_CACHE_DIR" not in os.environ and \
+        "MXTPU_COMPILE_CACHE_DIR" not in os.environ:
+    import tempfile as _tempfile
+
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = _tempfile.mkdtemp(
+        prefix="mxtpu_test_xla_cache_")
+
 import jax
 import numpy as np
 import pytest

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Pretty-print compile-report artifacts (docs/compilation.md).
+
+Usage::
+
+    python tools/compile_report.py <file-or-dir> [...]
+    python tools/compile_report.py       # scans $MXNET_HEALTH_DIR / tmpdir
+    python tools/compile_report.py --live   # report on THIS process's env
+
+Understands the JSON artifact ``mxnet_tpu.compile_cache.write_artifact``
+emits (``compile-report-<pid>-<time>.json``): persistent-cache counters,
+the recompile-guard registry, and every recorded compile event — enough
+to triage "why was this run slow" from the artifact alone (was compile
+time the problem, did the cache hit, did something retrace every step).
+
+Stdlib only (except ``--live``): this must run on the stripped
+coordinator image where the training venv is gone but the dump survived.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+ARTIFACT_KIND = "mxnet_tpu-compile-report"
+
+
+def _fmt_time(ts):
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+    except (TypeError, ValueError, OverflowError):
+        return repr(ts)
+
+
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return repr(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+
+
+def print_cache(cache, indent="  "):
+    print(indent + "persistent cache:")
+    if not cache:
+        print(indent + "  (no cache section recorded)")
+        return
+    if not cache.get("enabled"):
+        print(indent + "  disabled (MXNET_COMPILE_CACHE_DIR='')")
+        return
+    hits, misses = cache.get("hits", 0), cache.get("misses", 0)
+    total = hits + misses
+    print(indent + "  dir       %s" % cache.get("dir"))
+    print(indent + "  hits      %d / %d requests%s"
+          % (hits, total,
+             " (%.0f%%)" % (100.0 * hits / total) if total else ""))
+    print(indent + "  on disk   %d entries, %s (cap %s)"
+          % (cache.get("entries", 0), _fmt_bytes(cache.get("bytes", 0)),
+             _fmt_bytes(cache.get("max_bytes", 0))))
+    if cache.get("evictions"):
+        print(indent + "  evicted   %d entries, %s"
+              % (cache["evictions"], _fmt_bytes(cache.get("evicted_bytes",
+                                                          0))))
+
+
+def print_recompiles(recompiles, indent="  "):
+    print(indent + "recompile guards (retrace-heaviest first):")
+    if not recompiles:
+        print(indent + "  (no jitted callables registered)")
+        return
+    for name, snap in recompiles.items():
+        traces = snap.get("traces", 0)
+        calls = snap.get("calls", 0)
+        sigs = snap.get("signatures", 0)
+        flag = ""
+        if traces > 3:
+            flag = "  <-- RETRACE STORM (see docs/compilation.md)"
+        elif traces > 1:
+            flag = "  <-- retraced"
+        print(indent + "  %-40s %d traces / %d sigs / %d calls%s"
+              % (name, traces, sigs, calls, flag))
+
+
+def print_compile_events(events, indent="  "):
+    print(indent + "compile events:")
+    if not events:
+        print(indent + "  (none recorded)")
+        return
+    total = 0.0
+    for e in events:
+        total += float(e.get("duration_s", 0.0))
+        extras = []
+        if e.get("flops"):
+            extras.append("%.2e flops" % e["flops"])
+        if e.get("executable_bytes"):
+            extras.append(_fmt_bytes(e["executable_bytes"]))
+        if e.get("cache_hit"):
+            extras.append("persistent-cache HIT")
+        print(indent + "  %-40s %7.2fs  %s"
+              % (e.get("name", "?"), float(e.get("duration_s", 0.0)),
+                 ", ".join(extras)))
+    print(indent + "  total compile wall time: %.2fs" % total)
+
+
+def print_report(path, payload):
+    print("=" * 72)
+    print("COMPILE REPORT  %s" % path)
+    print("  pid %s at %s" % (payload.get("pid", "?"),
+                              _fmt_time(payload.get("time"))))
+    print_cache(payload.get("cache"))
+    print_recompiles(payload.get("recompiles"))
+    print_compile_events(payload.get("compile_events"))
+
+
+def report_file(path):
+    """Returns True when the file was a recognized artifact."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        print("%s: unreadable (%s)" % (path, e), file=sys.stderr)
+        return False
+    if not isinstance(payload, dict) or \
+            payload.get("kind") != ARTIFACT_KIND:
+        return False
+    print_report(path, payload)
+    return True
+
+
+def gather(target):
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target,
+                                             "compile-report-*.json")))
+    return [target]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pretty-print mxnet_tpu compile reports")
+    ap.add_argument("paths", nargs="*",
+                    help="artifact files or directories to scan "
+                         "(default: $MXNET_HEALTH_DIR, else the tmpdir)")
+    ap.add_argument("--live", action="store_true",
+                    help="report on the current environment instead of "
+                         "an artifact (imports mxnet_tpu)")
+    args = ap.parse_args(argv)
+    if args.live:
+        from mxnet_tpu import compile_cache
+
+        compile_cache.ensure_initialized()
+        print_report("(live)", compile_cache.report())
+        return 0
+    targets = args.paths or [os.environ.get("MXNET_HEALTH_DIR")
+                             or tempfile.gettempdir()]
+    shown = 0
+    for target in targets:
+        files = gather(target)
+        if not files:
+            print("%s: no compile-report artifacts" % target,
+                  file=sys.stderr)
+        for path in files:
+            shown += report_file(path)
+    if not shown:
+        print("nothing recognized — expected compile-report-*.json "
+              "(write one with mxnet_tpu.compile_cache.write_artifact; "
+              "see docs/compilation.md)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
