@@ -231,11 +231,10 @@ def _measure_piped(step, shapes, batch, iters=20, threads=8):
 
 
 def main():
-    import jax
-
-    from mxnet_tpu.models import resnet
-    from mxnet_tpu.fused import TrainStep
-
+    # watchdog + budget timer arm BEFORE the first jax import: backend
+    # init can hang (driver handshake, stale TPU lockfile) and a bench
+    # that dies with rc=124 and no JSON is useless to the driver — armed
+    # here, a hung init still emits valid partial JSON and exits 0
     argv = sys.argv[1:]
     watchdog_s = None
     if "--watchdog" in argv:
@@ -247,6 +246,11 @@ def main():
     if watchdog_s > 0:
         _arm_watchdog(watchdog_s)
     bench_util.arm_budget(_RESULT)
+
+    import jax
+
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.fused import TrainStep
 
     args = [a for a in argv if not a.startswith("--")]
     fp32 = "--fp32" in sys.argv

@@ -49,7 +49,13 @@ def arm_budget(result, seconds=None):
         result["partial"] = True
         result["budget_s"] = seconds
         try:
-            result.update(compile_summary())
+            # only read compile stats when the modules finished importing:
+            # the budget now arms BEFORE the first jax touch, and if the
+            # main thread hung inside `import jax` this thread would
+            # deadlock on the per-module import lock instead of emitting
+            if "mxnet_tpu.profiler" in sys.modules and \
+                    "mxnet_tpu.compile_cache" in sys.modules:
+                result.update(compile_summary())
         except Exception:
             pass
         print(json.dumps(result), flush=True)

@@ -1,40 +1,69 @@
-"""Fault-tolerant checkpointing: atomic writes, retention, resume state.
+"""Fault-tolerant checkpointing: sharded + checksummed snapshots, async
+off-critical-path writes, cross-topology restore.
 
 The reference's checkpoint surface (``model.py`` ``save_checkpoint`` /
 ``do_checkpoint`` callbacks) assumes the process survives the write; at
-pod scale workers are preempted mid-write, so this layer guarantees:
+pod scale workers are preempted mid-write, slices are reallocated to a
+different process count, and disks corrupt shards.  This layer
+guarantees:
 
-* **Atomicity** — every file (symbol json, params, optimizer states,
-  metadata) is written to a temp name and published with ``os.replace``;
-  a crash at any point leaves either the previous checkpoint or the new
-  one, never a torn file (:func:`atomic_replace`).
-* **Rank-0 writes + barrier** — under a dist kvstore only rank 0 touches
-  the filesystem, and every rank meets at ``kvstore.barrier()`` after the
-  write so no peer resumes against a half-published checkpoint.
+* **Atomicity** — every file is written to a temp name and published
+  with ``os.replace``; a crash at any point leaves either the previous
+  checkpoint or the new one, never a torn file (:func:`atomic_replace`).
+* **Sharded v2 format** — each host writes only the parameter shards it
+  owns (``prefix-NNNN.shard<R>.params`` + a per-rank sidecar recording
+  SHA-256/size/piece windows); rank 0 merges the sidecars into a
+  ``prefix-NNNN.manifest.json`` holding the GLOBAL shapes/dtypes, the
+  serialized ``PartitionSpec`` per parameter, and the step metadata.
+  The manifest is written LAST: its presence certifies the whole set.
+  ``MXNET_CKPT_FORMAT=1`` restores the legacy single-file layout.
+* **Verified loads + quarantine** — ``load()`` re-hashes every shard
+  (``MXNET_CKPT_VERIFY``, default on); a truncated or bit-flipped shard
+  quarantines the epoch (every file renamed ``*.corrupt``, excluded from
+  ``epochs()``/``latest()``/``resolve_resume``) and the load falls back
+  to the previous good epoch.  :meth:`CheckpointManager.fsck` (and
+  ``tools/ckpt_fsck.py``) audit a directory offline.
+* **Topology-elastic restore** — the manifest's global metadata lets
+  ``load()`` reassemble full arrays from any shard layout and reshard
+  them onto the CURRENT mesh via ``parallel.sharding`` (saved spec
+  filtered to the axes that still exist, or explicit
+  ``apply_rules``-style rules), so a run saved on N processes resumes
+  on M.
+* **Async writes** — ``MXNET_CKPT_ASYNC=1`` (or
+  ``CheckpointManager(async_writes=True)``): the device→host snapshot
+  happens on the calling thread, serialization + fsync happen in a
+  bounded ``mxtpu-ckpt-writer`` background thread (depth 1; a second
+  ``save()`` first joins the previous write).  Writer errors surface at
+  the next ``save()``/``flush()``; the preemption latch in ``Module.fit``
+  flushes before raising ``TrainingPreempted``.
+* **Rank-0 merge + barrier** — under a dist kvstore every rank writes
+  its own shards, meets at ``kvstore.barrier()``, rank 0 merges and
+  publishes the manifest, and a second barrier keeps any peer from
+  resuming against a half-published set.  (Async mode requires a
+  single-process store and falls back to synchronous writes otherwise.)
 * **Retention** — ``keep=N`` garbage-collects all but the newest N
-  epochs (params + states + metadata; the symbol file is shared and
-  kept).
-* **Resume metadata** — a ``-NNNN.meta.json`` sidecar records the epoch,
-  the mid-epoch batch offset of a preemption checkpoint, and the
-  optimizer ``num_update`` so ``Module.fit(resume_from=...)`` reproduces
-  the uninterrupted trajectory exactly (see ``docs/fault_tolerance.md``).
+  epochs, tolerating concurrently-deleted files, never collecting the
+  epoch a resume just loaded, and not counting quarantined epochs.
 
-File layout under ``prefix`` (reference filename contract preserved):
-``prefix-symbol.json``, ``prefix-NNNN.params``, ``prefix-NNNN.states``,
-``prefix-NNNN.meta.json``.  The epoch tag ``NNNN`` counts *completed*
-epochs; a preemption checkpoint taken mid-epoch E carries tag E with
-``nbatch > 0`` in its metadata.
+See ``docs/fault_tolerance.md`` for the on-disk format.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
+import threading
 
-from .base import MXNetError, logger
+from .base import MXNetError, get_env, logger
 
 __all__ = ["atomic_replace", "CheckpointManager", "CheckpointState",
-           "resolve_resume"]
+           "CorruptCheckpoint", "resolve_resume"]
+
+
+class CorruptCheckpoint(MXNetError):
+    """A checkpoint epoch failed checksum/coverage verification (it has
+    been quarantined on disk as ``*.corrupt``)."""
 
 
 def atomic_replace(path, write_cb):
@@ -64,11 +93,75 @@ def atomic_replace(path, write_cb):
     return path
 
 
+def _sha256_file(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _spec_of(data):
+    """Serialized PartitionSpec of a jax array's sharding (a list whose
+    entries are None, an axis name, or a list of axis names), or None
+    when the array carries no named sharding."""
+    spec = getattr(getattr(data, "sharding", None), "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(e) for e in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _index_windows(index, shape):
+    """``jax.Array`` shard index (tuple of slices) -> ``[[start, stop],
+    ...]`` per dimension, JSON-serializable."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, _ = sl.indices(int(dim))
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _host_pieces(arr, rank):
+    """(global_meta, owned_pieces) for one parameter on this rank.
+
+    Fully-addressable arrays (single process, or the replicated CPU rig)
+    are owned whole by rank 0; a genuinely multi-host ``jax.Array``
+    contributes its addressable shards with ``replica_id == 0``, each
+    tagged with its global index window so ANY topology can reassemble
+    the full array on load."""
+    import numpy as np
+
+    data = getattr(arr, "_data", arr)
+    shape = tuple(int(s) for s in getattr(data, "shape", ()))
+    meta = {"shape": list(shape),
+            "dtype": str(np.dtype(getattr(data, "dtype", "float32"))),
+            "spec": _spec_of(data)}
+    pieces = []
+    if getattr(data, "is_fully_addressable", True):
+        if rank == 0:
+            pieces.append((None, np.asarray(data)))
+    else:
+        for s in data.addressable_shards:
+            if s.replica_id != 0:
+                continue
+            pieces.append((_index_windows(s.index, shape),
+                           np.asarray(s.data)))
+    return meta, pieces
+
+
 class CheckpointState:
     """Everything ``fit(resume_from=...)`` needs to continue a run."""
 
     def __init__(self, epoch, nbatch, num_update, symbol, arg_params,
-                 aux_params, states_path=None, prefix=None):
+                 aux_params, states_path=None, prefix=None, manifest=None):
         self.epoch = int(epoch)          # completed epochs
         self.nbatch = int(nbatch)        # extra batches into epoch `epoch`
         self.num_update = int(num_update)
@@ -77,6 +170,7 @@ class CheckpointState:
         self.aux_params = aux_params
         self.states_path = states_path   # optimizer states file, or None
         self.prefix = prefix
+        self.manifest = manifest         # v2 manifest dict, or None (v1)
 
     def __repr__(self):
         return ("CheckpointState(epoch=%d, nbatch=%d, num_update=%d, "
@@ -85,15 +179,18 @@ class CheckpointState:
 
 
 class CheckpointManager:
-    """Atomic, rank-aware checkpoint store over a directory.
+    """Atomic, rank-aware, shard-verified checkpoint store over a
+    directory.
 
-    ``kvstore`` (optional) supplies rank/barrier semantics: rank 0 writes,
+    ``kvstore`` (optional) supplies rank/barrier semantics: every rank
+    writes its owned shards, rank 0 merges + publishes the manifest,
     everyone barriers.  ``keep=N`` retains only the newest N epochs.
     ``save_optimizer_states=False`` drops the states file (params-only
-    checkpoints, e.g. for export)."""
+    checkpoints, e.g. for export).  ``async_writes``/``verify`` override
+    ``MXNET_CKPT_ASYNC``/``MXNET_CKPT_VERIFY`` (None = read the env)."""
 
     def __init__(self, directory, prefix="model", keep=None, kvstore=None,
-                 save_optimizer_states=True):
+                 save_optimizer_states=True, async_writes=None, verify=None):
         if keep is not None and int(keep) < 1:
             raise MXNetError("CheckpointManager keep must be >= 1 or None "
                              "(got %r)" % (keep,))
@@ -102,6 +199,14 @@ class CheckpointManager:
         self.keep = None if keep is None else int(keep)
         self.kvstore = kvstore
         self.save_optimizer_states = save_optimizer_states
+        self.async_writes = bool(get_env("MXNET_CKPT_ASYNC", False, bool)) \
+            if async_writes is None else bool(async_writes)
+        self.verify = bool(get_env("MXNET_CKPT_VERIFY", True, bool)) \
+            if verify is None else bool(verify)
+        self._writer = None        # in-flight async write (depth 1)
+        self._writer_error = None  # surfaced at the next save()/flush()
+        self._warned_async_dist = False
+        self._pinned_epoch = None  # epoch a resume loaded; GC-exempt
 
     @property
     def prefix(self):
@@ -118,6 +223,16 @@ class CheckpointManager:
             return jax.process_index()
         return 0
 
+    def _num_workers(self):
+        if self.kvstore is not None:
+            return int(getattr(self.kvstore, "num_workers", 1) or 1)
+        if os.environ.get("MXNET_COORDINATOR") or \
+                os.environ.get("MXNET_NUM_WORKERS"):
+            import jax
+
+            return jax.process_count()
+        return 1
+
     def _barrier(self):
         kv = self.kvstore
         if kv is not None and getattr(kv, "_is_dist", False):
@@ -133,6 +248,18 @@ class CheckpointManager:
     def _meta_path(self, epoch):
         return "%s-%04d.meta.json" % (self.prefix, epoch)
 
+    def _manifest_path(self, epoch):
+        return "%s-%04d.manifest.json" % (self.prefix, epoch)
+
+    def _shard_path(self, epoch, rank):
+        return "%s-%04d.shard%d.params" % (self.prefix, epoch, rank)
+
+    def _sidecar_path(self, epoch, rank):
+        return "%s-%04d.shard%d.json" % (self.prefix, epoch, rank)
+
+    def _epoch_tag(self, epoch):
+        return "%s-%04d." % (self.prefix_name, epoch)
+
     # -- save -----------------------------------------------------------
     def save(self, module=None, epoch=0, nbatch=0, symbol=None,
              arg_params=None, aux_params=None):
@@ -140,9 +267,14 @@ class CheckpointManager:
         symbol and optimizer states are pulled from it) or explicit
         ``symbol``/``arg_params``/``aux_params``.  ``epoch`` counts
         completed epochs; ``nbatch > 0`` marks a mid-epoch preemption
-        point.  Rank 0 writes, every rank barriers; returns the epoch
-        tag."""
-        from . import model as model_mod
+        point.  Every rank writes its shards, rank 0 merges + publishes
+        the manifest, every rank barriers; returns the epoch tag.
+
+        With async writes on, only the device→host snapshot happens on
+        this thread; serialization and publish run on the
+        ``mxtpu-ckpt-writer`` thread.  A failure of the PREVIOUS async
+        write is raised here, before the new snapshot is taken."""
+        self._raise_writer_error()
 
         epoch = int(epoch)
         if module is not None:
@@ -154,6 +286,180 @@ class CheckpointManager:
             raise MXNetError("CheckpointManager.save needs a module or "
                              "explicit arg_params")
         aux_params = aux_params or {}
+
+        if int(get_env("MXNET_CKPT_FORMAT", 2, int)) < 2:
+            return self._save_v1(module, epoch, nbatch, symbol,
+                                 arg_params, aux_params)
+
+        os.makedirs(self.directory, exist_ok=True)
+        snap = self._snapshot(module, epoch, nbatch, symbol, arg_params,
+                              aux_params)
+        if self.async_writes and self._async_eligible():
+            self._join_writer()  # depth-1 bound: one write in flight
+            t = threading.Thread(target=self._commit_guarded, args=(snap,),
+                                 name="mxtpu-ckpt-writer", daemon=True)
+            self._writer = t
+            t.start()
+        else:
+            self._commit(snap)
+        return epoch
+
+    def _async_eligible(self):
+        """Async writes only without a dist store: the commit path
+        barriers, and a barrier from a background thread would race the
+        training step's own collectives."""
+        kv = self.kvstore
+        if kv is None or not getattr(kv, "_is_dist", False):
+            return True
+        if not self._warned_async_dist:
+            self._warned_async_dist = True
+            logger.warning(
+                "MXNET_CKPT_ASYNC requested under a distributed kvstore; "
+                "falling back to synchronous checkpoint writes (the "
+                "commit barrier cannot run off-thread)")
+        return False
+
+    def _snapshot(self, module, epoch, nbatch, symbol, arg_params,
+                  aux_params):
+        """Device→host snapshot, on the calling thread: after this
+        returns, the training loop may mutate params freely."""
+        rank = self._rank()
+        params_meta, pieces, piece_map = {}, {}, {}
+        for tag, params in (("arg", arg_params), ("aux", aux_params)):
+            for name, arr in params.items():
+                key = "%s:%s" % (tag, name)
+                meta, owned = _host_pieces(arr, rank)
+                params_meta[key] = meta
+                for i, (idx, data) in enumerate(owned):
+                    pkey = "%s/%d" % (key, i)
+                    pieces[pkey] = data
+                    piece_map[pkey] = {"param": key, "index": idx}
+        states = None
+        if rank == 0 and self.save_optimizer_states and \
+                module is not None and \
+                getattr(module, "optimizer_initialized", False):
+            states = self._states_blob(module)
+        opt = getattr(module, "_optimizer", None) \
+            if module is not None else None
+        return {"epoch": epoch, "nbatch": int(nbatch),
+                "num_update": int(getattr(opt, "num_update", 0) or 0),
+                "symbol_json": symbol.tojson() if symbol is not None
+                else None,
+                "rank": rank, "nproc": self._num_workers(),
+                "params_meta": params_meta, "pieces": pieces,
+                "piece_map": piece_map, "states": states}
+
+    def _states_blob(self, module):
+        """Optimizer states as bytes (the module API writes files, so
+        round-trip through a temp name; this is host-side pickling and
+        must run on the snapshot thread — it reads live device state)."""
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(prefix="mxtpu-states-",
+                                   dir=self.directory)
+        os.close(fd)
+        try:
+            module.save_optimizer_states(tmp)
+            with open(tmp, "rb") as f:
+                return f.read()
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def _commit_guarded(self, snap):
+        try:
+            self._commit(snap)
+        except BaseException as e:  # surfaced at the next save()/flush()
+            self._writer_error = e
+            logger.error("async checkpoint write for epoch %d failed: %s",
+                         snap["epoch"], e)
+
+    def _commit(self, snap):
+        """Serialize + publish one snapshot (writer thread under async).
+
+        Order matters: shards first, sidecars second, barrier, then rank
+        0 writes symbol/states and the manifest LAST — the manifest's
+        presence certifies the set, so a crash anywhere earlier leaves
+        the previous epoch as ``latest()``."""
+        import numpy as np
+
+        from .testing import faults
+
+        epoch = snap["epoch"]
+        if snap["pieces"]:
+            shard_path = self._shard_path(epoch, snap["rank"])
+            digest = {}
+
+            def _write(tmp):
+                with open(tmp, "wb") as f:
+                    np.savez(f, **snap["pieces"])
+                    f.flush()
+                    os.fsync(f.fileno())
+                digest["sha256"] = _sha256_file(tmp)
+                digest["bytes"] = os.path.getsize(tmp)
+                # worst crash point for the sharded writer: bytes down,
+                # shard not yet published
+                faults.inject("shard_write")
+                return tmp
+
+            atomic_replace(shard_path, _write)
+            # post-publish corruption hook: the harness may bit-flip or
+            # truncate the shard the verifier must then catch
+            faults.inject("checkpoint_corrupt", path=shard_path)
+            sidecar = {"rank": snap["rank"],
+                       "file": os.path.basename(shard_path),
+                       "sha256": digest["sha256"],
+                       "bytes": digest["bytes"],
+                       "pieces": snap["piece_map"]}
+            atomic_replace(self._sidecar_path(epoch, snap["rank"]),
+                           lambda tmp: _write_json(tmp, sidecar))
+        self._barrier()
+        if snap["rank"] == 0:
+            if snap["symbol_json"] is not None:
+                atomic_replace(
+                    "%s-symbol.json" % self.prefix,
+                    lambda tmp: _write_text(tmp, snap["symbol_json"]))
+            states_entry = None
+            if snap["states"] is not None:
+                spath = self._states_path(epoch)
+                atomic_replace(
+                    spath, lambda tmp: _write_bytes(tmp, snap["states"]))
+                states_entry = {
+                    "file": os.path.basename(spath),
+                    "sha256": hashlib.sha256(snap["states"]).hexdigest(),
+                    "bytes": len(snap["states"])}
+            manifest = {
+                "format": 2, "epoch": epoch, "nbatch": snap["nbatch"],
+                "num_update": snap["num_update"],
+                "have_states": states_entry is not None,
+                "num_processes": snap["nproc"],
+                "params": snap["params_meta"],
+                "shards": self._merge_sidecars(epoch),
+                "states": states_entry}
+            atomic_replace(self._manifest_path(epoch),
+                           lambda tmp: _write_json(tmp, manifest))
+            self._gc()
+        self._barrier()
+
+    def _merge_sidecars(self, epoch):
+        """Collect every rank's sidecar for ``epoch`` (shared-filesystem
+        contract, same as the v1 rank-0-writes protocol)."""
+        pat = re.compile(re.escape(self.prefix_name) +
+                         r"-%04d\.shard(\d+)\.json$" % epoch)
+        shards = []
+        for name in sorted(os.listdir(self.directory)):
+            if not pat.match(name):
+                continue
+            with open(os.path.join(self.directory, name)) as f:
+                shards.append(json.load(f))
+        return shards
+
+    # -- legacy v1 writes -----------------------------------------------
+    def _save_v1(self, module, epoch, nbatch, symbol, arg_params,
+                 aux_params):
+        from . import model as model_mod
 
         if self._rank() == 0:
             os.makedirs(self.directory, exist_ok=True)
@@ -179,11 +485,33 @@ class CheckpointManager:
         self._barrier()
         return epoch
 
+    # -- async plumbing -------------------------------------------------
+    def _join_writer(self):
+        t = self._writer
+        if t is not None and t is not threading.current_thread():
+            t.join()
+        self._writer = None
+
+    def _raise_writer_error(self):
+        self._join_writer()
+        err = self._writer_error
+        if err is not None:
+            self._writer_error = None
+            raise err
+
+    def flush(self):
+        """Join any in-flight async write and raise its error, if any.
+        The preemption latch calls this before the process exits."""
+        self._raise_writer_error()
+
     # -- discovery / load ----------------------------------------------
     def epochs(self):
-        """Sorted epoch tags that have a params file on disk."""
-        pat = re.compile(re.escape(self.prefix_name) + r"-(\d{4})\.params$")
-        found = []
+        """Sorted epoch tags that have a certified set on disk — a v2
+        manifest or a v1 params file.  Quarantined (``*.corrupt``)
+        epochs never appear here."""
+        pat = re.compile(re.escape(self.prefix_name) +
+                         r"-(\d{4})\.(params|manifest\.json)$")
+        found = set()
         try:
             names = os.listdir(self.directory)
         except OSError:
@@ -191,7 +519,7 @@ class CheckpointManager:
         for name in names:
             m = pat.match(name)
             if m:
-                found.append(int(m.group(1)))
+                found.add(int(m.group(1)))
         return sorted(found)
 
     def latest(self):
@@ -200,15 +528,88 @@ class CheckpointManager:
         eps = self.epochs()
         return eps[-1] if eps else None
 
-    def load(self, epoch=None):
-        """Load a checkpoint into a :class:`CheckpointState` (newest when
-        ``epoch`` is None)."""
-        if epoch is None:
-            epoch = self.latest()
-            if epoch is None:
-                raise MXNetError(
-                    "no checkpoint found under %r (prefix %r)"
-                    % (self.directory, self.prefix_name))
+    def load(self, epoch=None, mesh=None, sharding=None):
+        """Load a checkpoint into a :class:`CheckpointState`.
+
+        ``epoch=None`` loads the newest epoch, FALLING BACK past any
+        epoch that fails verification (the corrupt epoch is quarantined
+        on disk); an explicit ``epoch`` that fails verification is
+        quarantined and raises :class:`CorruptCheckpoint`.
+
+        Elastic restore: v2 params are reassembled into global arrays
+        and resharded onto ``mesh`` (default: the active
+        ``parallel.current_mesh()``) using the saved per-param
+        ``PartitionSpec`` filtered to the axes the mesh still has;
+        ``sharding`` overrides with a
+        :func:`~mxnet_tpu.parallel.sharding.param_sharding_rules` style
+        string or rule list applied through ``apply_rules``."""
+        self._join_writer()
+        if epoch is not None:
+            state = self._load_epoch(int(epoch), mesh, sharding)
+            self._pinned_epoch = state.epoch
+            return state
+        failures = []
+        for e in reversed(self.epochs()):
+            try:
+                state = self._load_epoch(e, mesh, sharding)
+                if failures:
+                    logger.warning(
+                        "checkpoint fallback: loaded epoch %d after "
+                        "quarantining %s", e,
+                        ", ".join("%d (%s)" % f for f in failures))
+                self._pinned_epoch = state.epoch
+                return state
+            except CorruptCheckpoint as err:
+                failures.append((e, str(err).splitlines()[0][:120]))
+                continue
+        if failures:
+            raise MXNetError(
+                "no loadable checkpoint under %r (prefix %r): every "
+                "candidate failed verification and was quarantined: %s"
+                % (self.directory, self.prefix_name,
+                   "; ".join("epoch %d: %s" % f for f in failures)))
+        raise MXNetError("no checkpoint found under %r (prefix %r)"
+                         % (self.directory, self.prefix_name))
+
+    def _load_epoch(self, epoch, mesh=None, sharding=None):
+        if not os.path.exists(self._manifest_path(epoch)):
+            return self._load_v1(epoch)
+        manifest = self._read_manifest(epoch)
+        if self.verify:
+            problems = self._verify_epoch(manifest)
+            if problems:
+                self._quarantine(epoch, problems)
+                raise CorruptCheckpoint(
+                    "checkpoint epoch %d under %r failed verification "
+                    "(quarantined as *.corrupt): %s"
+                    % (epoch, self.prefix, "; ".join(problems)))
+        arrays = self._assemble(manifest)
+        arg_params, aux_params = {}, {}
+        resolved_mesh, rule_shardings = self._restore_layout(
+            mesh, sharding, arrays)
+        for key, arr in arrays.items():
+            tag, name = key.split(":", 1)
+            nd = self._reshard(key, arr,
+                               (manifest["params"].get(key) or {})
+                               .get("spec"),
+                               resolved_mesh, rule_shardings.get(key))
+            (arg_params if tag == "arg" else aux_params)[name] = nd
+        symbol = None
+        symbol_file = "%s-symbol.json" % self.prefix
+        if os.path.exists(symbol_file):
+            from . import symbol as sym_mod
+
+            symbol = sym_mod.load(symbol_file)
+        states = self._states_path(epoch)
+        return CheckpointState(
+            epoch=manifest.get("epoch", epoch),
+            nbatch=manifest.get("nbatch", 0),
+            num_update=manifest.get("num_update", 0), symbol=symbol,
+            arg_params=arg_params, aux_params=aux_params,
+            states_path=states if os.path.exists(states) else None,
+            prefix=self.prefix, manifest=manifest)
+
+    def _load_v1(self, epoch):
         from . import model as model_mod
 
         symbol, arg_params, aux_params = model_mod.load_checkpoint(
@@ -222,7 +623,226 @@ class CheckpointManager:
             states_path=states if os.path.exists(states) else None,
             prefix=self.prefix)
 
+    def _read_manifest(self, epoch):
+        path = self._manifest_path(epoch)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            self._quarantine(epoch, ["unreadable manifest: %s" % e])
+            raise CorruptCheckpoint(
+                "checkpoint manifest %r is corrupt (epoch quarantined): %s"
+                % (path, e)) from e
+
+    # -- verification / quarantine --------------------------------------
+    def _verify_epoch(self, manifest):
+        """Checksum + coverage audit of one v2 epoch.  Returns a list of
+        problem strings (empty = healthy)."""
+        problems = []
+        blobs = list(manifest.get("shards") or [])
+        if manifest.get("states"):
+            blobs.append(manifest["states"])
+        for entry in blobs:
+            path = os.path.join(self.directory, entry["file"])
+            if not os.path.exists(path):
+                problems.append("missing file %s" % entry["file"])
+                continue
+            size = os.path.getsize(path)
+            if size != entry["bytes"]:
+                problems.append("%s truncated: %d bytes, manifest says %d"
+                                % (entry["file"], size, entry["bytes"]))
+                continue
+            if _sha256_file(path) != entry["sha256"]:
+                problems.append("%s checksum mismatch (bit rot or torn "
+                                "write)" % entry["file"])
+        # coverage: the pieces across all shards must tile each param
+        covered = {}
+        for shard in manifest.get("shards") or []:
+            for info in (shard.get("pieces") or {}).values():
+                key, idx = info["param"], info["index"]
+                meta = manifest["params"].get(key)
+                if meta is None:
+                    problems.append("shard piece for unknown param %r"
+                                    % key)
+                    continue
+                total = 1
+                for d in meta["shape"]:
+                    total *= int(d)
+                if idx is None:
+                    n = total
+                else:
+                    n = 1
+                    for start, stop in idx:
+                        n *= max(0, int(stop) - int(start))
+                covered[key] = covered.get(key, 0) + n
+        for key, meta in (manifest.get("params") or {}).items():
+            total = 1
+            for d in meta["shape"]:
+                total *= int(d)
+            if covered.get(key, 0) < total:
+                problems.append(
+                    "param %s incomplete: %d of %d elements present"
+                    % (key, covered.get(key, 0), total))
+        return problems
+
+    def _quarantine(self, epoch, problems):
+        """Rename every file of ``epoch`` to ``*.corrupt`` so discovery
+        (and retention GC) never touches it again; the shared symbol
+        file stays.  Best-effort: a concurrently-deleted file is fine."""
+        tag = self._epoch_tag(epoch)
+        moved = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            names = []
+        for name in names:
+            if not name.startswith(tag) or name.endswith(".corrupt"):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                os.replace(path, path + ".corrupt")
+                moved.append(name)
+            except OSError:
+                pass
+        logger.error(
+            "quarantined checkpoint epoch %d under %r (%s): %s",
+            epoch, self.prefix, "; ".join(problems), moved)
+
+    def fsck(self, quarantine=False):
+        """Offline audit of every epoch under the prefix: manifest
+        readability, shard existence/size/SHA-256, piece coverage (v1
+        epochs: params file + metadata readability).  Returns a report
+        dict; ``quarantine=True`` additionally renames failing epochs to
+        ``*.corrupt`` exactly as a failed ``load()`` would."""
+        report = {"directory": self.directory, "prefix": self.prefix_name,
+                  "ok": True, "epochs": []}
+        try:
+            names = os.listdir(self.directory)
+        except OSError as e:
+            report["ok"] = False
+            report["error"] = str(e)
+            return report
+        report["quarantined_files"] = sorted(
+            n for n in names
+            if n.startswith(self.prefix_name + "-")
+            and n.endswith(".corrupt"))
+        for epoch in self.epochs():
+            if os.path.exists(self._manifest_path(epoch)):
+                fmt = 2
+                try:
+                    with open(self._manifest_path(epoch)) as f:
+                        manifest = json.load(f)
+                    problems = self._verify_epoch(manifest)
+                except (OSError, ValueError) as e:
+                    problems = ["unreadable manifest: %s" % e]
+            else:
+                fmt = 1
+                problems = []
+                try:
+                    self._read_meta(epoch)
+                except MXNetError as e:
+                    problems.append(str(e))
+                try:
+                    from . import model as model_mod
+
+                    model_mod.load_checkpoint(self.prefix, epoch)
+                except MXNetError as e:
+                    problems.append(str(e))
+            entry = {"epoch": epoch, "format": fmt,
+                     "ok": not problems, "problems": problems}
+            if problems:
+                report["ok"] = False
+                if quarantine:
+                    self._quarantine(epoch, problems)
+                    entry["quarantined"] = True
+            report["epochs"].append(entry)
+        return report
+
+    # -- reassembly / elastic restore -----------------------------------
+    def _assemble(self, manifest):
+        """Global numpy arrays from whatever shard layout the saving
+        topology used."""
+        import numpy as np
+
+        arrays = {}
+        for shard in manifest.get("shards") or []:
+            path = os.path.join(self.directory, shard["file"])
+            try:
+                npz = np.load(path, allow_pickle=False)
+            except Exception as e:
+                # verification off (MXNET_CKPT_VERIFY=0) can reach an
+                # unreadable shard; surface it as the typed error
+                raise CorruptCheckpoint(
+                    "checkpoint shard %s is unreadable: %s"
+                    % (shard["file"], e)) from e
+            with npz as f:
+                for pkey, info in (shard.get("pieces") or {}).items():
+                    key, idx = info["param"], info["index"]
+                    meta = manifest["params"][key]
+                    piece = np.asarray(f[pkey])
+                    if idx is None:
+                        arrays[key] = piece
+                        continue
+                    dst = arrays.get(key)
+                    if dst is None:
+                        dst = np.zeros(tuple(meta["shape"]),
+                                       dtype=meta["dtype"])
+                        arrays[key] = dst
+                    dst[tuple(slice(int(a), int(b)) for a, b in idx)] = \
+                        piece
+        return arrays
+
+    def _restore_layout(self, mesh, sharding, arrays):
+        """(mesh, {key: NamedSharding}) for the elastic restore: the
+        CURRENT mesh (argument or ambient scope) plus explicit rule-based
+        shardings when the caller passed a style/rule list."""
+        if mesh is None:
+            from .parallel.mesh import current_mesh
+
+            mesh = current_mesh()
+        rule_shardings = {}
+        if mesh is not None and sharding is not None:
+            from .parallel.sharding import (apply_rules,
+                                            param_sharding_rules)
+
+            rules = param_sharding_rules(sharding) \
+                if isinstance(sharding, str) else sharding
+            rule_shardings = apply_rules(mesh, arrays, rules)
+        return mesh, rule_shardings
+
+    def _reshard(self, key, arr, spec, mesh, rule_sharding=None):
+        """One param onto the current topology: device_put under the
+        saved spec (axes filtered to the mesh that exists NOW) or the
+        caller's rule sharding; no mesh -> a host NDArray, and the
+        module's own bind/init_optimizer lays it out later."""
+        from .ndarray import NDArray, array as nd_array
+
+        if mesh is None:
+            return nd_array(arr)
+        try:
+            import jax
+
+            from .parallel.sharding import sharding_from_spec
+
+            ns = rule_sharding if rule_sharding is not None else \
+                sharding_from_spec(mesh, arr.shape, spec)
+            return NDArray(jax.device_put(arr, ns))
+        except Exception as e:
+            logger.warning(
+                "elastic reshard of %s onto mesh %s failed (%s); "
+                "replicating on host", key,
+                dict(getattr(mesh, "shape", {})), e)
+            return nd_array(arr)
+
     def _read_meta(self, epoch):
+        manifest_path = self._manifest_path(epoch)
+        if os.path.exists(manifest_path):
+            try:
+                with open(manifest_path) as f:
+                    return json.load(f)
+            except (OSError, ValueError) as e:
+                raise MXNetError("checkpoint manifest %r is corrupt: %s"
+                                 % (manifest_path, e)) from e
         path = self._meta_path(epoch)
         if not os.path.exists(path):
             # bare save_checkpoint output (no manager metadata): resume
@@ -239,33 +859,64 @@ class CheckpointManager:
     def _gc(self):
         if self.keep is None:
             return
-        doomed = self.epochs()[:-self.keep]
+        # epochs() already excludes quarantined (*.corrupt) epochs, so
+        # they neither count toward keep=N nor get collected here; the
+        # epoch a resume just loaded is pinned even when it has aged out
+        pinned = self._pinned_epoch
+        doomed = [e for e in self.epochs()[:-self.keep] if e != pinned]
+        if not doomed:
+            return
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        removed = []
         for epoch in doomed:
-            for path in (self._params_path(epoch), self._states_path(epoch),
-                         self._meta_path(epoch)):
+            tag = self._epoch_tag(epoch)
+            for name in names:
+                if not name.startswith(tag) or name.endswith(".corrupt"):
+                    continue
                 try:
-                    os.remove(path)
+                    os.remove(os.path.join(self.directory, name))
                 except FileNotFoundError:
-                    pass
+                    pass  # a concurrent GC/quarantine got there first
                 except OSError as e:  # keep training; disk GC can wait
                     logger.warning("checkpoint GC could not remove %s: %s",
-                                   path, e)
-        if doomed:
+                                   name, e)
+            removed.append(epoch)
+        if removed:
             logger.info("checkpoint GC removed epochs %s (keep=%d)",
-                        doomed, self.keep)
+                        removed, self.keep)
 
 
 def _write_json(path, obj):
     with open(path, "w") as f:
         json.dump(obj, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _write_text(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _write_bytes(path, blob):
+    with open(path, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def resolve_resume(resume_from, kvstore=None):
     """Normalize ``fit(resume_from=...)`` into a :class:`CheckpointState`.
 
     Accepts a :class:`CheckpointState`, a :class:`CheckpointManager`
-    (loads its latest), a ``prefix`` string (directory/prefix of manager
-    or bare ``save_checkpoint`` output), or a ``(prefix, epoch)`` pair.
+    (loads its latest — falling back past quarantined epochs), a
+    ``prefix`` string (directory/prefix of manager or bare
+    ``save_checkpoint`` output), or a ``(prefix, epoch)`` pair.
     """
     if isinstance(resume_from, CheckpointState):
         return resume_from

@@ -403,6 +403,11 @@ class BaseModule:
                              resume_nbatch=resume_state.nbatch
                              if resume_state is not None else 0,
                              hmon=hmon, watchdog=watchdog)
+            if mgr is not None:
+                # drain the async checkpoint writer before declaring the
+                # fit done: a failed background write must fail the fit,
+                # not vanish with the daemon thread
+                mgr.flush()
         except StepHung as e:
             # the watchdog delivers a BARE StepHung through
             # PyThreadState_SetAsyncExc (the C API cannot pass
@@ -575,6 +580,11 @@ class BaseModule:
                     "continuing to the checkpoint write")
         if mgr is not None:
             mgr.save(self, epoch=epoch, nbatch=nbatch)
+            # the preemption latch is the last code to run before the
+            # process exits: drain the async writer so the final
+            # checkpoint is on disk (and its errors surfaced) before
+            # TrainingPreempted unwinds
+            mgr.flush()
         raise TrainingPreempted(
             "training preempted by signal %d at epoch %d, batch %d%s"
             % (signum, epoch, nbatch,

@@ -15,7 +15,8 @@ from ..base import MXNetError
 from .mesh import current_mesh
 
 __all__ = ["named_sharding", "replicated", "shard_batch", "constraint",
-           "param_sharding_rules", "apply_rules", "tp_rules_for_mlp"]
+           "param_sharding_rules", "apply_rules", "tp_rules_for_mlp",
+           "sharding_from_spec"]
 
 
 def named_sharding(mesh, *spec):
@@ -124,3 +125,32 @@ def apply_rules(mesh, params, rules):
                 spec = tuple(lst)
         out[name] = NamedSharding(mesh, PartitionSpec(*spec))
     return out
+
+
+def sharding_from_spec(mesh, shape, spec):
+    """NamedSharding for ``shape`` on the CURRENT mesh from a serialized
+    PartitionSpec saved by a possibly-different topology (list entries:
+    None, an axis name, or a list of axis names).
+
+    The elastic-restore primitive: axes the current mesh does not have
+    are dropped, and a dimension whose size no longer divides the
+    surviving axes' extent falls back to replicated on that dim — so a
+    checkpoint from an 8-chip fsdp mesh loads onto 4 chips (resharded)
+    or 1 chip (fully replicated) without caller involvement."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    out = []
+    for i, entry in enumerate(tuple(spec or ())[:len(shape)]):
+        if entry is None:
+            out.append(None)
+            continue
+        names = [entry] if isinstance(entry, str) else list(entry)
+        names = [n for n in names if n in mesh.shape]
+        extent = 1
+        for n in names:
+            extent *= int(mesh.shape[n])
+        if not names or extent <= 1 or int(shape[i]) % extent != 0:
+            out.append(None)
+        else:
+            out.append(names[0] if len(names) == 1 else tuple(names))
+    return NamedSharding(mesh, PartitionSpec(*out))

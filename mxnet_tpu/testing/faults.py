@@ -32,6 +32,12 @@ Actions:
   site ``numerics``, which flows through forward/backward into the loss
   and every gradient).  Callers that ignore the return value are
   unaffected.
+* ``bitflip`` / ``truncate`` — *file* corruption: the site passes the
+  just-written file via ``inject(site, path=...)`` and the action XORs
+  one bit in the middle byte / truncates the file to half its length.
+  Models silent disk corruption after a successful write — exactly what
+  the checkpoint verifier's SHA-256 pass must catch.  Only valid at
+  sites that supply a path (today: ``checkpoint_corrupt``).
 
 Keys:
 
@@ -43,7 +49,11 @@ Keys:
 
 Sites instrumented today: ``device_prefetch`` / ``prefetch`` (the io.py
 worker loops), ``checkpoint_io`` (between temp-file write and the atomic
-rename), ``collective`` (kvstore DCN barrier / cross-replica sum),
+rename), ``shard_write`` (inside the v2 shard writer, bytes down but the
+shard not yet published — kill/raise/delay here model a host dying
+mid-checkpoint), ``checkpoint_corrupt`` (after a shard is published,
+with ``path=`` — ``bitflip``/``truncate`` here model post-write disk
+rot), ``collective`` (kvstore DCN barrier / cross-replica sum),
 ``numerics`` (Module's fused step — poison one batch element with the
 returned nan/inf), ``step`` (top of every fit batch — ``hang`` here
 trips the step watchdog).
@@ -63,7 +73,8 @@ __all__ = ["FaultInjected", "WorkerKilled", "inject", "reset", "active"]
 
 ENV_VAR = "MXNET_FAULT_INJECT"
 
-_ACTIONS = ("raise", "kill", "delay", "hang", "nan", "inf")
+_ACTIONS = ("raise", "kill", "delay", "hang", "nan", "inf",
+            "bitflip", "truncate")
 
 
 class FaultInjected(MXNetError):
@@ -143,16 +154,19 @@ def active(site=None):
         return any(s["site"] == site or site is None for s in _specs)
 
 
-def inject(site):
+def inject(site, path=None):
     """Fault hook.  No-op unless ``MXNET_FAULT_INJECT`` names ``site``;
     otherwise counts the hit and fires the configured action when the
     counter reaches ``after`` (every later hit too with ``sticky=1``).
     Returns the poison value for ``nan``/``inf`` actions, else None.
+    ``path`` names the file the ``bitflip``/``truncate`` corruption
+    actions mutate; sites that cannot supply one reject those actions.
     """
     if not os.environ.get(ENV_VAR) and _env_snapshot in (None, ""):
         return None  # fast path: nothing armed, nothing to refresh
     delays = []
     hangs = []
+    corruptions = []
     poison = None
     with _lock:
         _refresh_locked()
@@ -172,6 +186,8 @@ def inject(site):
                 poison = float("nan")
             elif spec["action"] == "inf":
                 poison = float("inf")
+            elif spec["action"] in ("bitflip", "truncate"):
+                corruptions.append(spec["action"])
             elif spec["action"] == "kill":
                 raise WorkerKilled(
                     "injected worker kill at site %r (hit %d)" % (site, n))
@@ -179,6 +195,8 @@ def inject(site):
                 raise FaultInjected(
                     "injected fault at site %r (hit %d, %s=%r)"
                     % (site, n, ENV_VAR, _env_snapshot))
+    for action in corruptions:  # file I/O outside the lock
+        _corrupt_file(action, path, site)
     for s in delays:  # sleep outside the lock: a delay must not serialize
         time.sleep(s)  # other sites behind it
     for s in hangs:
@@ -190,3 +208,22 @@ def inject(site):
         while time.monotonic() < deadline:
             time.sleep(0.02)
     return poison
+
+
+def _corrupt_file(action, path, site):
+    """Apply a ``bitflip``/``truncate`` corruption to ``path`` in place —
+    after the atomic publish, like real disk rot would."""
+    if path is None:
+        raise MXNetError(
+            "fault action %r at site %r needs a file: the site must call "
+            "inject(site, path=...)" % (action, site))
+    size = os.path.getsize(path)
+    if action == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        return
+    with open(path, "r+b") as f:  # bitflip: XOR one bit mid-file
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([(byte[0] if byte else 0) ^ 0x01]))

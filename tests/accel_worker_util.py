@@ -12,7 +12,7 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 _PROBE_CACHE = []  # session-wide: the environment can't gain a chip mid-run
 
 
-def _probe_accelerator(env, timeout=120):
+def _probe_accelerator(env, timeout=None):
     """Ask a throwaway child which platform bare discovery finds.
 
     Run before the real worker spawn: a wedged accelerator tunnel
@@ -20,11 +20,16 @@ def _probe_accelerator(env, timeout=120):
     minutes (in-process thread timeouts cannot interrupt it, and the
     wedge is per-spawn nondeterministic), so the only reliable bound is
     a subprocess kill.  Returns the platform string, or None when
-    discovery wedged past ``timeout``.  The verdict is cached for the
-    session so a wedged tunnel costs the suite one probe, not one per
-    test."""
+    discovery wedged past ``timeout`` (``TEST_ACCEL_PROBE_TIMEOUT_S``,
+    default 45 s — healthy discovery answers in seconds, and on a
+    wedged tunnel the probe burns its FULL bound of tier-1 wall clock,
+    so the default must stay well inside the suite's timeout budget).
+    The verdict is cached for the session so a wedged tunnel costs the
+    suite one probe, not one per test."""
     if _PROBE_CACHE:
         return _PROBE_CACHE[0]
+    if timeout is None:
+        timeout = float(os.environ.get("TEST_ACCEL_PROBE_TIMEOUT_S", "45"))
     try:
         res = subprocess.run(
             [sys.executable, "-c",
@@ -47,7 +52,7 @@ def run_accel_worker(argv, timeout=560):
            if k not in ("JAX_PLATFORMS",)}
     platform = _probe_accelerator(env)
     if platform is None:
-        pytest.skip("accelerator discovery wedged (no answer in 120s)")
+        pytest.skip("accelerator discovery wedged (bounded probe)")
     if platform == "cpu":
         # same verdict the worker's own sentinel would reach, without
         # risking a second (wedge-prone) discovery in the real spawn

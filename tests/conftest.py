@@ -39,6 +39,11 @@ def pytest_configure(config):
         "markers",
         "slow: long multi-process tests excluded from the tier-1 run "
         "(pytest -m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection matrix over the MXNET_FAULT_INJECT "
+        "sites (runs in tier-1; select just the matrix with "
+        "pytest -m chaos)")
 
 
 @pytest.fixture(autouse=True)

@@ -14,6 +14,17 @@ Modes (all train the same deterministic MLP for 2 epochs):
   exits 0.
 * ``resume`` — ``fit(resume_from=...)`` from the checkpoint directory;
   saves ``params_resume_rank<r>.npz``.
+* ``restore`` — elastic-restore probe: ``fit(resume_from=...)`` with
+  ``num_epoch`` equal to the checkpointed epoch count, so ZERO batches
+  run and ``params_restore_rank<r>.npz`` is exactly what the checkpoint
+  reassembled onto THIS topology (the cross-process-count bit-exactness
+  check).
+* ``asyncsave`` — trains 1 epoch (synchronous checkpoint), then starts
+  an async ``save()`` for epoch 2 with the ``shard_write`` fault site
+  armed to delay mid-write, touches ``asyncsave_inflight_rank<r>``, and
+  blocks in ``flush()`` — the parent SIGTERMs it there, modeling
+  preemption DURING a background checkpoint write; epoch 1 must stay
+  loadable.
 
 With the optional distributed triple the worker joins a
 ``jax.distributed`` pod and trains through ``kvstore='dist_tpu_sync'``
@@ -126,6 +137,9 @@ def main():
         try:
             mod.fit(make_iter(), checkpoint=mgr, batch_end_callback=batch_cb,
                     **fit_kwargs)
+            # clean completion: record the final params so an elastic
+            # restore on a different process count can diff against them
+            save_params(mod, "train")
             print("WORKER %d DONE train (no preemption)" % rank)
         except mx.TrainingPreempted as e:
             with open(os.path.join(workdir,
@@ -140,6 +154,40 @@ def main():
         mod.fit(make_iter(), resume_from=mgr, **fit_kwargs)
         save_params(mod, "resume")
         print("WORKER %d DONE resume" % rank)
+        return
+
+    if mode == "restore":
+        # resume with num_epoch == the checkpoint's completed epochs:
+        # fit binds, restores params/optimizer, trains zero batches —
+        # the saved params round-trip through the elastic load path
+        # unmodified onto whatever topology THIS process runs
+        n_epochs = int(os.environ.get("FT_RESTORE_EPOCHS", "2"))
+        mod = make_module()
+        mod.fit(make_iter(), resume_from=mgr,
+                **dict(fit_kwargs, num_epoch=n_epochs))
+        save_params(mod, "restore")
+        print("WORKER %d DONE restore" % rank)
+        return
+
+    if mode == "asyncsave":
+        from mxnet_tpu.testing import faults
+
+        mod = make_module()
+        mod.fit(make_iter(), checkpoint=mgr,
+                **dict(fit_kwargs, num_epoch=1))
+        amgr = ckpt.CheckpointManager(ckpt_dir, prefix="ft",
+                                      async_writes=True)
+        os.environ["MXNET_FAULT_INJECT"] = \
+            "shard_write:delay:seconds=%s" % os.environ.get(
+                "FT_ASYNC_DELAY_S", "30")
+        faults.reset()
+        amgr.save(mod, epoch=2)  # background writer enters the delay
+        with open(os.path.join(workdir,
+                               "asyncsave_inflight_rank%d" % rank),
+                  "w") as f:
+            f.write("writing\n")
+        amgr.flush()  # parent SIGTERMs us while blocked here
+        print("WORKER %d DONE asyncsave (no kill landed)" % rank)
         return
 
     raise SystemExit("unknown mode %r" % mode)

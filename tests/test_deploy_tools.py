@@ -179,6 +179,56 @@ def test_export_model_cli(tmp_path):
     assert served.forward(data=X[:10])[0].shape == (10, 3)
 
 
+def test_ckpt_fsck_cli(tmp_path):
+    """tools/ckpt_fsck.py offline audit: exit 0 on a healthy directory,
+    exit 1 + problem report on a corrupted shard, and --quarantine
+    renames the bad epoch so the next resume skips it."""
+    import json
+
+    from mxnet_tpu import checkpoint as ckpt
+
+    d = str(tmp_path / "ckpt")
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    args = {"w": mx.nd.array(np.arange(12, dtype="float32").reshape(3, 4))}
+    for epoch in (1, 2):
+        mgr.save(arg_params=args, aux_params={}, epoch=epoch)
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "ckpt_fsck.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, tool, d, "--prefix", "m", *extra],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.join(os.path.dirname(__file__), ".."))
+
+    res = run()
+    assert res.returncode == 0, res.stderr
+    report = json.loads(res.stdout)
+    assert report["ok"] and len(report["epochs"]) == 2
+
+    shard = os.path.join(d, "m-0002.shard0.params")
+    size = os.path.getsize(shard)
+    with open(shard, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0x01]))
+
+    res = run()
+    assert res.returncode == 1, res.stdout
+    report = json.loads(res.stdout)
+    bad = [e for e in report["epochs"] if not e["ok"]]
+    assert len(bad) == 1 and bad[0]["epoch"] == 2
+
+    res = run("--quarantine")
+    assert res.returncode == 1
+    assert ckpt.CheckpointManager(d, prefix="m").epochs() == [1]
+    res = run()
+    assert res.returncode == 0, res.stdout
+
+
 def test_c_predict_api(tmp_path):
     """Build src/c_predict_api.cc, compile a C client against the shipped
     header, and serve a checkpoint from C — the reference's

@@ -1,0 +1,628 @@
+"""Elastic v2 checkpoints: sharded+checksummed snapshots, quarantine +
+fallback loads, async off-critical-path writes, cross-topology restore.
+
+Covers the PR 5 surface end to end:
+
+* v2 on-disk layout (per-rank shard + sidecar, rank-0 manifest LAST) and
+  bit-exact save/load round-trips,
+* SHA-256 verification: a bit-flipped or truncated shard quarantines the
+  epoch (``*.corrupt``) and ``load()``/``resolve_resume`` fall back to
+  the previous good epoch; explicit-epoch loads raise
+  :class:`CorruptCheckpoint`,
+* ``CheckpointManager.fsck`` offline audit (+ ``--quarantine``),
+* retention GC: quarantined epochs neither count nor get collected, the
+  resumed-from epoch is pinned,
+* async writes: ``mxtpu-ckpt-writer`` equivalence with sync, depth-1
+  bound, background errors surfacing at the next ``save()``/``flush()``,
+  and a real ``kill -TERM`` during an in-flight async write leaving the
+  previous epoch loadable (subprocess, ``ft_worker.py asyncsave``),
+* topology-elastic restore: ``sharding_from_spec`` axis filtering and
+  ``load(mesh=..., sharding=...)`` resharding, plus the slow two-process
+  save → one-process restore (and vice versa) bit-exactness check,
+* the ``chaos`` marker matrix over the new ``shard_write`` /
+  ``checkpoint_corrupt`` fault sites under ``tests/worker_guard.py``.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import worker_guard
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.testing import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    os.environ.pop("MXNET_FAULT_INJECT", None)
+    faults.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _args(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"fc1_weight": mx.nd.array(rs.randn(8, 8).astype("float32")),
+            "fc1_bias": mx.nd.array(rs.randn(8).astype("float32")),
+            "fc2_weight": mx.nd.array(rs.randn(3, 8).astype("float32")),
+            "fc2_bias": mx.nd.array(rs.randn(3).astype("float32"))}
+
+
+def _fit_with(mgr, num_epoch=1):
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype("float32")
+    w = rs.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True, seed=42)
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            checkpoint=mgr)
+    return mod
+
+
+def _flip_bit(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0x01]))
+
+
+# -- v2 layout + round-trip --------------------------------------------
+
+def test_v2_layout_and_manifest(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=_args(), aux_params={}, epoch=1,
+             nbatch=7)
+    names = sorted(os.listdir(d))
+    assert names == ["m-0001.manifest.json", "m-0001.shard0.json",
+                     "m-0001.shard0.params", "m-symbol.json"]
+    with open(os.path.join(d, "m-0001.manifest.json")) as f:
+        man = json.load(f)
+    assert man["format"] == 2 and man["epoch"] == 1 and man["nbatch"] == 7
+    assert man["params"]["arg:fc1_weight"]["shape"] == [8, 8]
+    assert man["params"]["arg:fc1_weight"]["dtype"] == "float32"
+    shard = man["shards"][0]
+    assert shard["rank"] == 0
+    assert shard["file"] == "m-0001.shard0.params"
+    assert len(shard["sha256"]) == 64
+    assert shard["bytes"] == os.path.getsize(
+        os.path.join(d, shard["file"]))
+
+
+def test_v2_roundtrip_bit_exact(tmp_path):
+    args = _args()
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=3)
+    state = mgr.load()
+    assert state.epoch == 3
+    assert state.symbol is not None
+    for k, v in args.items():
+        np.testing.assert_array_equal(state.arg_params[k].asnumpy(),
+                                      v.asnumpy())
+
+
+def test_v2_module_save_records_states_and_meta(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    mod = _fit_with(mgr, num_epoch=1)
+    state = mgr.load()
+    assert state.epoch == 1 and state.num_update == 8
+    assert state.states_path is not None and \
+        os.path.exists(state.states_path)
+    assert state.manifest["have_states"]
+    assert state.manifest["states"]["sha256"]
+    for k, v in mod.get_params()[0].items():
+        np.testing.assert_array_equal(state.arg_params[k].asnumpy(),
+                                      v.asnumpy())
+
+
+def test_format_env_writes_legacy_v1(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_CKPT_FORMAT", "1")
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=_args(), aux_params={}, epoch=1,
+             nbatch=4)
+    assert os.path.exists(os.path.join(d, "m-0001.params"))
+    assert os.path.exists(os.path.join(d, "m-0001.meta.json"))
+    assert not os.path.exists(os.path.join(d, "m-0001.manifest.json"))
+    monkeypatch.delenv("MXNET_CKPT_FORMAT")
+    # a v2-default manager reads the v1 epoch transparently
+    state = ckpt.CheckpointManager(d, prefix="m").load()
+    assert state.epoch == 1 and state.nbatch == 4
+
+
+# -- verification, quarantine, fallback ---------------------------------
+
+def test_bitflip_quarantines_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    good = _args(seed=1)
+    mgr.save(symbol=_mlp(), arg_params=good, aux_params={}, epoch=1)
+    mgr.save(symbol=_mlp(), arg_params=_args(seed=2), aux_params={},
+             epoch=2)
+    _flip_bit(os.path.join(d, "m-0002.shard0.params"))
+
+    state = mgr.load()  # falls back past the corrupt epoch
+    assert state.epoch == 1
+    for k, v in good.items():
+        np.testing.assert_array_equal(state.arg_params[k].asnumpy(),
+                                      v.asnumpy())
+    corrupt = sorted(n for n in os.listdir(d) if n.endswith(".corrupt"))
+    assert "m-0002.shard0.params.corrupt" in corrupt
+    assert "m-0002.manifest.json.corrupt" in corrupt
+    assert mgr.epochs() == [1]
+    assert mgr.latest() == 1
+
+
+def test_truncated_shard_quarantines_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=_args(1), aux_params={}, epoch=1)
+    mgr.save(symbol=_mlp(), arg_params=_args(2), aux_params={}, epoch=2)
+    shard = os.path.join(d, "m-0002.shard0.params")
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    assert mgr.load().epoch == 1
+    assert mgr.latest() == 1
+
+
+def test_explicit_epoch_corrupt_raises_typed(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=_args(1), aux_params={}, epoch=1)
+    mgr.save(symbol=_mlp(), arg_params=_args(2), aux_params={}, epoch=2)
+    _flip_bit(os.path.join(d, "m-0001.shard0.params"))
+    _flip_bit(os.path.join(d, "m-0002.shard0.params"))
+    with pytest.raises(ckpt.CorruptCheckpoint, match="checksum mismatch"):
+        mgr.load(epoch=2)
+    # the remaining epoch is corrupt too: the scan quarantines it and
+    # names every failed candidate
+    with pytest.raises(MXNetError, match="candidate failed"):
+        mgr.load()
+    with pytest.raises(MXNetError, match="no checkpoint found"):
+        mgr.load()  # nothing left after the quarantines
+
+
+def test_corrupt_states_file_quarantines(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    _fit_with(mgr, num_epoch=2)  # epochs 1 and 2, each with states
+    _flip_bit(mgr._states_path(2))
+    assert mgr.load().epoch == 1
+
+
+def test_resolve_resume_skips_quarantined(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=_args(1), aux_params={}, epoch=1)
+    mgr.save(symbol=_mlp(), arg_params=_args(2), aux_params={}, epoch=2)
+    _flip_bit(os.path.join(d, "m-0002.shard0.params"))
+    state = ckpt.resolve_resume(os.path.join(d, "m"))
+    assert state.epoch == 1
+
+
+def test_verify_opt_out(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m", verify=False)
+    mgr.save(symbol=_mlp(), arg_params=_args(), aux_params={}, epoch=1)
+    assert mgr.load().epoch == 1  # no hashing, still loads
+    monkeypatch.setenv("MXNET_CKPT_VERIFY", "0")
+    assert not ckpt.CheckpointManager(d, prefix="m").verify
+
+
+# -- fsck ---------------------------------------------------------------
+
+def test_fsck_healthy_and_corrupt(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=_args(1), aux_params={}, epoch=1)
+    mgr.save(symbol=_mlp(), arg_params=_args(2), aux_params={}, epoch=2)
+    report = mgr.fsck()
+    assert report["ok"] and len(report["epochs"]) == 2
+    assert all(e["ok"] and e["format"] == 2 for e in report["epochs"])
+
+    _flip_bit(os.path.join(d, "m-0002.shard0.params"))
+    report = mgr.fsck()
+    assert not report["ok"]
+    bad = [e for e in report["epochs"] if not e["ok"]]
+    assert len(bad) == 1 and bad[0]["epoch"] == 2
+    assert any("checksum" in p for p in bad[0]["problems"])
+
+    # --quarantine semantics: the failing epoch is renamed away, after
+    # which the directory audits clean again
+    report = mgr.fsck(quarantine=True)
+    assert not report["ok"]
+    assert mgr.epochs() == [1]
+    follow_up = mgr.fsck()
+    assert follow_up["ok"]
+    assert follow_up["quarantined_files"]
+
+
+# -- retention GC -------------------------------------------------------
+
+def test_gc_skips_corrupt_and_pinned(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m", keep=2)
+    for e in (1, 2, 3):
+        mgr.save(symbol=_mlp(), arg_params=_args(e), aux_params={},
+                 epoch=e)
+    assert mgr.epochs() == [2, 3]
+    _flip_bit(os.path.join(d, "m-0003.shard0.params"))
+    state = mgr.load()  # quarantines 3, loads + pins 2
+    assert state.epoch == 2
+    # two more saves would normally age epoch 2 out; the pin keeps the
+    # epoch the run is actually resuming from
+    mgr.save(symbol=_mlp(), arg_params=_args(4), aux_params={}, epoch=4)
+    mgr.save(symbol=_mlp(), arg_params=_args(5), aux_params={}, epoch=5)
+    assert 2 in mgr.epochs()
+    assert mgr.epochs()[-2:] == [4, 5]
+    # quarantined epoch-3 files are untouched by GC
+    assert any(n.startswith("m-0003.") and n.endswith(".corrupt")
+               for n in os.listdir(d))
+
+
+def test_gc_tolerates_concurrent_deletion(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, prefix="m", keep=1)
+    mgr.save(symbol=_mlp(), arg_params=_args(1), aux_params={}, epoch=1)
+    real_remove = os.remove
+
+    def racing_remove(path):
+        # another rank's GC wins the race on every file
+        real_remove(path)
+        raise FileNotFoundError(path)
+
+    monkeypatch.setattr(os, "remove", racing_remove)
+    mgr.save(symbol=_mlp(), arg_params=_args(2), aux_params={}, epoch=2)
+    monkeypatch.setattr(os, "remove", real_remove)
+    assert mgr.epochs() == [2]
+
+
+# -- async writes -------------------------------------------------------
+
+def test_async_save_equivalent_to_sync(tmp_path):
+    args = _args()
+    sync_d, async_d = str(tmp_path / "s"), str(tmp_path / "a")
+    ckpt.CheckpointManager(sync_d, prefix="m").save(
+        symbol=_mlp(), arg_params=args, aux_params={}, epoch=1)
+    amgr = ckpt.CheckpointManager(async_d, prefix="m", async_writes=True)
+    amgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=1)
+    amgr.flush()
+    s1 = ckpt.CheckpointManager(sync_d, prefix="m").load()
+    s2 = ckpt.CheckpointManager(async_d, prefix="m").load()
+    for k in s1.arg_params:
+        np.testing.assert_array_equal(s1.arg_params[k].asnumpy(),
+                                      s2.arg_params[k].asnumpy())
+
+
+def test_async_depth_one_and_error_surfacing(tmp_path, monkeypatch):
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m",
+                                 async_writes=True)
+    args = _args()
+    # depth 1: back-to-back saves serialize on the writer join, both land
+    mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=1)
+    mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=2)
+    mgr.flush()
+    assert mgr.epochs() == [1, 2]
+
+    # a failing background write surfaces at the NEXT save (which joins
+    # the writer before doing anything, so the epoch-4 attempt never
+    # reaches its own write)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "shard_write:raise")
+    faults.reset()
+    mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=3)
+    with pytest.raises(faults.FaultInjected):
+        mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=4)
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faults.reset()
+    # the error was consumed; the manager keeps working
+    mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=5)
+    mgr.flush()
+    assert 3 not in mgr.epochs() and 5 in mgr.epochs()
+
+
+def test_async_flush_raises_pending_error(tmp_path, monkeypatch):
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m",
+                                 async_writes=True)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "shard_write:raise")
+    faults.reset()
+    mgr.save(symbol=_mlp(), arg_params=_args(), aux_params={}, epoch=1)
+    with pytest.raises(faults.FaultInjected):
+        mgr.flush()
+    assert mgr.latest() is None  # nothing was published
+
+
+def test_async_fit_checkpoints_match_sync(tmp_path, monkeypatch):
+    sync_mgr = ckpt.CheckpointManager(str(tmp_path / "s"), prefix="m")
+    _fit_with(sync_mgr, num_epoch=2)
+    monkeypatch.setenv("MXNET_CKPT_ASYNC", "1")
+    async_mgr = ckpt.CheckpointManager(str(tmp_path / "a"), prefix="m")
+    assert async_mgr.async_writes
+    _fit_with(async_mgr, num_epoch=2)  # fit flushes before returning
+    monkeypatch.delenv("MXNET_CKPT_ASYNC")
+    s1, s2 = sync_mgr.load(), async_mgr.load()
+    assert s1.epoch == s2.epoch == 2
+    for k in s1.arg_params:
+        np.testing.assert_array_equal(s1.arg_params[k].asnumpy(),
+                                      s2.arg_params[k].asnumpy())
+
+
+def test_kill_during_async_write_previous_epoch_survives(tmp_path):
+    """A real ``kill -TERM`` landing while the mxtpu-ckpt-writer thread
+    is mid-shard must leave the previous checkpoint loadable and the
+    torn epoch invisible (no manifest was published)."""
+    workdir = str(tmp_path)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "FT_ASYNC_DELAY_S": "60"}
+    env.pop("MXNET_FAULT_INJECT", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "ft_worker.py"), "asyncsave",
+         workdir], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    sentinel = os.path.join(workdir, "asyncsave_inflight_rank0")
+    deadline = time.time() + 120
+    while not os.path.exists(sentinel):
+        assert proc.poll() is None, \
+            "worker died early:\n%s" % proc.stderr.read()
+        assert time.time() < deadline, "worker never started the write"
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGTERM)
+    proc.communicate(timeout=120)
+    assert proc.returncode != 0  # killed mid-write, not a clean exit
+
+    mgr = ckpt.CheckpointManager(os.path.join(workdir, "ckpt"),
+                                 prefix="ft")
+    assert mgr.latest() == 1  # the torn epoch-2 write never published
+    state = mgr.load()
+    assert state.epoch == 1
+    assert not os.path.exists(mgr._manifest_path(2))
+    assert mgr.fsck()["ok"]
+
+
+# -- topology-elastic restore -------------------------------------------
+
+def test_sharding_from_spec_axis_filtering():
+    from mxnet_tpu.parallel.mesh import create_mesh
+    from mxnet_tpu.parallel.sharding import sharding_from_spec
+
+    mesh = create_mesh({"data": 8})
+    # saved axis survives when present and divisible
+    ns = sharding_from_spec(mesh, (16, 4), ["data", None])
+    assert tuple(ns.spec) == ("data", None)
+    # an axis the current mesh lacks drops to replicated
+    ns = sharding_from_spec(mesh, (16, 4), ["model", None])
+    assert tuple(ns.spec) == (None, None)
+    # non-divisible dims replicate instead of crashing the restore
+    ns = sharding_from_spec(mesh, (7, 4), ["data", None])
+    assert tuple(ns.spec) == (None, None)
+    # saved spec longer than the rank (or None) is tolerated
+    ns = sharding_from_spec(mesh, (8,), None)
+    assert tuple(ns.spec) == ()
+
+
+def test_load_reshards_onto_current_mesh(tmp_path):
+    from mxnet_tpu.parallel.mesh import create_mesh
+
+    d = str(tmp_path)
+    args = _args()
+    ckpt.CheckpointManager(d, prefix="m").save(
+        symbol=_mlp(), arg_params=args, aux_params={}, epoch=1)
+    mesh = create_mesh({"data": 8})
+    state = ckpt.CheckpointManager(d, prefix="m").load(
+        mesh=mesh, sharding="fsdp")
+    w = state.arg_params["fc1_weight"]._data
+    assert w.sharding.mesh.shape == {"data": 8}
+    # fsdp rules shard the largest dim of the 8x8 weight over the axis
+    assert "data" in tuple(w.sharding.spec)
+    np.testing.assert_array_equal(np.asarray(w),
+                                  args["fc1_weight"].asnumpy())
+
+
+def test_save_sharded_load_elsewhere_bit_exact(tmp_path):
+    """Save params laid out over an 8-way mesh (addressable shards with
+    explicit index windows), then load with NO mesh: the manifest's
+    global metadata must reassemble the identical full arrays."""
+    import jax
+
+    from mxnet_tpu.parallel.mesh import create_mesh, mesh_scope
+    from mxnet_tpu.parallel.sharding import named_sharding
+
+    d = str(tmp_path)
+    mesh = create_mesh({"data": 8})
+    host = np.arange(8 * 16, dtype="float32").reshape(8, 16)
+    sharded = jax.device_put(host, named_sharding(mesh, "data", None))
+    args = {"fc1_weight": mx.nd.NDArray(sharded),
+            "fc1_bias": mx.nd.array(np.ones(8, "float32"))}
+    with mesh_scope(mesh):
+        ckpt.CheckpointManager(d, prefix="m").save(
+            symbol=None, arg_params=args, aux_params={}, epoch=1)
+    with open(os.path.join(d, "m-0001.manifest.json")) as f:
+        man = json.load(f)
+    assert man["params"]["arg:fc1_weight"]["spec"] == ["data", None]
+
+    state = ckpt.CheckpointManager(d, prefix="m").load()
+    np.testing.assert_array_equal(
+        state.arg_params["fc1_weight"].asnumpy(), host)
+    np.testing.assert_array_equal(state.arg_params["fc1_bias"].asnumpy(),
+                                  np.ones(8, "float32"))
+
+
+def test_assemble_from_multi_host_shards(tmp_path):
+    """Reassembly from a genuinely sharded layout: two shard files, each
+    holding half of a global array with explicit index windows (the
+    layout a 2-host pod writes), must load into the full array on this
+    1-process topology.  Built by hand because an in-process jax array
+    is always fully addressable."""
+    import hashlib
+
+    d = str(tmp_path)
+    full = np.arange(16 * 4, dtype="float32").reshape(16, 4)
+    shards_meta = []
+    for rank, (lo, hi) in enumerate(((0, 8), (8, 16))):
+        shard = os.path.join(d, "m-0001.shard%d.params" % rank)
+        with open(shard, "wb") as f:
+            np.savez(f, **{"arg:w/0": full[lo:hi]})
+        shards_meta.append({
+            "rank": rank, "file": os.path.basename(shard),
+            "sha256": hashlib.sha256(open(shard, "rb").read()).hexdigest(),
+            "bytes": os.path.getsize(shard),
+            "pieces": {"arg:w/0": {"param": "arg:w",
+                                   "index": [[lo, hi], [0, 4]]}}})
+    manifest = {"format": 2, "epoch": 1, "nbatch": 0, "num_update": 0,
+                "have_states": False, "num_processes": 2,
+                "params": {"arg:w": {"shape": [16, 4],
+                                     "dtype": "float32", "spec": None}},
+                "shards": shards_meta, "states": None}
+    with open(os.path.join(d, "m-0001.manifest.json"), "w") as f:
+        json.dump(manifest, f)
+
+    state = ckpt.CheckpointManager(d, prefix="m").load()
+    np.testing.assert_array_equal(state.arg_params["w"].asnumpy(), full)
+
+    # drop one shard: coverage verification must catch the hole
+    os.remove(os.path.join(d, "m-0001.shard1.params"))
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    with pytest.raises(MXNetError):
+        mgr.load()
+
+
+@pytest.mark.slow
+def test_elastic_two_proc_save_one_proc_restore(tmp_path):
+    """Acceptance criterion: a checkpoint saved by a 2-process pod
+    restores bit-exactly into a 1-process run through
+    ``fit(resume_from=...)`` — and vice versa."""
+    import socket
+
+    def free_coordinator():
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        return "127.0.0.1:%d" % port
+
+    def run_one(mode, workdir, extra_env=None):
+        env = {**os.environ, **(extra_env or {})}
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_FAULT_INJECT", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "ft_worker.py"), mode,
+             workdir], env=env, capture_output=True, text=True,
+            timeout=240)
+        assert proc.returncode == 0, "worker failed:\n%s\n%s" % (
+            proc.stdout, proc.stderr)
+
+    def run_pod(mode, workdir, extra_env=None):
+        coordinator = free_coordinator()
+        procs = []
+        for rank in range(2):
+            env = {**os.environ, **(extra_env or {})}
+            env.pop("XLA_FLAGS", None)
+            env.pop("MXNET_FAULT_INJECT", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(HERE, "ft_worker.py"),
+                 mode, workdir, coordinator, "2", str(rank)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        outs = [p.communicate(timeout=240) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, "rank failed:\n%s\n%s" % (out, err)
+
+    # 2-process save -> 1-process elastic restore
+    wd = str(tmp_path / "two_to_one")
+    os.makedirs(wd)
+    run_pod("train", wd)  # clean 2-epoch run, checkpoint under wd/ckpt
+    run_one("restore", wd, extra_env={"FT_RESTORE_EPOCHS": "2"})
+    saved = np.load(os.path.join(wd, "params_train_rank0.npz"))
+    restored = np.load(os.path.join(wd, "params_restore_rank0.npz"))
+    for k in saved.files:
+        np.testing.assert_array_equal(saved[k], restored[k])
+
+    # 1-process save -> 2-process elastic restore
+    wd = str(tmp_path / "one_to_two")
+    os.makedirs(wd)
+    run_one("train", wd)
+    run_pod("restore", wd, extra_env={"FT_RESTORE_EPOCHS": "2"})
+    saved = np.load(os.path.join(wd, "params_train_rank0.npz"))
+    for rank in range(2):
+        restored = np.load(os.path.join(
+            wd, "params_restore_rank%d.npz" % rank))
+        for k in saved.files:
+            np.testing.assert_array_equal(saved[k], restored[k])
+
+
+# -- chaos matrix over the new fault sites ------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site,action", [
+    ("shard_write", "raise"),
+    ("shard_write", "kill"),
+    ("shard_write", "delay:seconds=0.2"),
+    ("checkpoint_corrupt", "bitflip"),
+    ("checkpoint_corrupt", "truncate"),
+])
+def test_chaos_matrix_new_sites(tmp_path, monkeypatch, site, action):
+    """Every fault shape on the new sites must leave the previous epoch
+    loadable: in-flight faults abort before publish, post-publish
+    corruption is caught by verification and quarantined."""
+    guard = worker_guard.install(120)
+    try:
+        d = str(tmp_path)
+        mgr = ckpt.CheckpointManager(d, prefix="m")
+        good = _args(seed=9)
+        mgr.save(symbol=_mlp(), arg_params=good, aux_params={}, epoch=1)
+
+        monkeypatch.setenv("MXNET_FAULT_INJECT",
+                           "%s:%s" % (site, action))
+        faults.reset()
+        kind = action.split(":")[0]
+        try:
+            mgr.save(symbol=_mlp(), arg_params=_args(seed=10),
+                     aux_params={}, epoch=2)
+        except faults.FaultInjected:
+            assert kind == "raise"
+        except faults.WorkerKilled:
+            assert kind == "kill"
+        else:
+            assert kind in ("delay", "bitflip", "truncate")
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        faults.reset()
+
+        state = mgr.load()
+        assert state.epoch in (1, 2)
+        if kind in ("bitflip", "truncate"):
+            # post-publish corruption: verification must have caught it
+            assert state.epoch == 1
+            assert any(n.startswith("m-0002.") and n.endswith(".corrupt")
+                       for n in os.listdir(d))
+        if kind in ("raise", "kill"):
+            # aborted before publish: epoch 2 must be invisible
+            assert state.epoch == 1
+            assert not os.path.exists(mgr._manifest_path(2))
+        for k, v in good.items():
+            if state.epoch == 1:
+                np.testing.assert_array_equal(
+                    state.arg_params[k].asnumpy(), v.asnumpy())
+    finally:
+        guard.cancel()
