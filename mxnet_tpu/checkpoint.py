@@ -36,11 +36,16 @@ guarantees:
   ``save()`` first joins the previous write).  Writer errors surface at
   the next ``save()``/``flush()``; the preemption latch in ``Module.fit``
   flushes before raising ``TrainingPreempted``.
-* **Rank-0 merge + barrier** — under a dist kvstore every rank writes
-  its own shards, meets at ``kvstore.barrier()``, rank 0 merges and
-  publishes the manifest, and a second barrier keeps any peer from
-  resuming against a half-published set.  (Async mode requires a
-  single-process store and falls back to synchronous writes otherwise.)
+* **Rank-0 merge + barrier** — every rank writes its shards and its
+  sidecar (even an empty one), meets at a barrier
+  (``kvstore.barrier()`` under a dist store, a jax global-device sync
+  in the coordinator-env multi-process mode), rank 0 merges the
+  sidecars of ranks ``< nproc`` — deleting stale shard files a
+  previous save of the same epoch tag under a larger topology left
+  behind — and publishes the manifest; a second barrier keeps any
+  peer from resuming against a half-published set.  (Async mode
+  requires a single-process run and falls back to synchronous writes
+  otherwise.)
 * **Retention** — ``keep=N`` garbage-collects all but the newest N
   epochs, tolerating concurrently-deleted files, never collecting the
   epoch a resume just loaded, and not counting quarantined epochs.
@@ -91,6 +96,27 @@ def atomic_replace(path, write_cb):
                     pass
         raise
     return path
+
+
+def _np_dtype(name):
+    """``np.dtype`` for a manifest dtype string.  ml_dtypes names
+    (``bfloat16``, ``float8_e4m3fn``, ...) are only registered with
+    numpy once ml_dtypes (or jax) has been imported — resolve them
+    explicitly so a process that never touched jax, e.g. an offline
+    fsck/CPU tool, can still load such a checkpoint."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, str(name)))
+        except (ImportError, AttributeError):
+            raise MXNetError(
+                "checkpoint dtype %r is not constructible on this host "
+                "(ml_dtypes unavailable?)" % (name,)) from None
 
 
 def _sha256_file(path):
@@ -234,9 +260,20 @@ class CheckpointManager:
         return 1
 
     def _barrier(self):
+        """Rendezvous every writer around the commit.  A dist kvstore
+        supplies a bounded barrier; the coordinator-env multi-process
+        mode (``MXNET_COORDINATOR``/``MXNET_NUM_WORKERS`` with no
+        kvstore) syncs through jax instead — without one, rank 0 could
+        publish a manifest missing peer shards, and a later load would
+        quarantine files the peers were still writing."""
         kv = self.kvstore
         if kv is not None and getattr(kv, "_is_dist", False):
             kv.barrier()
+            return
+        if self._num_workers() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mxtpu-ckpt-commit")
 
     # -- paths ----------------------------------------------------------
     def _params_path(self, epoch):
@@ -305,16 +342,18 @@ class CheckpointManager:
         return epoch
 
     def _async_eligible(self):
-        """Async writes only without a dist store: the commit path
-        barriers, and a barrier from a background thread would race the
-        training step's own collectives."""
+        """Async writes only in a single-process run: the commit path
+        barriers (dist kvstore or the coordinator-env jax sync), and a
+        barrier from a background thread would race the training step's
+        own collectives."""
         kv = self.kvstore
-        if kv is None or not getattr(kv, "_is_dist", False):
+        if (kv is None or not getattr(kv, "_is_dist", False)) and \
+                self._num_workers() <= 1:
             return True
         if not self._warned_async_dist:
             self._warned_async_dist = True
             logger.warning(
-                "MXNET_CKPT_ASYNC requested under a distributed kvstore; "
+                "MXNET_CKPT_ASYNC requested in a multi-process run; "
                 "falling back to synchronous checkpoint writes (the "
                 "commit barrier cannot run off-thread)")
         return False
@@ -388,6 +427,8 @@ class CheckpointManager:
         from .testing import faults
 
         epoch = snap["epoch"]
+        sidecar = {"rank": snap["rank"], "file": None, "sha256": None,
+                   "bytes": 0, "pieces": {}}
         if snap["pieces"]:
             shard_path = self._shard_path(epoch, snap["rank"])
             digest = {}
@@ -413,8 +454,12 @@ class CheckpointManager:
                        "sha256": digest["sha256"],
                        "bytes": digest["bytes"],
                        "pieces": snap["piece_map"]}
-            atomic_replace(self._sidecar_path(epoch, snap["rank"]),
-                           lambda tmp: _write_json(tmp, sidecar))
+        # the sidecar is written even when this rank owns no pieces: a
+        # re-save of the same epoch tag after an elastic topology change
+        # must overwrite the rank's previous sidecar, or rank 0 would
+        # merge the stale pieces into the new manifest
+        atomic_replace(self._sidecar_path(epoch, snap["rank"]),
+                       lambda tmp: _write_json(tmp, sidecar))
         self._barrier()
         if snap["rank"] == 0:
             if snap["symbol_json"] is not None:
@@ -436,25 +481,52 @@ class CheckpointManager:
                 "have_states": states_entry is not None,
                 "num_processes": snap["nproc"],
                 "params": snap["params_meta"],
-                "shards": self._merge_sidecars(epoch),
+                "shards": self._merge_sidecars(epoch, snap["nproc"]),
                 "states": states_entry}
             atomic_replace(self._manifest_path(epoch),
                            lambda tmp: _write_json(tmp, manifest))
             self._gc()
         self._barrier()
 
-    def _merge_sidecars(self, epoch):
-        """Collect every rank's sidecar for ``epoch`` (shared-filesystem
-        contract, same as the v1 rank-0-writes protocol)."""
+    def _merge_sidecars(self, epoch, nproc):
+        """Merge the sidecars of ranks ``< nproc`` for ``epoch``
+        (shared-filesystem contract, same as the v1 rank-0-writes
+        protocol).  Leftovers from an EARLIER save of the same epoch tag
+        under a different topology — higher-rank sidecars/shards from a
+        larger pod preempted mid-epoch, or a shard no fresh sidecar
+        references — are deleted before the manifest publishes: merging
+        them would let stale parameter windows shadow freshly-saved data
+        on restore."""
         pat = re.compile(re.escape(self.prefix_name) +
-                         r"-%04d\.shard(\d+)\.json$" % epoch)
-        shards = []
+                         r"-%04d\.shard(\d+)\.(json|params)$" % epoch)
+        entries = []
         for name in sorted(os.listdir(self.directory)):
-            if not pat.match(name):
+            m = pat.match(name)
+            if m:
+                entries.append((name, int(m.group(1)), m.group(2)))
+        sidecars = []
+        for name, rank, kind in entries:
+            if kind != "json" or rank >= nproc:
                 continue
             with open(os.path.join(self.directory, name)) as f:
-                shards.append(json.load(f))
-        return shards
+                sidecars.append(json.load(f))
+        sidecars.sort(key=lambda s: int(s.get("rank", 0)))
+        merged = [s for s in sidecars if s.get("file")]
+        live = set(s["file"] for s in merged)
+        live.update(name for name, rank, kind in entries
+                    if kind == "json" and rank < nproc)
+        stale = [name for name, rank, kind in entries if name not in live]
+        for name in stale:
+            try:
+                os.remove(os.path.join(self.directory, name))
+            except OSError:
+                pass
+        if stale:
+            logger.warning(
+                "checkpoint epoch %d: removed %d stale shard file(s) left "
+                "by an earlier save of the same tag (current topology: %d "
+                "writer(s)): %s", epoch, len(stale), nproc, stale)
+        return merged
 
     # -- legacy v1 writes -----------------------------------------------
     def _save_v1(self, module, epoch, nbatch, symbol, arg_params,
@@ -679,10 +751,18 @@ class CheckpointManager:
             total = 1
             for d in meta["shape"]:
                 total *= int(d)
-            if covered.get(key, 0) < total:
+            n = covered.get(key, 0)
+            if n < total:
                 problems.append(
                     "param %s incomplete: %d of %d elements present"
-                    % (key, covered.get(key, 0), total))
+                    % (key, n, total))
+            elif n > total:
+                # a valid save tiles each param exactly once; extra
+                # elements mean overlapping windows, i.e. stale shards
+                # from another topology's save of the same epoch tag
+                problems.append(
+                    "param %s over-covered: %d elements for %d (stale or "
+                    "overlapping shard pieces)" % (key, n, total))
         return problems
 
     def _quarantine(self, epoch, problems):
@@ -764,6 +844,10 @@ class CheckpointManager:
         topology used."""
         import numpy as np
 
+        try:  # bf16/fp8 shards need the extension dtypes registered
+            import ml_dtypes  # noqa: F401
+        except ImportError:
+            pass
         arrays = {}
         for shard in manifest.get("shards") or []:
             path = os.path.join(self.directory, shard["file"])
@@ -780,13 +864,19 @@ class CheckpointManager:
                     key, idx = info["param"], info["index"]
                     meta = manifest["params"][key]
                     piece = np.asarray(f[pkey])
+                    want = _np_dtype(meta["dtype"])
+                    if piece.dtype != want and \
+                            piece.dtype.itemsize == want.itemsize:
+                        # npz stores extension dtypes (bfloat16, fp8)
+                        # as raw void bytes; reinterpret, don't cast
+                        piece = piece.view(want)
                     if idx is None:
                         arrays[key] = piece
                         continue
                     dst = arrays.get(key)
                     if dst is None:
                         dst = np.zeros(tuple(meta["shape"]),
-                                       dtype=meta["dtype"])
+                                       dtype=_np_dtype(meta["dtype"]))
                         arrays[key] = dst
                     dst[tuple(slice(int(a), int(b)) for a, b in idx)] = \
                         piece

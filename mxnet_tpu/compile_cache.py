@@ -182,6 +182,7 @@ def sweep_cache(cache_dir=None, max_bytes=None):
         return len(entries), total
     entries.sort(key=lambda e: e[2])  # oldest last-use first
     removed = 0
+    freed = 0
     for path, size, _ in entries:
         if total <= max_bytes:
             break
@@ -191,12 +192,13 @@ def sweep_cache(cache_dir=None, max_bytes=None):
             continue
         total -= size
         removed += 1
+        freed += size
         _state["evictions"] += 1
         _state["evicted_bytes"] += size
     if removed:
         logger.info("compile cache sweep: evicted %d entries (%d bytes "
                     "over the %d-byte cap) from %s", removed,
-                    _state["evicted_bytes"], max_bytes, cache_dir)
+                    freed, max_bytes, cache_dir)
     return len(entries) - removed, total
 
 

@@ -508,6 +508,159 @@ def test_assemble_from_multi_host_shards(tmp_path):
         mgr.load()
 
 
+def test_resave_smaller_topology_drops_stale_shards(tmp_path):
+    """Preempt -> shrink -> re-preempt: a 4-proc run saved epoch 1, the
+    2-proc (here: 1-proc) resume re-saves the SAME epoch tag.  The
+    higher-rank shard/sidecar leftovers must be deleted before the new
+    manifest publishes — merging them would let the stale windows shadow
+    the freshly-saved parameters on restore."""
+    import hashlib
+
+    d = str(tmp_path)
+    stale = np.full((8, 8), 99.0, dtype="float32")
+    spath = os.path.join(d, "m-0001.shard2.params")
+    os.makedirs(d, exist_ok=True)
+    with open(spath, "wb") as f:
+        np.savez(f, **{"arg:fc1_weight/0": stale})
+    sidecar = {"rank": 2, "file": "m-0001.shard2.params",
+               "sha256": hashlib.sha256(
+                   open(spath, "rb").read()).hexdigest(),
+               "bytes": os.path.getsize(spath),
+               "pieces": {"arg:fc1_weight/0": {
+                   "param": "arg:fc1_weight",
+                   "index": [[0, 8], [0, 8]]}}}
+    with open(os.path.join(d, "m-0001.shard2.json"), "w") as f:
+        json.dump(sidecar, f)
+
+    args = _args()
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=_mlp(), arg_params=args, aux_params={}, epoch=1)
+
+    names = os.listdir(d)
+    assert "m-0001.shard2.params" not in names
+    assert "m-0001.shard2.json" not in names
+    with open(os.path.join(d, "m-0001.manifest.json")) as f:
+        man = json.load(f)
+    assert [s["rank"] for s in man["shards"]] == [0]
+    state = mgr.load()
+    np.testing.assert_array_equal(
+        state.arg_params["fc1_weight"].asnumpy(),
+        args["fc1_weight"].asnumpy())
+
+
+def test_verify_rejects_overlapping_coverage(tmp_path):
+    """Exact-tiling check: two shards whose windows overlap (the
+    signature of stale shards merged into a manifest) must fail
+    verification instead of silently overwriting each other."""
+    import hashlib
+
+    d = str(tmp_path)
+    full = np.arange(16 * 4, dtype="float32").reshape(16, 4)
+    shards_meta = []
+    for rank, (lo, hi) in enumerate(((0, 10), (6, 16))):  # overlap 6:10
+        shard = os.path.join(d, "m-0001.shard%d.params" % rank)
+        with open(shard, "wb") as f:
+            np.savez(f, **{"arg:w/0": full[lo:hi]})
+        shards_meta.append({
+            "rank": rank, "file": os.path.basename(shard),
+            "sha256": hashlib.sha256(
+                open(shard, "rb").read()).hexdigest(),
+            "bytes": os.path.getsize(shard),
+            "pieces": {"arg:w/0": {"param": "arg:w",
+                                   "index": [[lo, hi], [0, 4]]}}})
+    manifest = {"format": 2, "epoch": 1, "nbatch": 0, "num_update": 0,
+                "have_states": False, "num_processes": 2,
+                "params": {"arg:w": {"shape": [16, 4],
+                                     "dtype": "float32", "spec": None}},
+                "shards": shards_meta, "states": None}
+    with open(os.path.join(d, "m-0001.manifest.json"), "w") as f:
+        json.dump(manifest, f)
+
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    with pytest.raises(ckpt.CorruptCheckpoint, match="over-covered"):
+        mgr.load(epoch=1)
+    assert mgr.epochs() == []  # quarantined
+
+
+def test_coordinator_mode_barrier_and_async_fallback(tmp_path,
+                                                     monkeypatch):
+    """Multi-process without a dist kvstore (MXNET_COORDINATOR /
+    MXNET_NUM_WORKERS): the commit must still rendezvous — via the jax
+    global-device sync — and async writes must fall back to synchronous
+    (the off-thread barrier would race the step's collectives)."""
+    from jax.experimental import multihost_utils
+
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m",
+                                 async_writes=True)
+    monkeypatch.setattr(mgr, "_num_workers", lambda: 2)
+    assert not mgr._async_eligible()
+
+    syncs = []
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: syncs.append(name))
+    mgr.save(symbol=_mlp(), arg_params=_args(), aux_params={}, epoch=1)
+    assert mgr._writer is None          # ran synchronously
+    assert len(syncs) == 2              # pre-merge + post-publish
+    assert mgr.load().epoch == 1
+
+
+def test_bf16_checkpoint_roundtrip_whole_and_windowed(tmp_path):
+    """npz stores extension dtypes as raw void bytes; both assembly
+    paths (whole-array piece and windowed pieces into a zeros buffer)
+    must reinterpret them back to the manifest dtype."""
+    import hashlib
+
+    import ml_dtypes
+
+    bf = np.arange(32, dtype=ml_dtypes.bfloat16).reshape(4, 8)
+    d = str(tmp_path / "whole")
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mgr.save(symbol=None, arg_params={"w": mx.nd.array(np.asarray(bf))},
+             aux_params={}, epoch=1)
+    with open(os.path.join(d, "m-0001.manifest.json")) as f:
+        assert json.load(f)["params"]["arg:w"]["dtype"] == "bfloat16"
+    got = ckpt.CheckpointManager(d, prefix="m").load() \
+        .arg_params["w"].asnumpy()
+    np.testing.assert_array_equal(np.asarray(got, "float32"),
+                                  np.asarray(bf, "float32"))
+
+    # windowed: two half-array pieces land in a zeros(bfloat16) buffer
+    d = str(tmp_path / "windowed")
+    os.makedirs(d)
+    shards_meta = []
+    for rank, (lo, hi) in enumerate(((0, 2), (2, 4))):
+        shard = os.path.join(d, "m-0001.shard%d.params" % rank)
+        with open(shard, "wb") as f:
+            np.savez(f, **{"arg:w/0": bf[lo:hi]})
+        shards_meta.append({
+            "rank": rank, "file": os.path.basename(shard),
+            "sha256": hashlib.sha256(
+                open(shard, "rb").read()).hexdigest(),
+            "bytes": os.path.getsize(shard),
+            "pieces": {"arg:w/0": {"param": "arg:w",
+                                   "index": [[lo, hi], [0, 8]]}}})
+    manifest = {"format": 2, "epoch": 1, "nbatch": 0, "num_update": 0,
+                "have_states": False, "num_processes": 2,
+                "params": {"arg:w": {"shape": [4, 8],
+                                     "dtype": "bfloat16", "spec": None}},
+                "shards": shards_meta, "states": None}
+    with open(os.path.join(d, "m-0001.manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    got = ckpt.CheckpointManager(d, prefix="m").load() \
+        .arg_params["w"].asnumpy()
+    np.testing.assert_array_equal(np.asarray(got, "float32"),
+                                  np.asarray(bf, "float32"))
+
+
+def test_np_dtype_resolves_ml_dtypes_names():
+    import ml_dtypes
+
+    assert ckpt._np_dtype("float32") == np.dtype("float32")
+    assert ckpt._np_dtype("bfloat16") == np.dtype(ml_dtypes.bfloat16)
+    with pytest.raises(MXNetError, match="not constructible"):
+        ckpt._np_dtype("no_such_dtype")
+
+
 @pytest.mark.slow
 def test_elastic_two_proc_save_one_proc_restore(tmp_path):
     """Acceptance criterion: a checkpoint saved by a 2-process pod
