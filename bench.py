@@ -9,17 +9,23 @@ and %MFU against the chip's bf16 peak alongside the reference-comparable
 img/s metric.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-Partial snapshots stream to stderr after each phase, and a watchdog
-(``--watchdog SEC`` / env ``MXTPU_BENCH_WATCHDOG``, default 900, 0 to
-disable) prints the partial line to stdout and exits if the run wedges.
+Partial snapshots stream to stderr after each phase, and the shared
+``bench_util`` watchdog (``--watchdog SEC`` / env
+``MXNET_BENCH_WATCHDOG``, default 420, 0 to disable) prints the partial
+line to stdout and exits 0 if the run wedges — so a hung backend init
+still yields a parseable artifact instead of rc=124 with nothing.
 
-Usage: bench.py [batch] [--fp32] [--sweep] [--piped (opt-in long run)]
-                [--watchdog SEC]
+The default sweep is sized to finish inside the watchdog: ResNet-50 at
+one batch size plus the transformer MFU row.  The AlexNet/Inception-v3
+flagship rows are opt-in via ``--all-models`` (they add two full
+compile+measure cycles), ``--sweep`` adds the ResNet batch sweep, and
+``--piped`` the record-fed epoch run.
+
+Usage: bench.py [batch] [--fp32] [--sweep] [--all-models]
+                [--piped (opt-in long run)] [--watchdog SEC]
 """
 import json
-import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, ".")
@@ -37,26 +43,6 @@ def _emit_partial():
     final JSON line)."""
     print(json.dumps({"partial": True, **_RESULT}), file=sys.stderr,
           flush=True)
-
-
-def _arm_watchdog(seconds):
-    """If the run wedges (a hung device tunnel mid-phase), print the
-    partial result line to stdout and hard-exit instead of producing
-    nothing."""
-    def fire():
-        _RESULT["partial"] = True
-        _RESULT["watchdog_timeout_sec"] = seconds
-        try:
-            _RESULT.update(bench_util.compile_summary())
-        except Exception:
-            pass
-        print(json.dumps(_RESULT), flush=True)
-        os._exit(2)
-
-    t = threading.Timer(seconds, fire)
-    t.daemon = True
-    t.start()
-    return t
 
 # fwd+bwd model FLOPs per 224x224 image for ResNet-50 under the standard
 # MFU convention (multiply-add = 2 FLOPs, the same convention as the
@@ -241,10 +227,7 @@ def main():
         i = argv.index("--watchdog")
         watchdog_s = float(argv[i + 1])
         del argv[i:i + 2]
-    if watchdog_s is None:
-        watchdog_s = float(os.environ.get("MXTPU_BENCH_WATCHDOG", "900"))
-    if watchdog_s > 0:
-        _arm_watchdog(watchdog_s)
+    bench_util.arm_watchdog(_RESULT, watchdog_s)
     bench_util.arm_budget(_RESULT)
 
     import jax
@@ -301,11 +284,32 @@ def main():
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     })
     _emit_partial()
+    # secondary metric: the MXU-bound transformer workload, where the
+    # framework's compute ceiling shows (ResNet-50@224 is HBM-bound on
+    # this hardware generation — see README).  Runs EARLY — right after
+    # the headline metric — so the MFU row the roadmap tracks survives
+    # a watchdog/budget cut that strands the longer optional phases.
+    # Skipped under --fp32.
+    if not fp32 and "--resnet-only" not in sys.argv:
+        try:
+            import bench_transformer
+
+            tf = bench_transformer.measure(argv=[])
+            result["transformer_tokens_per_sec"] = tf["value"]
+            result["transformer_mfu_pct"] = tf["mfu_pct"]
+            result["transformer_model"] = tf["model"]
+            result["transformer_attn_peak_bytes"] = \
+                tf.get("attn_peak_bytes")
+        except Exception as exc:  # keep the primary metric robust
+            result["transformer_error"] = str(exc)[:200]
+        _emit_partial()
     # the BASELINE distributed-scaling flagships (docs/how_to/
     # perf.md:157-167: alexnet bs256 483.37 img/s, inception-v3 bs32
     # 29.62 img/s on K80) — single-chip rows so BENCH anchors more than
-    # one model family.  Skipped under --fp32/--resnet-only.
-    if not fp32 and "--resnet-only" not in sys.argv:
+    # one model family.  OPT-IN via --all-models: two extra
+    # compile+measure cycles do not fit the default watchdog budget
+    # alongside the headline rows (the round-5 lesson).
+    if not fp32 and "--all-models" in sys.argv:
         try:
             from mxnet_tpu.models import alexnet, inception_v3
 
@@ -366,19 +370,6 @@ def main():
             result["piped_error"] = str(exc)[:200]
         _emit_partial()
 
-    # secondary metric: the MXU-bound transformer workload, where the
-    # framework's compute ceiling shows (ResNet-50@224 is HBM-bound on
-    # this hardware generation — see README).  Skipped under --fp32.
-    if not fp32 and "--resnet-only" not in sys.argv:
-        try:
-            import bench_transformer
-
-            tf = bench_transformer.measure(argv=[])
-            result["transformer_tokens_per_sec"] = tf["value"]
-            result["transformer_mfu_pct"] = tf["mfu_pct"]
-            result["transformer_model"] = tf["model"]
-        except Exception as exc:  # keep the primary metric robust
-            result["transformer_error"] = str(exc)[:200]
     result["step_s"] = round(batch / img_s, 4) if img_s else None
     result.update(bench_util.compile_summary())
     print(json.dumps(result))

@@ -190,9 +190,10 @@ def measure_ckpt_save(sym, X, y, batch, saves=5):
 
 
 def main():
-    # budget timer arms BEFORE the first jax/numpy touch: backend init
-    # can hang, and an armed budget turns that into valid partial JSON
-    # + exit 0 instead of the driver's rc=124/parsed=null
+    # watchdog + budget timers arm BEFORE the first jax/numpy touch:
+    # backend init can hang, and an armed timer turns that into valid
+    # partial JSON + exit 0 instead of the driver's rc=124/parsed=null
+    bench_util.arm_watchdog(_RESULT)
     bench_util.arm_budget(_RESULT)
 
     import numpy as np

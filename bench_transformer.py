@@ -73,6 +73,15 @@ def measure(argv=None):
     # persistent cache on a repeat run)
     compile_s = bench_util.timed_compile(step, shapes, _RESULT)
     _RESULT["compile_s"] = round(compile_s, 3)
+    # attention peak-memory visibility: the compiled step's temp-buffer
+    # peak (memory_analysis, the examples/memcost harness) is dominated
+    # by attention intermediates at these shapes, so this one number
+    # makes the O(T^2) -> O(T*block) flash drop visible per-PR
+    try:
+        mem = step._aot.memory_analysis()
+        _RESULT["attn_peak_bytes"] = int(mem.temp_size_in_bytes)
+    except Exception:
+        _RESULT["attn_peak_bytes"] = None
     params, aux, states = step.init_state(shapes)
     rng = jax.random.PRNGKey(0)
     toks = jnp.asarray(
@@ -129,6 +138,10 @@ def measure(argv=None):
                            if achieved is not None else None,
         "mfu_pct": round(100 * achieved / peak, 2)
                    if peak and achieved is not None else None,
+        # 6*P*tokens (matmul stack) + 12*L*B*T^2*d_model (attention
+        # score/value contractions, MAC=2) — the honest numerator at
+        # long T, where the quadratic term is a double-digit share
+        "flops_accounting": None if moe else "6P_tokens+attn_12LBT2D",
         "precision": "bf16+fp32-master",
         "device": kind,
     })
@@ -136,9 +149,11 @@ def measure(argv=None):
 
 
 def main():
-    # budget arms before measure()'s jax imports: a hung backend init
-    # still yields valid partial JSON + exit 0 (no module-level jax
-    # import exists in this file, so arming here is already first-touch)
+    # watchdog + budget arm before measure()'s jax imports: a hung
+    # backend init still yields valid partial JSON + exit 0 (no
+    # module-level jax import exists in this file, so arming here is
+    # already first-touch)
+    bench_util.arm_watchdog(_RESULT)
     bench_util.arm_budget(_RESULT)
     result = measure()
     result.update(bench_util.compile_summary())

@@ -1,13 +1,24 @@
-"""Shared bench-script plumbing: wall-clock budget + compile accounting.
+"""Shared bench-script plumbing: budget + watchdog + compile accounting.
 
 Every bench script prints ONE final JSON line on stdout.  Before this
 module existed, a harness timeout (rc 124) killed the process mid-phase
 and the artifact parsed as null — rounds 1-5 of BENCH/MULTICHIP all died
-that way.  ``arm_budget`` bounds the run from the inside instead:
-``MXNET_BENCH_BUDGET_S`` seconds after arming, the shared result dict —
-filled phase by phase by the script — is printed as the final stdout
-line (marked ``"partial": true``) and the process exits 0, so a budgeted
-run still produces a parseable artifact with whatever phases finished.
+that way.  Two timers bound the run from the inside instead:
+
+* ``arm_budget`` — ``MXNET_BENCH_BUDGET_S`` seconds after arming, the
+  shared result dict (filled phase by phase by the script) is printed
+  as the final stdout line (marked ``"partial": true``) and the process
+  exits 0.  Opt-in: no budget env, no timer.
+* ``arm_watchdog`` — the always-on wedge guard (default 420 s,
+  ``MXNET_BENCH_WATCHDOG`` / ``--watchdog`` to change, 0 disables): if
+  the run is still going when it fires — a hung backend init, a stale
+  TPU lockfile, a wedged device tunnel — the same partial line is
+  emitted and the process exits 0.  Round 5 regressed exactly here:
+  the old per-script watchdog imported mxnet_tpu from its timer thread,
+  which deadlocks on the interpreter's import lock when the main thread
+  is stuck inside ``import jax``, so the harness timeout (rc 124) won
+  and the artifact parsed as null.  Both timers now share one emitter
+  that touches already-imported modules only.
 
 ``compile_summary`` splits compile time out of the measured rates: the
 scripts AOT-compile through ``TrainStep.compile``/``Module.fit`` warmup,
@@ -32,6 +43,41 @@ def budget_seconds():
     return 0.0
 
 
+def watchdog_seconds():
+    """The wedge-guard timeout (default 420 s; 0 disables).  Sized to
+    beat the harness's external timeout: an internally-bounded run
+    emits partial JSON and exits 0, an externally-killed one is rc=124
+    with nothing on stdout."""
+    for key in ("MXTPU_BENCH_WATCHDOG", "MXNET_BENCH_WATCHDOG"):
+        raw = os.environ.get(key)
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+    return 420.0
+
+
+def _emit_and_exit(result, extra):
+    """Finalize ``result`` from a timer thread and hard-exit 0.
+
+    MUST NOT import anything: the main thread may be stuck inside
+    ``import jax`` holding the import lock, and a blocked emitter is
+    exactly the round-5 no-artifact failure.  Compile stats are read
+    only when their modules already finished importing."""
+    result.update(extra)
+    try:
+        if "mxnet_tpu.profiler" in sys.modules and \
+                "mxnet_tpu.compile_cache" in sys.modules:
+            result.update(compile_summary())
+    except Exception:
+        pass
+    print(json.dumps(result), flush=True)
+    # stdout is line-buffered under pipes; make sure the line left
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def arm_budget(result, seconds=None):
     """Arm the wall-clock budget for this bench process.
 
@@ -44,26 +90,27 @@ def arm_budget(result, seconds=None):
         seconds = budget_seconds()
     if seconds <= 0:
         return None
+    t = threading.Timer(seconds, _emit_and_exit,
+                        (result, {"partial": True, "budget_s": seconds}))
+    t.daemon = True
+    t.start()
+    return t
 
-    def fire():
-        result["partial"] = True
-        result["budget_s"] = seconds
-        try:
-            # only read compile stats when the modules finished importing:
-            # the budget now arms BEFORE the first jax touch, and if the
-            # main thread hung inside `import jax` this thread would
-            # deadlock on the per-module import lock instead of emitting
-            if "mxnet_tpu.profiler" in sys.modules and \
-                    "mxnet_tpu.compile_cache" in sys.modules:
-                result.update(compile_summary())
-        except Exception:
-            pass
-        print(json.dumps(result), flush=True)
-        # stdout is line-buffered under pipes; make sure the line left
-        sys.stdout.flush()
-        os._exit(0)
 
-    t = threading.Timer(seconds, fire)
+def arm_watchdog(result, seconds=None):
+    """Arm the always-on wedge guard (call BEFORE the first jax touch).
+
+    Unlike the opt-in budget, this fires even with no budget configured:
+    ``seconds`` (default :func:`watchdog_seconds`) after arming, the
+    partial result line is printed and the process exits 0.  Returns the
+    Timer, or None when disabled (0)."""
+    if seconds is None:
+        seconds = watchdog_seconds()
+    if seconds <= 0:
+        return None
+    t = threading.Timer(
+        seconds, _emit_and_exit,
+        (result, {"partial": True, "watchdog_timeout_sec": seconds}))
     t.daemon = True
     t.start()
     return t

@@ -25,7 +25,8 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
-def measure(remat, model, num_layers, batch, image):
+def measure(remat, model, num_layers, batch, image,
+            lm_layers=8, seq_len=1024, d_model=1024):
     import jax
     import jax.numpy as jnp
 
@@ -33,11 +34,12 @@ def measure(remat, model, num_layers, batch, image):
     from mxnet_tpu.models import resnet, transformer
 
     if model == "transformer":
-        sym = transformer.get_symbol(vocab_size=8192, num_layers=8,
-                                     d_model=1024, num_heads=16,
-                                     seq_len=1024)
-        shapes = {"data": (batch, 1024),
-                  "softmax_label": (batch, 1024)}
+        sym = transformer.get_symbol(vocab_size=8192,
+                                     num_layers=lm_layers,
+                                     d_model=d_model, num_heads=16,
+                                     seq_len=seq_len)
+        shapes = {"data": (batch, seq_len),
+                  "softmax_label": (batch, seq_len)}
     else:
         sym = resnet.get_symbol(num_classes=1000,
                                 num_layers=num_layers,
@@ -69,7 +71,8 @@ def main(args):
     for name, remat in (("none", None), ("full", "full"),
                         ("dots_saveable", "dots_saveable")):
         m = measure(remat, args.model, args.num_layers, args.batch,
-                    args.image)
+                    args.image, lm_layers=args.lm_layers,
+                    seq_len=args.seq_len, d_model=args.d_model)
         rows.append((name, m))
         print("remat=%-14s temp(activations) %.1f MB  peak %.1f MB"
               % (name, m["temp_mb"], m["peak_mb"]))
@@ -88,4 +91,9 @@ if __name__ == "__main__":
     p.add_argument("--num-layers", type=int, default=50)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--image", type=int, default=224)
+    # transformer-config overrides (defaults = the measured v5e study;
+    # CI shrinks them — the contract is policy coverage, not MBs)
+    p.add_argument("--lm-layers", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--d-model", type=int, default=1024)
     main(p.parse_args())

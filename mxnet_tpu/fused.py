@@ -167,19 +167,36 @@ class TrainStep:
         clip_gnorm = optimizer.clip_global_norm
         rescale = optimizer.rescale_grad
 
+        # compute/collective overlap: under a pure data-parallel mesh
+        # the gradient reduction runs as explicit bucketed all-reduces
+        # (shard_map) issued in reverse production order so they hide
+        # under backward compute; the latency-hiding scheduler flags
+        # arm here (best effort — first TrainStep in the process, before
+        # the backend initializes)
+        from .parallel import overlap as _overlap
+
+        _overlap.arm_latency_hiding()
+        ddp_ax = _overlap.ddp_axis(mesh, batch_sharding_axis,
+                                   param_sharding)
+        ddp_bucket = _overlap.grad_bucket_bytes()
+        # reverse graph-construction order approximates the order
+        # backward produces gradients in
+        ddp_order = tuple(reversed(self.param_names))
+        self.grad_overlap_axis = ddp_ax
+
         def cast_compute(x):
             return x.astype(cdtype) if jnp.issubdtype(
                 x.dtype, jnp.floating) else x
 
         def core_step(params, aux, states, batch, rng, lr, t, hstate):
-            def loss_fn(p):
+            def loss_fn(p, b, r):
                 args = dict(p)
-                args.update(batch)
+                args.update(b)
                 a = aux
                 if cdtype is not None:
                     args = {k: cast_compute(v) for k, v in args.items()}
                     a = {k: cast_compute(v) for k, v in aux.items()}
-                outs, new_aux = fwd_fn(args, a, rng)
+                outs, new_aux = fwd_fn(args, a, r)
                 if cdtype is not None:
                     new_aux = {k: v.astype(aux[k].dtype)
                                for k, v in new_aux.items()}
@@ -190,8 +207,19 @@ class TrainStep:
                     loss = loss * hstate["loss_scale"]
                 return loss, (outs, new_aux)
 
-            (loss, (outs, new_aux)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            vag = None
+            if ddp_ax is not None:
+                # None = this trace can't run the DDP path (indivisible
+                # batch, non-batch-leading outputs); GSPMD fallback below
+                vag = _overlap.ddp_value_and_grad(
+                    loss_fn, params, batch, rng, mesh, ddp_ax,
+                    frozen=frozen, order=ddp_order,
+                    bucket_bytes=ddp_bucket)
+            if vag is None:
+                vag = jax.value_and_grad(
+                    lambda p: loss_fn(p, batch, rng),
+                    has_aux=True)(params)
+            (loss, (outs, new_aux)), grads = vag
             live = [k for k in sorted(grads) if k not in frozen]
             if scaler is not None:
                 inv = 1.0 / hstate["loss_scale"]

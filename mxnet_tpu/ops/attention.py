@@ -1,0 +1,341 @@
+"""Blockwise (flash-style) dot-product attention.
+
+The reference (0.11, pre-transformer) has nothing to port here; this
+module is the TPU-first kernel behind ``MultiHeadAttention`` and the
+per-hop inner kernel of ring attention (``parallel/sequence.py``).
+
+Why it exists: the materialized-scores path builds an ``(n, h, T, T)``
+fp32 tensor that XLA's fusion heuristics will not cross ("Operator
+Fusion in XLA", arXiv 2301.13062) — at the bench shape (8L-d2048-T1024)
+it is the single largest live buffer in the train step and caps both
+sequence length and MFU.  The flash path tiles the key/value sequence
+into blocks and keeps online-softmax statistics (running max ``m`` and
+denominator ``l``) in fp32, so peak attention memory is O(T·block)
+instead of O(T²), with a ``jax.custom_vjp`` backward that *recomputes*
+each block's probabilities from the saved logsumexp instead of storing
+them (Dao et al., FlashAttention, 2022 — public technique).
+
+Three implementations, selected by ``MXNET_ATTN_IMPL``:
+
+* ``reference`` — the original materialized path (exact softmax over
+  the full score matrix).  Ground truth for tests.
+* ``flash`` — the pure-``lax`` blockwise kernel below.  Runs on every
+  backend, so the CPU tier-1 rig exercises the same code path that
+  ships on TPU.
+* ``auto`` (default) — on TPU, try the Pallas fused flash kernel
+  (``jax.experimental.pallas.ops.tpu.flash_attention``) and fall back
+  to the ``lax`` blockwise kernel when the shape/backend does not
+  qualify; elsewhere, the ``lax`` blockwise kernel.
+
+The per-block accumulation (:func:`attend_block` /
+:func:`online_block_merge`) is shared with ring attention: each ring
+hop is exactly one K/V-block visit with positions recovered from the
+hop index, so sequence parallelism and the single-chip kernel stay one
+implementation.
+
+Gradient contract: the custom VJP is linear in the incoming cotangent
+(``d(q,k,v)`` scale with ``g``), so the dynamic loss scale riding the
+loss-head cotangent (PR 3) flows through unchanged — same semantics the
+materialized path gets from autodiff.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, get_env
+
+__all__ = ["attention_impl", "attention_block_size", "dot_product_attention",
+           "flash_attention", "reference_attention", "attend_block",
+           "online_block_merge", "finalize_attention"]
+
+_IMPLS = ("auto", "flash", "reference")
+
+
+def attention_impl():
+    """Resolve ``MXNET_ATTN_IMPL`` (``auto`` | ``flash`` | ``reference``).
+
+    Read at trace time: jitted programs bake in whichever implementation
+    was active when they were traced (the registry's imperative-invoke
+    cache keys on attrs/shapes, not env) — tests that need to force a
+    path per-call should pass the ``attn_impl`` op attr instead.
+    """
+    impl = get_env("MXNET_ATTN_IMPL", "auto").strip().lower()
+    if impl not in _IMPLS:
+        raise MXNetError("MXNET_ATTN_IMPL=%r not in %s" % (impl, _IMPLS))
+    return impl
+
+
+def attention_block_size():
+    """K/V block length for the blockwise kernel (``MXNET_ATTN_BLOCK``)."""
+    block = get_env("MXNET_ATTN_BLOCK", 128)
+    if block < 1:
+        raise MXNetError("MXNET_ATTN_BLOCK must be >= 1, got %d" % block)
+    return block
+
+
+# ---------------------------------------------------------------------------
+# shared online-softmax inner kernel (also the ring-attention hop kernel)
+# ---------------------------------------------------------------------------
+
+def online_block_merge(acc, m, l, scores, v):
+    """One flash-attention accumulation step.
+
+    acc: (..., Tq, D) weighted-value accumulator; m: (..., Tq, 1) running
+    max; l: (..., Tq, 1) running denominator; scores: (..., Tq, Tk) this
+    block's logits (fp32, masked entries at ``-inf``); v: (..., Tk, D).
+    Returns updated (acc, m, l).
+    """
+    block_max = jnp.max(scores, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, block_max)
+    # guard against all--inf rows (fully masked block): exp(-inf - -inf)
+    new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    correction = jnp.exp(m - new_m_safe)
+    correction = jnp.where(jnp.isfinite(m), correction, 0.0)
+    p = jnp.exp(scores - new_m_safe)
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    new_l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    new_acc = acc * correction + jnp.einsum("...qk,...kd->...qd", p, v)
+    return new_acc, new_m, new_l
+
+
+def attend_block(q32, kb, vb, acc, m, l, q_pos=None, k_pos=None,
+                 causal=False, kv_valid=None):
+    """Visit one K/V block: score, mask, merge into the running stats.
+
+    ``q32`` is the full (pre-scaled, fp32) query; ``kb``/``vb`` one key/
+    value block.  ``q_pos``/``k_pos`` are absolute positions (1-D int
+    arrays) used for causal masking — ring attention recovers ``k_pos``
+    from the hop index, the blockwise kernel from the block start.
+    ``kv_valid`` masks padded keys in the (ragged) last block.
+    """
+    scores = jnp.einsum("...qd,...kd->...qk", q32,
+                        kb.astype(jnp.float32))
+    mask = None
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+    if kv_valid is not None:
+        mask = kv_valid if mask is None else mask & kv_valid
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return online_block_merge(acc, m, l, scores,
+                              vb.astype(jnp.float32))
+
+
+def finalize_attention(acc, l):
+    """Normalize the accumulator by the running denominator."""
+    return acc / jnp.maximum(l, 1e-20)
+
+
+# ---------------------------------------------------------------------------
+# reference (materialized) path
+# ---------------------------------------------------------------------------
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """Exact softmax attention over the full (..., Tq, Tk) score matrix.
+
+    The pre-flash ``_multi_head_attention`` body, kept verbatim as the
+    numeric ground truth: scores in fp32, O(T²) peak memory.
+    """
+    t, d = q.shape[-2], q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32)
+    scores = scores * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, k.shape[-2]), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# blockwise flash kernel (pure lax, custom VJP)
+# ---------------------------------------------------------------------------
+
+def _kv_blocks(x, t_pad, block):
+    """(..., T, D) -> (nblk, ..., block, D) scan-ready block stack."""
+    pad = [(0, 0)] * (x.ndim - 2) + [(0, t_pad - x.shape[-2]), (0, 0)]
+    x = jnp.pad(x, pad)
+    x = x.reshape(x.shape[:-2] + (t_pad // block, block, x.shape[-1]))
+    return jnp.moveaxis(x, -3, 0)
+
+
+def _flash_forward(q, k, v, causal, scale, block):
+    """Tiled forward: scan over K/V blocks carrying (acc, m, l) in fp32.
+
+    Returns ``(out, lse)`` where ``lse = m + log l`` is the per-query
+    logsumexp the backward recomputes probabilities from.  Peak live
+    memory is O(T·block) — the (T, T) score matrix never exists.
+    """
+    t, d = q.shape[-2], q.shape[-1]
+    nblk = -(-t // block)
+    t_pad = nblk * block
+    kb = _kv_blocks(k, t_pad, block)
+    vb = _kv_blocks(v, t_pad, block)
+    starts = jnp.arange(nblk) * block
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(t)
+
+    acc0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
+    m0 = jnp.full(q.shape[:-1] + (1,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+
+    def body(carry, xs):
+        acc, m, l = carry
+        kblk, vblk, start = xs
+        k_pos = start + jnp.arange(block)
+        kv_valid = k_pos < t if t_pad != t else None
+        acc, m, l = attend_block(q32, kblk, vblk, acc, m, l,
+                                 q_pos=q_pos, k_pos=k_pos, causal=causal,
+                                 kv_valid=kv_valid)
+        return (acc, m, l), None
+
+    (acc, m, l), _ = lax.scan(body, (acc0, m0, l0), (kb, vb, starts))
+    out = finalize_attention(acc, l).astype(q.dtype)
+    # l > 0 always (row q attends to at least key 0 under causal; all
+    # keys when not), so the log is finite
+    lse = jnp.where(jnp.isfinite(m), m, 0.0) + jnp.log(jnp.maximum(l, 1e-38))
+    return out, lse
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block):
+    """Recompute-based backward: one more scan over K/V blocks.
+
+    Each block's probabilities are rebuilt from ``lse`` (never stored),
+    then ``ds = p * (dp - delta)`` with ``delta = Σ dO·O`` gives the
+    score gradient.  dq accumulates across blocks (carry); dk/dv are
+    per-block (stacked ys).  Linear in ``g`` by construction.
+    """
+    t = q.shape[-2]
+    nblk = -(-t // block)
+    t_pad = nblk * block
+    kb = _kv_blocks(k, t_pad, block)
+    vb = _kv_blocks(v, t_pad, block)
+    starts = jnp.arange(nblk) * block
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(t)
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    def body(dq, xs):
+        kblk, vblk, start = xs
+        kb32 = kblk.astype(jnp.float32)
+        vb32 = vblk.astype(jnp.float32)
+        scores = jnp.einsum("...qd,...kd->...qk", q32, kb32)
+        k_pos = start + jnp.arange(block)
+        mask = q_pos[:, None] >= k_pos[None, :] if causal else None
+        if t_pad != t:
+            valid = k_pos < t
+            mask = valid if mask is None else mask & valid
+        if mask is not None:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        p = jnp.exp(scores - lse)  # masked -> exp(-inf) == 0 exactly
+        dv_blk = jnp.einsum("...qk,...qd->...kd", p, do)
+        dp = jnp.einsum("...qd,...kd->...qk", do, vb32)
+        ds = p * (dp - delta)
+        dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kb32)
+        dk_blk = jnp.einsum("...qk,...qd->...kd", ds, q32)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dk_blk, dv_blk) = lax.scan(body, dq0, (kb, vb, starts))
+
+    def unblocks(blk, like):
+        x = jnp.moveaxis(blk, 0, -3)
+        x = x.reshape(x.shape[:-3] + (t_pad, x.shape[-1]))
+        return x[..., :t, :].astype(like.dtype)
+
+    # scores = (q*scale)·k: d/dq carries the scale factor explicitly,
+    # d/dk already has it through q32
+    dq = (dq * scale).astype(q.dtype)
+    return dq, unblocks(dk_blk, k), unblocks(dv_blk, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _flash_fn(causal, scale, block):
+    """Per-(causal, scale, block) custom-VJP closure.
+
+    ``custom_vjp`` needs the static config out of the traced signature;
+    the cache keeps function identity stable so jit does not re-trace
+    per call.
+    """
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = _flash_forward(q, k, v, causal, scale, block)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _flash_forward(q, k, v, causal, scale, block)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, out, lse = res
+        return _flash_backward(q, k, v, out, lse, g, causal, scale, block)
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block=None):
+    """Blockwise online-softmax attention, O(T·block) peak memory.
+
+    q/k/v: (..., T, D) with identical leading dims (batch, heads are
+    free).  Ragged T is handled by padding the last K/V block and
+    masking the padded keys to ``-inf``.  Differentiable via a
+    recompute-based ``custom_vjp`` (no stored probabilities).
+    """
+    d = q.shape[-1]
+    t = k.shape[-2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if block is None:
+        block = attention_block_size()
+    block = min(block, max(t, 1))
+    return _flash_fn(bool(causal), float(scale), int(block))(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused kernel (TPU) + dispatcher
+# ---------------------------------------------------------------------------
+
+def _pallas_attention(q, k, v, causal, scale):
+    """TPU fused flash kernel (Mosaic).  Raises when unavailable or the
+    shape does not meet the kernel's block constraints — callers fall
+    back to the ``lax`` blockwise path."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as pl_flash)
+
+    if q.ndim != 4:
+        raise MXNetError("pallas flash kernel needs (n, h, T, d) inputs")
+    return pl_flash(q, k, v, causal=causal, sm_scale=scale)
+
+
+def dot_product_attention(q, k, v, causal=True, scale=None, impl=None,
+                          block=None):
+    """Dispatch attention to the implementation ``MXNET_ATTN_IMPL`` (or
+    the explicit ``impl`` argument) selects.
+
+    ``auto`` tries the Pallas fused kernel when tracing for TPU and
+    falls back to the portable ``lax`` blockwise kernel — which is also
+    what ``flash`` forces, so the CPU tier-1 rig and the TPU fallback
+    run identical code.
+    """
+    impl = (impl or attention_impl()).strip().lower()
+    if impl not in _IMPLS:
+        raise MXNetError("attention impl %r not in %s" % (impl, _IMPLS))
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "auto" and jax.default_backend() == "tpu":
+        if scale is None:
+            scale = 1.0 / (q.shape[-1] ** 0.5)
+        try:
+            return _pallas_attention(q, k, v, causal, scale)
+        except Exception:  # unsupported shape/kernel -> portable path
+            pass
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           block=block)

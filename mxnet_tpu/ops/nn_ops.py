@@ -329,11 +329,18 @@ def _softmax_output(attrs, data, label):
             mask = (l != ignore_label).astype(p.dtype)
             mask = jnp.expand_dims(mask, axis=1 if multi_output else -1)
             grad = grad * mask
+        # under the DDP grad-overlap shard_map the op sees only the
+        # local batch shard; widen batch/valid normalization to the
+        # global batch or the psum of per-replica gradients over-counts
+        # by the replica factor
+        from ..parallel import overlap as _ov
+
         scale = grad_scale
         if normalization == "batch":
-            scale = scale / p.shape[0]
+            scale = scale / (p.shape[0] * _ov.ddp_batch_factor())
         elif normalization == "valid" and use_ignore:
-            valid = jnp.maximum(jnp.sum((l != ignore_label)), 1)
+            valid = jnp.maximum(_ov.ddp_psum(jnp.sum(l != ignore_label)),
+                                1)
             grad = grad / valid.astype(p.dtype)
         grad = grad * scale
         # ride the head cotangent: the reference seeds ones (identical
@@ -439,9 +446,15 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     bshape = tuple(data.shape[axis] if i == axis else 1 for i in range(data.ndim))
 
     if is_train:
+        from ..parallel import overlap as _ov
         from .pallas_bn import pallas_bn_enabled
 
-        if axis == 1 and pallas_bn_enabled(data):
+        # under the DDP grad-overlap shard_map, the batch statistics
+        # must be the GLOBAL batch's (sync-BN): pmean the local moments
+        # so the normalization — and the gradient flowing back through
+        # it — matches the GSPMD global-batch computation exactly
+        sync = _ov.ddp_batch_factor() > 1
+        if axis == 1 and not sync and pallas_bn_enabled(data):
             # opt-in custom-kernel path (hand-written vjp + pallas sums)
             out, mean, var = _bn_train(eps, axis, fix_gamma)(
                 data, gamma, beta)
@@ -451,10 +464,12 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
             # v5e (XLA fuses the stat reductions with their consumers
             # better than the custom bwd's explicit passes)
             g = jnp.ones_like(gamma) if fix_gamma else gamma
-            if data.dtype == jnp.bfloat16:
+            if data.dtype == jnp.bfloat16 or sync:
                 mean = jnp.mean(data, axis=reduce_axes, dtype=jnp.float32)
                 mean_sq = jnp.mean(jnp.square(data.astype(jnp.float32)),
                                    axis=reduce_axes)
+                mean = _ov.ddp_pmean(mean)
+                mean_sq = _ov.ddp_pmean(mean_sq)
                 var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
             else:
                 data32 = data.astype(jnp.float32)
@@ -839,8 +854,12 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
     shaped so every FLOP lands on the MXU: one (3C, C) input projection,
     einsum score/value matmuls batched over (batch, heads), one (C, C)
     output projection.  Softmax statistics run in fp32 regardless of the
-    compute dtype (bf16-safe).  Sequence-parallel execution of the same
-    contraction lives in ``parallel/sequence.py`` (ring attention).
+    compute dtype (bf16-safe).  The score/value contraction dispatches
+    through ``ops/attention.py`` — blockwise flash-style kernel with
+    O(T·block) peak memory by default, the materialized reference path
+    under ``MXNET_ATTN_IMPL=reference`` or the ``attn_impl`` attr.
+    Sequence-parallel execution of the same contraction lives in
+    ``parallel/sequence.py`` (ring attention, same per-block kernel).
     """
     num_heads = int(attrs["num_heads"])
     causal = bool(attrs.get("causal", True))
@@ -868,13 +887,12 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
         ctx = sequence_parallel_attention(q, k, v, causal=causal,
                                           mesh=mesh)
     else:
-        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32)
-        scores = scores / (d ** 0.5)
-        if causal:
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            scores = jnp.where(mask, scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-        ctx = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+        from .attention import dot_product_attention
+
+        block = int(attrs["attn_block"]) if "attn_block" in attrs else None
+        ctx = dot_product_attention(q, k, v, causal=causal,
+                                    impl=attrs.get("attn_impl") or None,
+                                    block=block)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
     return jnp.einsum("ntc,oc->nto", ctx, out_weight) + out_bias
 

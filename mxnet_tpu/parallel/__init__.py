@@ -14,13 +14,15 @@ from . import sharding
 from . import sequence
 from . import pipeline
 from . import expert
+from . import overlap
 from .mesh import (create_mesh, current_mesh, set_mesh, mesh_scope,
                    init_distributed)
 from .sequence import ring_attention, sequence_parallel_attention
 from .pipeline import pipeline_apply, split_symbol, PipelineTrainStep
 from .expert import moe_ffn, routed_moe_ffn
 
-__all__ = ["mesh", "collectives", "sharding", "sequence", "create_mesh",
+__all__ = ["mesh", "collectives", "sharding", "sequence", "overlap",
+           "create_mesh",
            "current_mesh", "set_mesh", "mesh_scope", "init_distributed", "ring_attention",
            "sequence_parallel_attention", "pipeline", "expert",
            "pipeline_apply", "split_symbol", "PipelineTrainStep",

@@ -34,25 +34,12 @@ __all__ = ["ring_attention", "sequence_parallel_attention"]
 
 
 def _online_softmax_merge(acc, m, l, scores, v):
-    """One flash-attention accumulation step.
+    """Back-compat alias: the flash accumulation step now lives in
+    ``ops/attention.py`` (shared with the single-chip blockwise
+    kernel)."""
+    from ..ops.attention import online_block_merge
 
-    acc: (Tq, D) weighted-value accumulator; m: (Tq, 1) running max;
-    l: (Tq, 1) running denominator; scores: (Tq, Tk) this block's
-    logits; v: (Tk, D).  Returns updated (acc, m, l).
-    """
-    import jax.numpy as jnp
-
-    block_max = jnp.max(scores, axis=-1, keepdims=True)
-    new_m = jnp.maximum(m, block_max)
-    # guard against all--inf rows (fully masked block): exp(-inf - -inf)
-    new_m_safe = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
-    correction = jnp.exp(m - new_m_safe)
-    correction = jnp.where(jnp.isfinite(m), correction, 0.0)
-    p = jnp.exp(scores - new_m_safe)
-    p = jnp.where(jnp.isfinite(scores), p, 0.0)
-    new_l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-    new_acc = acc * correction + p @ v
-    return new_acc, new_m, new_l
+    return online_block_merge(acc, m, l, scores, v)
 
 
 def ring_attention(q, k, v, axis_name, causal=False, scale=None):
@@ -70,9 +57,10 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     T_local >= 1024 checking ppermute slots hide under the score
     matmuls (docs/distributed.md "pending hardware" list).
     """
-    import jax
     import jax.numpy as jnp
     from jax import lax
+
+    from ..ops.attention import attend_block, finalize_attention
 
     # psum of a constant folds to the static axis size on every jax
     # version; lax.axis_size only exists on newer releases
@@ -84,28 +72,22 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         scale = 1.0 / (d ** 0.5)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    neg_inf = jnp.float32(-jnp.inf)
-    acc0 = jnp.zeros(q.shape[:-1] + (d,), jnp.float32)
-    m0 = jnp.full(q.shape[:-1] + (1,), neg_inf, jnp.float32)
+    acc0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
+    m0 = jnp.full(q.shape[:-1] + (1,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
 
     q32 = q.astype(jnp.float32) * scale
-    if causal:
-        # global positions of this device's queries
-        q_pos = rank * t_local + jnp.arange(t_local)
+    # global positions of this device's queries (causal masking)
+    q_pos = rank * t_local + jnp.arange(t_local)
 
     def hop(i, state):
         acc, m, l, kk, vv = state
-        # the K/V block now resident came from rank - i (ring rotation)
+        # the K/V block now resident came from rank - i (ring rotation):
+        # one visit of the shared blockwise kernel per hop
         src = (rank - i) % n
-        scores = jnp.einsum("...qd,...kd->...qk", q32,
-                            kk.astype(jnp.float32))
-        if causal:
-            k_pos = src * t_local + jnp.arange(t_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask, scores, neg_inf)
-        acc, m, l = _online_softmax_merge(acc, m, l, scores,
-                                          vv.astype(jnp.float32))
+        k_pos = src * t_local + jnp.arange(t_local)
+        acc, m, l = attend_block(q32, kk, vv, acc, m, l, q_pos=q_pos,
+                                 k_pos=k_pos, causal=causal)
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
         return acc, m, l, kk, vv
@@ -114,8 +96,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     for i in range(n):  # static unroll: n is a mesh constant
         state = hop(i, state)
     acc, m, l, _, _ = state
-    out = acc / jnp.maximum(l, 1e-20)
-    return out.astype(q.dtype)
+    return finalize_attention(acc, l).astype(q.dtype)
 
 
 def sequence_parallel_attention(q, k, v, causal=False, mesh=None,

@@ -239,7 +239,8 @@ def test_example_memcost():
     variants, so CI asserts the tool's contract, not the chip-only
     numbers."""
     out = _run("examples/memcost/memcost.py", "--model", "transformer",
-               "--batch", "2")
+               "--batch", "2", "--lm-layers", "2", "--seq-len", "256",
+               "--d-model", "256")
     assert "best policy" in out
     lines = {l.split()[0].split("=")[1]: float(l.split()[2])
              for l in out.splitlines() if l.startswith("remat=")}

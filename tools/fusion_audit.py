@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Audit XLA fusion and live-buffer pressure of a compiled train step.
+
+Usage::
+
+    python tools/fusion_audit.py --dump out.json [--model mlp|transformer]
+                                 [--batch N] [--seq T] [--attn-impl X]
+    python tools/fusion_audit.py out.json [...]      # pretty-print dumps
+    python tools/fusion_audit.py step.hlo.txt        # parse a raw HLO dump
+    python tools/fusion_audit.py --diff old.json new.json
+
+``--dump`` compiles one fused train step AOT (no execution), walks the
+*optimized* HLO, and writes a JSON artifact: ``memory_analysis()``
+totals (temp/argument/output/generated-code bytes — temp is the peak
+live-buffer watermark the ``attn_peak_bytes`` bench column reports),
+per-opcode instruction counts, the collective roster (is the gradient
+reduction bucketed? did it stay one step-ending all-reduce?), and the
+largest **unfused top-level producers** — entry-computation ops that are
+not fusions, each one a separate kernel launch and a materialized
+buffer.  That ranking is where an O(T²) attention score matrix or a
+missed transpose fold shows up by name.
+
+``--diff`` compares two dumps — run one before and one after a kernel
+change (e.g. ``MXNET_ATTN_IMPL=reference`` vs ``flash``) and the report
+shows the temp-bytes delta, opcode-count drift, and which big buffers
+appeared/vanished.
+
+Reading/diffing dumps is stdlib-only (like ``tools/compile_report.py``):
+the artifact outlives the training venv.  ``--dump`` imports mxnet_tpu.
+"""
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+ARTIFACT_KIND = "mxnet_tpu-fusion-audit"
+TOP_N = 12
+
+_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+          "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+          "f64": 8, "c64": 8, "c128": 16}
+
+# `  %name = f32[8,128]{1,0} opcode(...)` (entry or nested computation)
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"\(?([a-z]+\d*)\[([\d,]*)\][^\s]*\s+([\w\-]+)\(")
+# `%fused_computation.3 (param_0.7: f32[...]) -> f32[...] {`
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\([^)]*\)\s*->.*{")
+
+# top-level ops that are bookkeeping, not kernels
+_SKIP_OPS = {"parameter", "constant", "get-tuple-element", "tuple",
+             "bitcast", "after-all", "partition-id", "replica-id"}
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "all-to-all", "collective-permute")
+
+
+def _shape_bytes(dtype, dims):
+    n = _BYTES.get(dtype, 4)
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def parse_hlo(text):
+    """Walk optimized HLO text: per-opcode counts, collectives, and the
+    largest unfused entry-computation producers."""
+    op_counts = {}
+    collectives = []
+    producers = []
+    in_entry = False
+    for line in text.splitlines():
+        mc = _COMP_RE.match(line.strip()) if "{" in line else None
+        if mc:
+            in_entry = bool(mc.group(1))
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, dtype, dims, op = m.groups()
+        op_counts[op] = op_counts.get(op, 0) + 1
+        if not in_entry:
+            continue
+        nbytes = _shape_bytes(dtype, dims)
+        if any(op.startswith(c) for c in _COLLECTIVES):
+            collectives.append({"name": name, "op": op, "bytes": nbytes})
+        if op in _SKIP_OPS or op.startswith("fusion"):
+            continue
+        producers.append({"name": name, "op": op,
+                          "shape": "%s[%s]" % (dtype, dims),
+                          "bytes": nbytes})
+    producers.sort(key=lambda p: -p["bytes"])
+    return {"op_counts": op_counts,
+            "collectives": collectives,
+            "unfused_producers": producers[:TOP_N],
+            "unfused_producer_count": len(producers)}
+
+
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return repr(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+
+
+def dump(out_path, model="transformer", batch=None, seq=None,
+         attn_impl=None):
+    """Compile one fused train step AOT and write the audit artifact."""
+    if attn_impl:
+        os.environ["MXNET_ATTN_IMPL"] = attn_impl
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import mxnet_tpu as mx
+    from mxnet_tpu.fused import TrainStep
+
+    if model == "mlp":
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=1024, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        shapes = {"data": (batch or 64, 512),
+                  "softmax_label": (batch or 64,)}
+    else:
+        from mxnet_tpu.models import transformer
+
+        cfg = dict(vocab_size=8192, num_layers=2, d_model=256,
+                   num_heads=4, seq_len=seq or 512)
+        sym = transformer.get_symbol(**cfg)
+        b = batch or 2
+        shapes = {"data": (b, cfg["seq_len"]),
+                  "softmax_label": (b, cfg["seq_len"])}
+
+    step = TrainStep(sym, optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.01})
+    step.compile(shapes)
+    compiled = step._aot
+    payload = {"kind": ARTIFACT_KIND, "pid": os.getpid(),
+               "time": time.time(), "model": model, "shapes":
+               {k: list(v) for k, v in shapes.items()},
+               "attn_impl": attn_impl or os.environ.get(
+                   "MXNET_ATTN_IMPL", "auto")}
+    try:
+        mem = compiled.memory_analysis()
+        payload["memory"] = {
+            k: int(getattr(mem, k + "_in_bytes", 0) or 0)
+            for k in ("temp_size", "argument_size", "output_size",
+                      "generated_code_size")}
+    except Exception as e:  # backend without memory_analysis
+        payload["memory"] = {"error": str(e)}
+    payload.update(parse_hlo(compiled.as_text()))
+    with open(out_path, "w") as f:
+        json.dump(payload, f)
+    print("wrote %s" % out_path)
+    print_report(out_path, payload)
+    return 0
+
+
+def print_report(path, payload):
+    print("=" * 72)
+    print("FUSION AUDIT  %s" % path)
+    if payload.get("model"):
+        print("  model %s  shapes %s  attn_impl %s"
+              % (payload["model"], payload.get("shapes"),
+                 payload.get("attn_impl")))
+    mem = payload.get("memory") or {}
+    if mem and "error" not in mem:
+        print("  memory (memory_analysis):")
+        for k in ("temp_size", "argument_size", "output_size",
+                  "generated_code_size"):
+            note = "  <-- peak live-buffer watermark" \
+                if k == "temp_size" else ""
+            print("    %-20s %12s%s" % (k, _fmt_bytes(mem.get(k, 0)),
+                                        note))
+    counts = payload.get("op_counts") or {}
+    fused = counts.get("fusion", 0)
+    total = sum(counts.values())
+    print("  instructions: %d total, %d fusions" % (total, fused))
+    top_ops = sorted(counts.items(), key=lambda kv: -kv[1])[:8]
+    print("    " + "  ".join("%s:%d" % kv for kv in top_ops))
+    colls = payload.get("collectives") or []
+    print("  collectives: %d%s" % (
+        len(colls),
+        "" if not colls else "  (" + ", ".join(sorted(
+            {c["op"] for c in colls})) + ")"))
+    for c in colls[:TOP_N]:
+        print("    %-44s %-24s %s" % (c["name"], c["op"],
+                                      _fmt_bytes(c["bytes"])))
+    prods = payload.get("unfused_producers") or []
+    print("  largest unfused top-level producers "
+          "(%d total, top %d):" % (payload.get("unfused_producer_count",
+                                               len(prods)), len(prods)))
+    for p in prods:
+        print("    %-44s %-16s %-20s %s"
+              % (p["name"], p["op"], p["shape"], _fmt_bytes(p["bytes"])))
+
+
+def diff(path_a, path_b):
+    a, b = (_load(p) for p in (path_a, path_b))
+    print("=" * 72)
+    print("FUSION AUDIT DIFF  %s -> %s" % (path_a, path_b))
+    ma, mb = a.get("memory") or {}, b.get("memory") or {}
+    for k in ("temp_size", "argument_size", "output_size"):
+        if k in ma and k in mb:
+            va, vb = ma[k], mb[k]
+            pct = " (%+.1f%%)" % (100.0 * (vb - va) / va) if va else ""
+            print("  %-20s %12s -> %12s%s"
+                  % (k, _fmt_bytes(va), _fmt_bytes(vb), pct))
+    ca, cb = a.get("op_counts") or {}, b.get("op_counts") or {}
+    drift = {op: cb.get(op, 0) - ca.get(op, 0)
+             for op in set(ca) | set(cb)}
+    moved = sorted((kv for kv in drift.items() if kv[1]),
+                   key=lambda kv: -abs(kv[1]))
+    print("  opcode drift (new minus old):")
+    if not moved:
+        print("    (identical opcode mix)")
+    for op, d in moved[:TOP_N]:
+        print("    %-28s %+d" % (op, d))
+    # key by (op, shape), not instruction name — HLO renumbers every
+    # instruction between compiles, shapes are the stable identity
+    def by_sig(payload):
+        sig = {}
+        for p in payload.get("unfused_producers") or []:
+            sig.setdefault((p["op"], p["shape"]), p)
+        return sig
+
+    pa, pb = by_sig(a), by_sig(b)
+    for title, only, src in (("big buffers gone", set(pa) - set(pb), pa),
+                             ("big buffers new", set(pb) - set(pa), pb)):
+        print("  %s:" % title)
+        if not only:
+            print("    (none)")
+        for key in sorted(only, key=lambda k: -src[k]["bytes"]):
+            p = src[key]
+            print("    %-16s %-20s %s" % (p["op"], p["shape"],
+                                          _fmt_bytes(p["bytes"])))
+    return 0
+
+
+def _load(path):
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) or \
+            payload.get("kind") != ARTIFACT_KIND:
+        raise SystemExit("%s: not a fusion-audit artifact" % path)
+    return payload
+
+
+def report_file(path):
+    """JSON artifact or raw HLO text — detect and report either."""
+    try:
+        payload = _load(path)
+    except (ValueError, SystemExit):
+        with open(path) as f:
+            text = f.read()
+        if "HloModule" not in text:
+            print("%s: neither a fusion-audit artifact nor HLO text"
+                  % path, file=sys.stderr)
+            return False
+        print_report(path, parse_hlo(text))
+        return True
+    print_report(path, payload)
+    return True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="audit XLA fusion / live buffers of the fused step")
+    ap.add_argument("paths", nargs="*",
+                    help="fusion-audit JSON artifacts or raw HLO dumps")
+    ap.add_argument("--dump", metavar="OUT",
+                    help="compile a step and write an artifact "
+                         "(imports mxnet_tpu)")
+    ap.add_argument("--model", default="transformer",
+                    choices=("transformer", "mlp"))
+    ap.add_argument("--batch", type=int)
+    ap.add_argument("--seq", type=int)
+    ap.add_argument("--attn-impl",
+                    help="force MXNET_ATTN_IMPL for the dump "
+                         "(flash|reference|auto)")
+    ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                    help="compare two artifacts")
+    args = ap.parse_args(argv)
+    if args.dump:
+        return dump(args.dump, model=args.model, batch=args.batch,
+                    seq=args.seq, attn_impl=args.attn_impl)
+    if args.diff:
+        return diff(*args.diff)
+    if not args.paths:
+        ap.error("nothing to do: pass artifacts, --dump, or --diff")
+    ok = 0
+    for path in args.paths:
+        ok += report_file(path)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
