@@ -303,6 +303,21 @@ def main():
         except Exception as exc:  # keep the primary metric robust
             result["transformer_error"] = str(exc)[:200]
         _emit_partial()
+    # serving summary row: continuous-batching speedup over serial plus
+    # the continuous tokens/s and tail TTFT (bench_serve.py has the
+    # full per-policy breakdown and the bit-exactness/KV-flat probes)
+    if not fp32 and "--resnet-only" not in sys.argv:
+        try:
+            import bench_serve
+
+            sv = bench_serve.measure(argv=[])
+            result["serving_speedup_vs_serial"] = sv["value"]
+            result["serving_tokens_per_sec"] = sv["tokens_per_sec"]
+            result["serving_ttft_p99_s"] = sv["continuous_ttft_p99_s"]
+            result["serving_bitexact"] = sv["bitexact"]
+        except Exception as exc:  # keep the primary metric robust
+            result["serving_error"] = str(exc)[:200]
+        _emit_partial()
     # the BASELINE distributed-scaling flagships (docs/how_to/
     # perf.md:157-167: alexnet bs256 483.37 img/s, inception-v3 bs32
     # 29.62 img/s on K80) — single-chip rows so BENCH anchors more than

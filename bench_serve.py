@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Serving benchmark: serial vs static-batch vs continuous batching.
+
+Drives one :class:`mxnet_tpu.serve.InferenceSession` (compiled ONCE —
+the same bucketed prefill + fixed-shape decode executables serve every
+policy) through an identical Poisson open-loop arrival trace under the
+three scheduler policies, and reports per-policy p50/p99 TTFT,
+per-token latency, and tokens/s.  The headline metric is the
+continuous-batching speedup over serial one-request-at-a-time serving.
+
+Also certifies the serving acceptance criteria directly in the JSON:
+
+* ``bitexact``           — paged decode logits == jitted full-context
+                           reference forward (``assert_array_equal``).
+* ``kv_pool_bytes_*``    — decode KV memory at step 1 vs step N
+                           (identical: the pools are fixed buffers).
+* ``executables`` / ``recompiles`` — compiled-executable count stays at
+                           ``len(buckets) + 1`` with one trace each.
+* ``compile_report``     — ``compile_cache.write_artifact`` path for
+                           the serving executable set
+                           (pretty-print: ``tools/compile_report.py``).
+
+Prints ONE JSON line.  Honors ``MXNET_BENCH_BUDGET_S`` (valid partial
+JSON + exit 0) and always arms the ``bench_util`` watchdog.
+
+Usage: bench_serve.py [--requests=N] [--max-new=N] [--watchdog SEC]
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench_util
+
+_RESULT = {"metric": "serve_continuous_speedup_vs_serial"}
+
+
+def _poisson_trace(n_requests, mean_gap_s, prompt_lens, max_new, seed):
+    """Seeded open-loop arrival trace, replayed for every policy."""
+    import numpy as np
+
+    from mxnet_tpu.serve import Request
+
+    rs = np.random.RandomState(seed)
+    gaps = rs.exponential(mean_gap_s, size=n_requests)
+    arrivals = np.cumsum(gaps) - gaps[0]  # first request at t=0
+    reqs = []
+    for i in range(n_requests):
+        plen = int(prompt_lens[i % len(prompt_lens)])
+        prompt = rs.randint(1, 127, size=plen).tolist()
+        reqs.append(dict(rid=i, prompt=prompt, max_new=int(max_new),
+                         arrival_s=float(arrivals[i])))
+    return reqs
+
+
+def measure(argv=None):
+    import numpy as np
+
+    from mxnet_tpu import compile_cache, serve
+    from mxnet_tpu.serve import model as serve_model
+
+    argv = sys.argv if argv is None else argv
+    n_requests = int(next((a.split("=")[1] for a in argv
+                           if a.startswith("--requests=")), 16))
+    max_new = int(next((a.split("=")[1] for a in argv
+                        if a.startswith("--max-new=")), 16))
+
+    cfg = serve.ModelConfig(vocab_size=128, num_layers=2, d_model=64,
+                            num_heads=2, max_len=128)
+    params = serve_model.init_params(cfg, seed=0)
+    sconf = serve.ServeConfig(slots=8, page_size=16, buckets=(16, 32),
+                              max_new=max_new, exact=True)
+    t0 = time.perf_counter()
+    sess = serve.InferenceSession(params, num_heads=cfg.num_heads,
+                                  config=sconf)
+    _RESULT["compile_s"] = round(time.perf_counter() - t0, 3)
+    _RESULT["model"] = "%dL-d%d-V%d" % (cfg.num_layers, cfg.d_model,
+                                        cfg.vocab_size)
+    _RESULT["slots"] = sconf.slots
+    _RESULT["buckets"] = list(sconf.buckets)
+    _RESULT["executables"] = sorted(sess.executables)
+
+    # -- acceptance probe 1: paged decode bit-exact vs reference ---------
+    def ref_row(seq):
+        return np.asarray(serve_model.reference_last_logits(
+            sess.params, seq, cfg, sconf.page_size, exact=True))
+
+    probe = list(np.random.RandomState(1).randint(1, 127, size=9))
+    slot = sess.try_alloc(len(probe), 8)
+    first, last_logits = sess.prefill(slot, probe)
+    np.testing.assert_array_equal(last_logits, ref_row(probe))
+    seq = list(probe) + [first]
+    for _ in range(7):
+        toks, logits = sess.step()
+        np.testing.assert_array_equal(logits[slot], ref_row(seq))
+        seq.append(toks[slot])
+    sess.release(slot)
+    _RESULT["bitexact"] = True
+
+    # -- acceptance probe 2: KV memory flat in generated length ----------
+    # the pools are fixed-shape buffers and the ONE decode executable
+    # serves every step, so the watermark cannot move; record it from
+    # both ends of a max-length generation to make that observable.
+    mem = sess.memory_analysis("decode")
+    _RESULT["decode_memory_analysis"] = mem
+    slot = sess.try_alloc(16, max_new)
+    sess.prefill(slot, list(range(1, 17)))
+    step1_bytes = sess.cache.pool_bytes()
+    sess.step()
+    for _ in range(max_new - 2):
+        sess.step()
+    stepN_bytes = sess.cache.pool_bytes()
+    sess.release(slot)
+    _RESULT["kv_pool_bytes_step1"] = step1_bytes
+    _RESULT["kv_pool_bytes_stepN"] = stepN_bytes
+    assert step1_bytes == stepN_bytes, "KV pool bytes moved during decode"
+
+    # -- the policy comparison -------------------------------------------
+    trace = _poisson_trace(n_requests, mean_gap_s=0.002,
+                           prompt_lens=(9, 14, 23, 30), max_new=max_new,
+                           seed=2)
+    policies = ("serial", "static", "continuous")
+    for policy in policies:
+        reqs = [serve.Request(**spec) for spec in trace]
+        sched = serve.Scheduler(sess, policy=policy)
+        done, makespan = sched.run(reqs)
+        summary = serve.summarize(done, makespan)
+        assert summary["failed"] == 0, "%s: %d requests failed" \
+            % (policy, summary["failed"])
+        assert summary["completed"] == n_requests
+        for key, val in summary.items():
+            _RESULT["%s_%s" % (policy, key)] = (
+                round(val, 5) if isinstance(val, float) else val)
+
+    speedup = (_RESULT["continuous_tokens_per_sec"]
+               / max(_RESULT["serial_tokens_per_sec"], 1e-9))
+    _RESULT["value"] = round(speedup, 2)
+    _RESULT["unit"] = "x serial tokens/s"
+    _RESULT["tokens_per_sec"] = _RESULT["continuous_tokens_per_sec"]
+
+    # -- acceptance probe 3: no per-request recompiles -------------------
+    guards = sess.guard_report()
+    _RESULT["recompiles"] = {
+        name: snap for name, snap in guards.items()
+        if snap.get("traces", 0) > 1 or snap.get("signatures", 0) > 1}
+    assert not _RESULT["recompiles"], \
+        "serving executables retraced: %r" % (_RESULT["recompiles"],)
+    assert len(sess.executables) == len(sconf.buckets) + 1
+    _RESULT["dispatch_fallbacks"] = sess.fallback_count()
+
+    # -- satellite: compile-report artifact for the serving set ----------
+    try:
+        _RESULT["compile_report"] = compile_cache.write_artifact()
+    except Exception as exc:
+        _RESULT["compile_report_error"] = str(exc)[:200]
+    return dict(_RESULT)
+
+
+def main():
+    # watchdog + budget armed before measure()'s jax imports: a hung
+    # backend init still yields valid partial JSON + exit 0
+    seconds = None
+    for i, a in enumerate(sys.argv):
+        if a == "--watchdog" and i + 1 < len(sys.argv):
+            seconds = float(sys.argv[i + 1])
+        elif a.startswith("--watchdog="):
+            seconds = float(a.split("=", 1)[1])
+    bench_util.arm_watchdog(_RESULT, seconds=seconds)
+    bench_util.arm_budget(_RESULT)
+    result = measure()
+    result.update(bench_util.compile_summary())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Deployment walkthrough (reference ``amalgamation/`` +
-``c_predict_api``): train → checkpoint → AOT bundle → serve three ways.
+``c_predict_api``): train → checkpoint → AOT bundle → serve four ways.
 
     python examples/deploy/export_and_serve.py
 
@@ -11,6 +11,10 @@
 3. The C ABI (``include/mxnet_tpu/c_predict_api.h``) — see
    ``tests/test_deploy_tools.py::test_c_predict_api`` for a full C
    client; this script prints the compile line.
+4. The continuous-batching generation queue — an LM checkpoint restored
+   into ``serve.InferenceSession`` (bucketed AOT prefill + paged-KV
+   decode) and driven by ``serve.Scheduler`` over an arrival trace.
+   See ``docs/serving.md`` and ``bench_serve.py``.
 """
 import os
 import sys
@@ -66,6 +70,39 @@ def main():
           "_native._load('c_predict_api')\"\n"
           "then link clients against mxnet_tpu/_build/c_predict_api.so "
           "with -I include/ and run with MXNET_TPU_HOME set.")
+
+    # 4. continuous-batching generation queue over a paged KV cache
+    from mxnet_tpu import checkpoint as ckpt
+    from mxnet_tpu import serve
+
+    lm_cfg = serve.ModelConfig(vocab_size=96, num_layers=2, d_model=32,
+                               num_heads=2, max_len=64)
+    lm_params = serve.init_params(lm_cfg, seed=0)  # stands in for a run
+    ckpt.CheckpointManager(workdir, prefix="lm",
+                           save_optimizer_states=False).save(
+        epoch=1, arg_params=lm_params)
+
+    # every executable (one prefill per bucket + one decode step) is
+    # AOT-compiled here; steady-state serving never traces
+    sess = serve.InferenceSession.from_checkpoint(
+        workdir, prefix="lm", num_heads=lm_cfg.num_heads,
+        config=serve.ServeConfig(slots=4, page_size=8, buckets=(8, 16),
+                                 max_new=12))
+    rs = np.random.RandomState(1)
+    requests = [
+        serve.Request(rid=i,
+                      prompt=rs.randint(1, 95, size=plen).tolist(),
+                      max_new=12, arrival_s=0.004 * i)
+        for i, plen in enumerate((5, 9, 13, 6, 11, 7))]
+    done, makespan = serve.Scheduler(sess, policy="continuous") \
+        .run(requests)
+    stats = serve.summarize(done, makespan)
+    print("\ncontinuous batching: %d requests, %d tokens, "
+          "%.0f tok/s, ttft p99 %.1f ms"
+          % (stats["completed"], stats["total_tokens"],
+             stats["tokens_per_sec"], stats["ttft_p99_s"] * 1e3))
+    print("executables:", sorted(sess.executables),
+          "fallbacks:", sess.fallback_count())
 
 
 if __name__ == "__main__":

@@ -71,6 +71,7 @@ from . import checkpoint
 from .checkpoint import CheckpointManager, CheckpointState
 from . import testing
 from . import models
+from . import serve
 from . import name
 from . import libinfo
 from . import executor_manager

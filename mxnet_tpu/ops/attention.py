@@ -50,7 +50,7 @@ from ..base import MXNetError, get_env
 
 __all__ = ["attention_impl", "attention_block_size", "dot_product_attention",
            "flash_attention", "reference_attention", "attend_block",
-           "online_block_merge", "finalize_attention"]
+           "online_block_merge", "finalize_attention", "decode_attention"]
 
 _IMPLS = ("auto", "flash", "reference")
 
@@ -81,7 +81,33 @@ def attention_block_size():
 # shared online-softmax inner kernel (also the ring-attention hop kernel)
 # ---------------------------------------------------------------------------
 
-def online_block_merge(acc, m, l, scores, v):
+def _qk_scores(q32, kb32, mi=False):
+    """(..., Tq, D) x (..., Tk, D) -> (..., Tq, Tk) score matmul.
+
+    ``mi=True`` selects the M-invariant broadcast-multiply-reduce form:
+    each output element reduces over D in an order independent of Tq, so
+    a single-query decode step produces bit-identical scores to the
+    matching row of a full-context forward (the serving bit-exactness
+    contract — XLA's gemm packs/accumulates differently per M, which is
+    ~1 ulp of drift the einsum form cannot avoid).  Costs extra bandwidth
+    (the product tensor materializes), so it is opt-in.
+    """
+    if mi:
+        return jnp.sum(q32[..., :, None, :] * kb32[..., None, :, :],
+                       axis=-1)
+    return jnp.einsum("...qd,...kd->...qk", q32, kb32)
+
+
+def _pv_accum(p, vb32, mi=False):
+    """(..., Tq, Tk) x (..., Tk, D) -> (..., Tq, D) probability-value
+    matmul; ``mi`` as in :func:`_qk_scores`."""
+    if mi:
+        return jnp.sum(p[..., :, :, None] * vb32[..., None, :, :],
+                       axis=-2)
+    return jnp.einsum("...qk,...kd->...qd", p, vb32)
+
+
+def online_block_merge(acc, m, l, scores, v, mi=False):
     """One flash-attention accumulation step.
 
     acc: (..., Tq, D) weighted-value accumulator; m: (..., Tq, 1) running
@@ -98,22 +124,24 @@ def online_block_merge(acc, m, l, scores, v):
     p = jnp.exp(scores - new_m_safe)
     p = jnp.where(jnp.isfinite(scores), p, 0.0)
     new_l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-    new_acc = acc * correction + jnp.einsum("...qk,...kd->...qd", p, v)
+    new_acc = acc * correction + _pv_accum(p, v, mi=mi)
     return new_acc, new_m, new_l
 
 
 def attend_block(q32, kb, vb, acc, m, l, q_pos=None, k_pos=None,
-                 causal=False, kv_valid=None):
+                 causal=False, kv_valid=None, mi=False):
     """Visit one K/V block: score, mask, merge into the running stats.
 
     ``q32`` is the full (pre-scaled, fp32) query; ``kb``/``vb`` one key/
     value block.  ``q_pos``/``k_pos`` are absolute positions (1-D int
     arrays) used for causal masking — ring attention recovers ``k_pos``
     from the hop index, the blockwise kernel from the block start.
-    ``kv_valid`` masks padded keys in the (ragged) last block.
+    ``kv_valid`` masks padded keys in the (ragged) last block; any
+    broadcastable mask shape works (the paged decode kernel passes a
+    per-batch-element (..., 1, Tk) validity mask).  ``mi`` selects the
+    M-invariant matmuls (see :func:`_qk_scores`).
     """
-    scores = jnp.einsum("...qd,...kd->...qk", q32,
-                        kb.astype(jnp.float32))
+    scores = _qk_scores(q32, kb.astype(jnp.float32), mi=mi)
     mask = None
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
@@ -122,7 +150,7 @@ def attend_block(q32, kb, vb, acc, m, l, q_pos=None, k_pos=None,
     if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
     return online_block_merge(acc, m, l, scores,
-                              vb.astype(jnp.float32))
+                              vb.astype(jnp.float32), mi=mi)
 
 
 def finalize_attention(acc, l):
@@ -164,7 +192,7 @@ def _kv_blocks(x, t_pad, block):
     return jnp.moveaxis(x, -3, 0)
 
 
-def _flash_forward(q, k, v, causal, scale, block):
+def _flash_forward(q, k, v, causal, scale, block, mi=False):
     """Tiled forward: scan over K/V blocks carrying (acc, m, l) in fp32.
 
     Returns ``(out, lse)`` where ``lse = m + log l`` is the per-query
@@ -191,7 +219,7 @@ def _flash_forward(q, k, v, causal, scale, block):
         kv_valid = k_pos < t if t_pad != t else None
         acc, m, l = attend_block(q32, kblk, vblk, acc, m, l,
                                  q_pos=q_pos, k_pos=k_pos, causal=causal,
-                                 kv_valid=kv_valid)
+                                 kv_valid=kv_valid, mi=mi)
         return (acc, m, l), None
 
     (acc, m, l), _ = lax.scan(body, (acc0, m0, l0), (kb, vb, starts))
@@ -256,21 +284,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block):
 
 
 @functools.lru_cache(maxsize=64)
-def _flash_fn(causal, scale, block):
-    """Per-(causal, scale, block) custom-VJP closure.
+def _flash_fn(causal, scale, block, mi=False):
+    """Per-(causal, scale, block, mi) custom-VJP closure.
 
     ``custom_vjp`` needs the static config out of the traced signature;
     the cache keeps function identity stable so jit does not re-trace
-    per call.
+    per call.  ``mi`` only changes the forward matmul form (serving
+    bit-exactness); the recompute backward keeps the einsum form —
+    gradients carry no M-invariance contract.
     """
 
     @jax.custom_vjp
     def attn(q, k, v):
-        out, _ = _flash_forward(q, k, v, causal, scale, block)
+        out, _ = _flash_forward(q, k, v, causal, scale, block, mi=mi)
         return out
 
     def fwd(q, k, v):
-        out, lse = _flash_forward(q, k, v, causal, scale, block)
+        out, lse = _flash_forward(q, k, v, causal, scale, block, mi=mi)
         return out, (q, k, v, out, lse)
 
     def bwd(res, g):
@@ -281,22 +311,85 @@ def _flash_fn(causal, scale, block):
     return attn
 
 
-def flash_attention(q, k, v, causal=True, scale=None, block=None):
+def flash_attention(q, k, v, causal=True, scale=None, block=None,
+                    mi=False):
     """Blockwise online-softmax attention, O(T·block) peak memory.
 
     q/k/v: (..., T, D) with identical leading dims (batch, heads are
     free).  Ragged T is handled by padding the last K/V block and
     masking the padded keys to ``-inf``.  Differentiable via a
-    recompute-based ``custom_vjp`` (no stored probabilities).
+    recompute-based ``custom_vjp`` (no stored probabilities).  ``mi``
+    selects M-invariant forward matmuls so per-row outputs do not depend
+    on how many query rows share the call (see :func:`_qk_scores`).
     """
     d = q.shape[-1]
     t = k.shape[-2]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if block is None:
+        # default only: clamp to T so short sequences do not pay padding.
+        # An explicit block is honored verbatim — serving bit-exactness
+        # needs the accumulation width fixed across different T.
+        block = min(attention_block_size(), max(t, 1))
+    return _flash_fn(bool(causal), float(scale), int(block),
+                     bool(mi))(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# single-query paged decode kernel (serving)
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
+                     mi=False):
+    """One autoregressive decode step of attention over a paged KV
+    context: the O(1)-per-token serving counterpart of
+    :func:`flash_attention`, built from the same :func:`attend_block`
+    online-softmax primitive so the two paths cannot drift numerically.
+
+    q: (S, H, 1, D) — one query per batch slot; k_ctx/v_ctx:
+    (S, H, Tcap, D) — the slot's gathered KV pages, where ``Tcap`` is the
+    fixed page capacity and rows at positions >= ``lengths[s]`` are
+    stale/garbage; lengths: (S,) int — valid context length per slot
+    (INCLUDING the current token, whose KV the caller appends before
+    attending).  ``Tcap`` must be a multiple of ``block`` (the page
+    size, for the paged cache).  Fully-masked blocks are exact no-ops in
+    the online merge (correction 1, p 0), so visiting all ``Tcap/block``
+    blocks with the validity mask reproduces the reference forward's
+    merge sequence bit-for-bit when ``mi=True``.
+    """
+    d = q.shape[-1]
+    t_cap = k_ctx.shape[-2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if block is None:
         block = attention_block_size()
-    block = min(block, max(t, 1))
-    return _flash_fn(bool(causal), float(scale), int(block))(q, k, v)
+    block = min(block, max(t_cap, 1))
+    if t_cap % block:
+        raise MXNetError(
+            "decode_attention: context capacity %d not a multiple of "
+            "block %d" % (t_cap, block))
+    nblk = t_cap // block
+    kb = _kv_blocks(k_ctx, t_cap, block)
+    vb = _kv_blocks(v_ctx, t_cap, block)
+    starts = jnp.arange(nblk) * block
+    q32 = q.astype(jnp.float32) * scale
+    acc0 = jnp.zeros(q.shape[:-1] + (v_ctx.shape[-1],), jnp.float32)
+    m0 = jnp.full(q.shape[:-1] + (1,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+    # (S, 1, 1, 1) so the mask broadcasts against (S, H, 1, block)
+    valid_len = lengths.reshape(lengths.shape + (1,) * (q.ndim - 1))
+
+    def body(carry, xs):
+        acc, m, l = carry
+        kblk, vblk, start = xs
+        k_pos = start + jnp.arange(block)
+        kv_valid = k_pos < valid_len
+        acc, m, l = attend_block(q32, kblk, vblk, acc, m, l,
+                                 kv_valid=kv_valid, mi=mi)
+        return (acc, m, l), None
+
+    (acc, _, l), _ = lax.scan(body, (acc0, m0, l0), (kb, vb, starts))
+    return finalize_attention(acc, l).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -62,6 +62,18 @@ class Predictor:
         self._input_shapes = dict(input_shapes)
         self._exec = self._symbol.simple_bind(
             ctx, grad_req="null", **self._input_shapes)
+        # PR 4 guardrails: serving clients that churn input shapes (new
+        # Predictor per shape, or hot-swapped buffers) retrace the XLA
+        # program every request.  The registry guard is shared across
+        # instances of the same graph so fleet-wide churn aggregates;
+        # per-instance `_seen_sigs` keeps a single instance's steady
+        # state free (repeat shapes observe without counting a trace).
+        from .compile_cache import registry
+
+        self._recompile_guard = registry.guard(
+            "Predictor(%s)" % (getattr(self._symbol, "name", None)
+                               or "graph"))
+        self._seen_sigs = set()
         for name, arr in arg_params.items():
             if name in self._exec.arg_dict:
                 if tuple(arr.shape) != self._exec.arg_dict[name].shape:
@@ -122,6 +134,12 @@ class Predictor:
         ``MXPredForward``)."""
         for k, v in inputs.items():
             self.set_input(k, v)
+        from .compile_cache import signature_of
+
+        sig = signature_of({k: self._exec.arg_dict[k]._data
+                            for k in sorted(self._input_shapes)})
+        self._recompile_guard.observe(sig, force=sig not in self._seen_sigs)
+        self._seen_sigs.add(sig)
         self._exec.forward(is_train=False)
         return self._exec.outputs
 
@@ -227,6 +245,18 @@ class ExportedPredictor:
                      if k.startswith("aux:")}
         self._rng = jax.random.PRNGKey(0)
         self._outputs = None
+        # same PR 4 accounting as Predictor: an exported bundle has ONE
+        # legal input signature (jax.export enforces exact shapes), so
+        # any drift a client feeds it is surfaced as a named recompile
+        # storm instead of an opaque serialization error.
+        import os
+
+        from .compile_cache import registry
+
+        self._recompile_guard = registry.guard(
+            "ExportedPredictor(%s)"
+            % os.path.splitext(os.path.basename(str(path)))[0])
+        self._seen_sigs = set()
 
     @property
     def output_names(self):
@@ -244,6 +274,11 @@ class ExportedPredictor:
                                  % (k, sorted(self._input_shapes)))
             args[k] = np.asarray(v.asnumpy() if isinstance(v, NDArray)
                                  else v, dtype=args[k].dtype)
+        from .compile_cache import signature_of
+
+        sig = signature_of({k: args[k] for k in sorted(self._input_shapes)})
+        self._recompile_guard.observe(sig, force=sig not in self._seen_sigs)
+        self._seen_sigs.add(sig)
         outs, _new_aux = self._exported.call(args, self._aux, self._rng)
         self._outputs = [np.asarray(o) for o in outs]
         return self._outputs

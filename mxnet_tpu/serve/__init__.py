@@ -1,0 +1,27 @@
+"""mxnet_tpu.serve — production inference runtime.
+
+Continuous batching over bucketed AOT executables plus an O(1) paged
+KV decode cache.  See docs/serving.md for the architecture and
+bench_serve.py for the serial/static/continuous comparison.
+"""
+from .kv_cache import PagedKVCache
+from .model import ModelConfig, config_from_params, decode_step, \
+    full_forward, init_params, prefill_forward, reference_last_logits
+from .scheduler import Request, Scheduler, summarize
+from .session import InferenceSession, ServeConfig
+
+__all__ = [
+    "InferenceSession",
+    "ModelConfig",
+    "PagedKVCache",
+    "Request",
+    "Scheduler",
+    "ServeConfig",
+    "config_from_params",
+    "decode_step",
+    "full_forward",
+    "init_params",
+    "prefill_forward",
+    "reference_last_logits",
+    "summarize",
+]

@@ -1,0 +1,161 @@
+"""Paged KV cache: fixed-capacity device pools + host-side page tables.
+
+The decode-side memory design (PAPERS.md "Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching"): all KV state lives
+in two fixed-shape device pools
+
+    k_pool, v_pool : (num_layers, num_pages + 1, page_size, H, D)
+
+so every prefill/decode executable sees one unchanging buffer shape —
+no per-request allocation, no growing tensors, no recompiles.  Requests
+own *pages* (rows of the pool), recorded in a per-slot page table the
+executables consume as a plain (slots, max_pages) int32 array.
+
+Two deliberate simplifications vs a vLLM-style pager:
+
+* **Reservation admission** — a request is admitted only when pages for
+  its whole worst case (prompt + max_new tokens) are free, so an
+  admitted request can never stall mid-decode waiting for a page and no
+  preemption/swap machinery is needed.  The cost is lower pool
+  utilization when requests finish early; the scheduler's continuous
+  admission backfills freed pages at the next step boundary.
+* **The trash page** — pool row ``num_pages`` is a write-only dump.
+  Unreserved page-table entries and inactive slots point at it, so the
+  fixed-shape executables can always scatter (padded prefill positions,
+  idle slots) without conditionals; nothing ever reads it through a
+  validity mask.
+
+Page-table/length bookkeeping is host-side numpy (the scheduler mutates
+it between steps); :meth:`device_tables` re-uploads only after a
+mutation.  The pools themselves live on device and flow through the
+donated executable arguments.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Fixed-pool paged KV cache for ``slots`` concurrent requests."""
+
+    def __init__(self, num_layers, num_heads, head_dim, page_size,
+                 num_pages, slots, max_pages_per_slot, dtype=None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        if min(num_layers, num_heads, head_dim, page_size, num_pages,
+               slots, max_pages_per_slot) < 1:
+            raise MXNetError("PagedKVCache: all dimensions must be >= 1")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.slots = int(slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.trash_page = self.num_pages  # reserved last pool row
+        dtype = dtype or jnp.float32
+        pool_shape = (self.num_layers, self.num_pages + 1, self.page_size,
+                      self.num_heads, self.head_dim)
+        self.k_pool = jnp.zeros(pool_shape, dtype)
+        self.v_pool = jnp.zeros(pool_shape, dtype)
+        self._free_pages = list(range(self.num_pages - 1, -1, -1))
+        self._free_slots = list(range(self.slots - 1, -1, -1))
+        self._tables = np.full((self.slots, self.max_pages_per_slot),
+                               self.trash_page, np.int32)
+        self._pages_of = {}  # slot -> [page, ...]
+        self.lengths = np.zeros((self.slots,), np.int32)
+        self._tables_dev = None  # upload cache, invalidated on mutation
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def free_pages(self):
+        return len(self._free_pages)
+
+    @property
+    def free_slots(self):
+        return len(self._free_slots)
+
+    def pages_needed(self, prompt_len, max_new):
+        """Worst-case page reservation for one request."""
+        total = int(prompt_len) + int(max_new)
+        return -(-total // self.page_size)
+
+    def can_admit(self, prompt_len, max_new):
+        need = self.pages_needed(prompt_len, max_new)
+        if need > self.max_pages_per_slot:
+            raise MXNetError(
+                "request needs %d pages (prompt %d + max_new %d at page "
+                "size %d) but slots hold at most %d — raise the session's "
+                "max context" % (need, prompt_len, max_new,
+                                 self.page_size, self.max_pages_per_slot))
+        return self._free_slots and len(self._free_pages) >= need
+
+    # -- slot lifecycle ---------------------------------------------------
+    def alloc(self, prompt_len, max_new):
+        """Reserve a slot + its worst-case pages; returns the slot id or
+        ``None`` when either resource is exhausted (the scheduler keeps
+        the request queued)."""
+        if not self.can_admit(prompt_len, max_new):
+            return None
+        need = self.pages_needed(prompt_len, max_new)
+        slot = self._free_slots.pop()
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self._pages_of[slot] = pages
+        self._tables[slot, :] = self.trash_page
+        self._tables[slot, :need] = pages
+        self.lengths[slot] = 0
+        self._tables_dev = None
+        return slot
+
+    def release(self, slot):
+        """Return the slot's pages to the free pool (request finished,
+        evicted, or failed)."""
+        pages = self._pages_of.pop(slot, None)
+        if pages is None:
+            raise MXNetError("release of unallocated slot %r" % (slot,))
+        # keep free lists sorted (descending, pop() takes the end) so the
+        # lowest id is always reused first — allocation order stays
+        # deterministic no matter the order requests finished in
+        self._free_pages.extend(pages)
+        self._free_pages.sort(reverse=True)
+        self._free_slots.append(slot)
+        self._free_slots.sort(reverse=True)
+        self._tables[slot, :] = self.trash_page
+        self.lengths[slot] = 0
+        self._tables_dev = None
+
+    def active_slots(self):
+        return sorted(self._pages_of)
+
+    # -- executable-facing views -----------------------------------------
+    def device_tables(self):
+        """The (slots, max_pages) int32 page-table array, uploaded only
+        when the host copy changed since the last call."""
+        import jax.numpy as jnp
+
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        return self._tables_dev
+
+    def device_lengths(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.lengths)
+
+    def table_row(self, slot):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._tables[slot])
+
+    # -- accounting -------------------------------------------------------
+    def pool_bytes(self):
+        """Total device bytes held by the two pools — constant for the
+        session's lifetime, which IS the O(1) decode-memory story."""
+        return int(self.k_pool.nbytes) + int(self.v_pool.nbytes)
+
+    def utilization(self):
+        used = self.num_pages - len(self._free_pages)
+        return used / float(self.num_pages)

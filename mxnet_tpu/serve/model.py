@@ -1,0 +1,354 @@
+"""Functional transformer decoder for the serving runtime.
+
+The training side runs the symbolic graph (``models/transformer.py`` →
+``ops/nn_ops.py``); serving needs the same network as a *pure function*
+it can specialize three ways — full-context reference forward, bucketed
+prefill (full forward + KV page writes), and the O(1) single-token
+decode step — over one parameter dict.  This module is that function,
+written against the exact op semantics of the training kernels
+(``FullyConnected``'s ``(out, in)`` weight layout, ``LayerNorm`` at
+eps 1e-5 in rsqrt form, the MHA in/out projection einsums, head split
+``(n, t, h, d) -> (n, h, t, d)``, ``jax.nn.gelu``) and parameterized by
+the training graph's own parameter names (``tok_embed_weight``,
+``blk{i}_attn_in_weight`` …), so a ``CheckpointManager`` restore of a
+training run drops straight in.
+
+Bit-exactness contract (the serving acceptance criterion): with
+``exact=True`` every matmul uses the M-invariant broadcast-multiply-
+reduce form and attention runs the ``mi=True`` flash/decode kernels, so
+a token decoded through the paged KV cache is bit-identical to the same
+position of a full-context forward.  XLA's gemm accumulation order
+depends on the M dimension (a 1-row projection differs from row T of a
+T-row projection by ~1 ulp), which is why plain einsums cannot make
+that guarantee; ``exact=False`` restores them for production serving
+where ulp-level drift is acceptable and gemm throughput matters.
+``MXNET_SERVE_EXACT`` picks the default.
+
+The oracle for that contract is :func:`reference_last_logits`: a jitted
+full-context forward padded to the next ``page_size`` multiple, so the
+reference runs the *same attention-block geometry* as the serving
+executables (whole-program XLA fusion is itself shape-dependent — an
+unpadded T=9 forward and a padded T=16 one differ by ~1 ulp at some
+widths, so the reference must share the padded shape family; causal
+masking makes the pad positions exact no-ops).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from ..base import MXNetError, get_env
+from ..ops.attention import decode_attention, flash_attention
+
+__all__ = ["ModelConfig", "exact_mode", "init_params", "config_from_params",
+           "full_forward", "prefill_forward", "decode_step",
+           "reference_last_logits"]
+
+
+def exact_mode():
+    """Default for the ``exact`` knob (``MXNET_SERVE_EXACT``, default 1):
+    bit-exact M-invariant matmuls vs plain gemms."""
+    return get_env("MXNET_SERVE_EXACT", True, bool)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Static decoder geometry (everything the traced functions close
+    over)."""
+    vocab_size: int
+    num_layers: int
+    d_model: int
+    num_heads: int
+    max_len: int          # pos_embed rows == the context ceiling
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.num_heads
+
+    def validate(self):
+        if self.d_model % self.num_heads:
+            raise MXNetError("d_model %d not divisible by num_heads %d"
+                             % (self.d_model, self.num_heads))
+        return self
+
+
+def _mm(x, w, exact):
+    """``x (..., C) @ w (F, C)^T -> (..., F)`` — the ``FullyConnected``/
+    MHA-projection contraction.  ``exact`` selects the M-invariant
+    reduce form (each output element sums over C in an order independent
+    of the leading dims)."""
+    if exact:
+        return (x[..., None, :] * w).sum(axis=-1)
+    import jax.numpy as jnp
+
+    return jnp.einsum("...c,fc->...f", x, w)
+
+
+def _layer_norm(x, gamma, beta):
+    """Training ``LayerNorm`` semantics: axis -1, eps 1e-5, rsqrt form.
+    Row-wise, so it is M-invariant as-is."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + 1e-5) * gamma + beta
+
+
+def init_params(cfg, seed=0, scale=0.02):
+    """Fresh float32 parameters under the training graph's names (for
+    benches/tests; real deployments restore a checkpoint)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg.validate()
+    keys = iter(jax.random.split(jax.random.PRNGKey(seed),
+                                 4 * cfg.num_layers + 4))
+
+    def normal(shape):
+        return (scale * jax.random.normal(next(keys), shape)
+                ).astype(jnp.float32)
+
+    c, v = cfg.d_model, cfg.vocab_size
+    params = {
+        "tok_embed_weight": normal((v, c)),
+        "pos_embed": normal((1, cfg.max_len, c)),
+        "final_ln_gamma": jnp.ones((c,), jnp.float32),
+        "final_ln_beta": jnp.zeros((c,), jnp.float32),
+        "lm_head_weight": normal((v, c)),
+        "lm_head_bias": jnp.zeros((v,), jnp.float32),
+    }
+    for i in range(cfg.num_layers):
+        params.update({
+            "blk%d_ln1_gamma" % i: jnp.ones((c,), jnp.float32),
+            "blk%d_ln1_beta" % i: jnp.zeros((c,), jnp.float32),
+            "blk%d_attn_in_weight" % i: normal((3 * c, c)),
+            "blk%d_attn_in_bias" % i: jnp.zeros((3 * c,), jnp.float32),
+            "blk%d_attn_out_weight" % i: normal((c, c)),
+            "blk%d_attn_out_bias" % i: jnp.zeros((c,), jnp.float32),
+            "blk%d_ln2_gamma" % i: jnp.ones((c,), jnp.float32),
+            "blk%d_ln2_beta" % i: jnp.zeros((c,), jnp.float32),
+            "blk%d_ffn1_weight" % i: normal((4 * c, c)),
+            "blk%d_ffn1_bias" % i: jnp.zeros((4 * c,), jnp.float32),
+            "blk%d_ffn2_weight" % i: normal((c, 4 * c)),
+            "blk%d_ffn2_bias" % i: jnp.zeros((c,), jnp.float32),
+        })
+    return params
+
+
+def config_from_params(params, num_heads):
+    """Derive the :class:`ModelConfig` from parameter shapes (everything
+    except ``num_heads`` — head count does not appear in any shape)."""
+    if "tok_embed_weight" not in params or "pos_embed" not in params:
+        raise MXNetError(
+            "not a transformer LM parameter dict (expected "
+            "tok_embed_weight / pos_embed; got %s)"
+            % sorted(params)[:8])
+    vocab, d_model = params["tok_embed_weight"].shape
+    max_len = params["pos_embed"].shape[1]
+    n = 0
+    while "blk%d_attn_in_weight" % n in params:
+        n += 1
+    if n == 0:
+        raise MXNetError("no blk0_attn_in_weight — zero decoder layers?")
+    return ModelConfig(vocab_size=int(vocab), num_layers=n,
+                       d_model=int(d_model), num_heads=int(num_heads),
+                       max_len=int(max_len)).validate()
+
+
+def _attn_heads(x, n, t, h, d):
+    return x.reshape(n, t, h, d).transpose(0, 2, 1, 3)
+
+
+def _block_attention(params, i, x, cfg, exact, block):
+    """One pre-norm attention sublayer on (n, T, C); returns the
+    residual-added activations plus this layer's (k, v) heads —
+    (n, H, T, D) each, the page-writable prefill byproduct."""
+    n, t, c = x.shape
+    h, d = cfg.num_heads, cfg.head_dim
+    hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
+                      params["blk%d_ln1_beta" % i])
+    import jax.numpy as jnp
+
+    qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
+        + params["blk%d_attn_in_bias" % i]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (_attn_heads(q, n, t, h, d), _attn_heads(k, n, t, h, d),
+               _attn_heads(v, n, t, h, d))
+    ctx = flash_attention(q, k, v, causal=True, block=block, mi=exact)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
+    out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+        + params["blk%d_attn_out_bias" % i]
+    return x + out, (k, v)
+
+
+def _block_mlp(params, i, x, exact):
+    import jax
+
+    hdn = _layer_norm(x, params["blk%d_ln2_gamma" % i],
+                      params["blk%d_ln2_beta" % i])
+    hdn = _mm(hdn, params["blk%d_ffn1_weight" % i], exact) \
+        + params["blk%d_ffn1_bias" % i]
+    hdn = jax.nn.gelu(hdn)
+    hdn = _mm(hdn, params["blk%d_ffn2_weight" % i], exact) \
+        + params["blk%d_ffn2_bias" % i]
+    return x + hdn
+
+
+def full_forward(params, tokens, cfg, exact=None, block=None,
+                 return_kv=False):
+    """Full-context forward: (n, T) int tokens -> (n, T, V) logits.
+
+    The O(T²)-work reference every serve-path output is checked against,
+    and the compute body of the bucketed prefill (``return_kv=True``
+    additionally yields each layer's (k, v) head tensors for the page
+    writes)."""
+    import jax.numpy as jnp
+
+    if exact is None:
+        exact = exact_mode()
+    t = tokens.shape[-1]
+    if t > cfg.max_len:
+        raise MXNetError("sequence length %d > model max_len %d"
+                         % (t, cfg.max_len))
+    x = jnp.take(params["tok_embed_weight"], tokens.astype(jnp.int32),
+                 axis=0)
+    x = x + params["pos_embed"][:, :t]
+    kvs = []
+    for i in range(cfg.num_layers):
+        x, kv = _block_attention(params, i, x, cfg, exact, block)
+        kvs.append(kv)
+        x = _block_mlp(params, i, x, exact)
+    x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
+    logits = _mm(x, params["lm_head_weight"], exact) \
+        + params["lm_head_bias"]
+    if return_kv:
+        return logits, kvs
+    return logits
+
+
+def prefill_forward(params, tokens, length, table_row, k_pool, v_pool,
+                    cfg, page_size, exact=None):
+    """Bucketed prefill: run the full forward over one padded prompt and
+    write its KV into the slot's reserved pages.
+
+    tokens: (1, Tb) prompt padded to the bucket length (a multiple of
+    ``page_size``); length: () int32 true prompt length; table_row:
+    (max_pages,) int32 page ids — entries beyond the slot's reservation
+    point at the trash page, so padded-position garbage lands where no
+    reader looks.  Returns (first_token, last_logits, k_pool, v_pool);
+    the pools are donate-safe.
+    """
+    import jax.numpy as jnp
+
+    if exact is None:
+        exact = exact_mode()
+    _, t_b = tokens.shape
+    if t_b % page_size:
+        raise MXNetError("bucket length %d not a multiple of page size %d"
+                         % (t_b, page_size))
+    logits, kvs = full_forward(params, tokens, cfg, exact=exact,
+                               block=page_size, return_kv=True)
+    n_pages = t_b // page_size
+    h, d = cfg.num_heads, cfg.head_dim
+    for i, (k, v) in enumerate(kvs):
+        # (1, H, Tb, D) -> (Tb, H, D) -> page-major blocks
+        kp = k[0].transpose(1, 0, 2).reshape(n_pages, page_size, h, d)
+        vp = v[0].transpose(1, 0, 2).reshape(n_pages, page_size, h, d)
+        for j in range(n_pages):
+            page = table_row[j]
+            k_pool = k_pool.at[i, page].set(kp[j])
+            v_pool = v_pool.at[i, page].set(vp[j])
+    last = jnp.take(logits[0], length - 1, axis=0)
+    first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    return first_token, last, k_pool, v_pool
+
+
+def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
+                page_size, exact=None):
+    """One continuous-batching decode step for every slot at once.
+
+    tokens: (S,) int32 — each slot's previous output token; lengths:
+    (S,) int32 — KV rows already cached per slot (the new token's
+    position); tables: (S, max_pages) int32 page tables (inactive slots:
+    all-trash rows, length 0).  Appends each slot's new KV at
+    ``lengths``, attends over the gathered pages with the shared
+    online-softmax kernel, and returns
+    (next_tokens (S,), logits (S, V), k_pool, v_pool).
+
+    Per-token cost is constant in the generated length: fixed-shape
+    gather/scatter over the page pool plus ``Tcap/page_size`` block
+    visits — there is no tensor here whose size depends on how many
+    tokens any request has generated.
+    """
+    import jax.numpy as jnp
+
+    if exact is None:
+        exact = exact_mode()
+    s = tokens.shape[0]
+    h, d = cfg.num_heads, cfg.head_dim
+    max_pages = tables.shape[1]
+    x = jnp.take(params["tok_embed_weight"], tokens.astype(jnp.int32),
+                 axis=0)
+    pos = jnp.clip(lengths, 0, cfg.max_len - 1)
+    x = x + jnp.take(params["pos_embed"][0], pos, axis=0)
+    page_slot = jnp.clip(lengths // page_size, 0, max_pages - 1)
+    page = jnp.take_along_axis(tables, page_slot[:, None], axis=1)[:, 0]
+    offset = lengths % page_size
+    for i in range(cfg.num_layers):
+        hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
+                          params["blk%d_ln1_beta" % i])
+        qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
+            + params["blk%d_attn_in_bias" % i]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # append this token's KV at (page, offset); inactive slots write
+        # the trash page (their table rows are all-trash)
+        k_pool = k_pool.at[i, page, offset].set(k.reshape(s, h, d))
+        v_pool = v_pool.at[i, page, offset].set(v.reshape(s, h, d))
+        # gather the slot's full page set: (S, P, page, H, D) ->
+        # (S, H, P*page, D)
+        ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
+        ctx_v = v_pool[i][tables].reshape(s, max_pages * page_size, h, d)
+        ctx_k = ctx_k.transpose(0, 2, 1, 3)
+        ctx_v = ctx_v.transpose(0, 2, 1, 3)
+        att = decode_attention(q.reshape(s, h, 1, d), ctx_k, ctx_v,
+                               lengths + 1, block=page_size, mi=exact)
+        ctx = att.transpose(0, 2, 1, 3).reshape(s, cfg.d_model)
+        out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+            + params["blk%d_attn_out_bias" % i]
+        x = x + out
+        x = _block_mlp(params, i, x, exact)
+    x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
+    logits = _mm(x, params["lm_head_weight"], exact) \
+        + params["lm_head_bias"]
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return next_tokens, logits, k_pool, v_pool
+
+
+@functools.lru_cache(maxsize=None)
+def _reference_fn(cfg, page_size, exact):
+    import jax
+
+    def fwd(params, tokens):
+        return full_forward(params, tokens, cfg, exact=exact,
+                            block=page_size)
+
+    return jax.jit(fwd)
+
+
+def reference_last_logits(params, seq, cfg, page_size, exact=None):
+    """Bit-exactness oracle for the serving path: full-context forward
+    over ``seq`` padded to the next ``page_size`` multiple (the same
+    attention-block geometry the prefill/decode executables run), logits
+    at the last *real* position.  Jitted and cached per padded shape —
+    eager dispatch fuses differently and is NOT bit-comparable."""
+    import jax.numpy as jnp
+
+    exact = exact_mode() if exact is None else bool(exact)
+    seq = [int(t) for t in seq]
+    if not seq:
+        raise MXNetError("reference_last_logits: empty sequence")
+    pad = (-len(seq)) % int(page_size)
+    toks = jnp.asarray([seq + [0] * pad], jnp.int32)
+    logits = _reference_fn(cfg, int(page_size), exact)(params, toks)
+    return logits[0, len(seq) - 1]

@@ -1,0 +1,199 @@
+"""Request queue + batching policies over an :class:`InferenceSession`.
+
+Three policies, all running the *same* compiled executables so the
+bench comparison isolates scheduling:
+
+* ``serial`` — one request at a time, admitted only when the previous
+  one finished.  The baseline every serving system is measured against.
+* ``static`` — classic static batching: admit up to ``slots`` requests
+  only when the batch is empty, run them to completion together.  Head
+  of-line blocking both ways (late arrivals wait for the batch to
+  drain; the batch waits for its slowest member).
+* ``continuous`` — in-flight batching: at *every* decode-step boundary,
+  finished requests are evicted and newly-arrived ones are prefilled
+  into freed slots, so the decode executable runs as full as the
+  arrival process allows.
+
+Requests replay an open-loop arrival trace (``arrival_s`` offsets from
+run start) — the scheduler never back-pressures arrivals, so queueing
+delay shows up in TTFT exactly as a production load balancer would see
+it.
+
+Fault sites (``testing/faults.py``): every admit / decode-step /
+response boundary crosses ``serve_queue`` plus a phase-specific site
+(``serve_admit`` / ``serve_decode`` / ``serve_respond``).  A fault
+fails *that request only*: its slot is released and surviving slots
+keep decoding — the chaos tests assert exactly this isolation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..base import MXNetError
+from ..testing import faults
+
+__all__ = ["Request", "Scheduler", "summarize"]
+
+_POLICIES = ("serial", "static", "continuous")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its measured lifecycle."""
+
+    rid: int
+    prompt: list
+    max_new: int
+    arrival_s: float = 0.0
+    eos_id: int = -1  # -1: never stops early
+    # -- filled in by the scheduler --
+    tokens: list = dataclasses.field(default_factory=list)
+    ttft_s: float = -1.0
+    done_s: float = -1.0
+    failed: bool = False
+    error: str = ""
+
+    @property
+    def finished(self):
+        return self.failed or self.done_s >= 0.0
+
+
+class Scheduler(object):
+    """Drives a session through an arrival trace under one policy."""
+
+    def __init__(self, session, policy="continuous"):
+        if policy not in _POLICIES:
+            raise MXNetError("unknown policy %r (one of %s)"
+                             % (policy, ", ".join(_POLICIES)))
+        self.session = session
+        self.policy = policy
+
+    # -- fault boundaries -------------------------------------------------
+    def _boundary(self, req, slot, site):
+        """Cross a fault boundary for one request; a fault fails the
+        request (releasing its slot if held) and the run continues."""
+        try:
+            faults.inject("serve_queue")
+            faults.inject(site)
+            return True
+        except faults.WorkerKilled as exc:
+            self._fail(req, slot, exc)
+            return False
+        except Exception as exc:  # FaultInjected / MXNetError
+            self._fail(req, slot, exc)
+            return False
+
+    def _fail(self, req, slot, exc):
+        req.failed = True
+        req.error = "%s: %s" % (type(exc).__name__, exc)
+        if slot is not None:
+            try:
+                self.session.release(slot)
+            except MXNetError:
+                pass
+
+    # -- the run loop -----------------------------------------------------
+    def run(self, requests):
+        """Replay ``requests`` (sorted by ``arrival_s``) to completion;
+        returns ``(requests, makespan_s)``."""
+        sess = self.session
+        queue = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
+        pending = list(queue)
+        active = {}  # slot -> Request
+        t0 = time.perf_counter()
+
+        def now():
+            return time.perf_counter() - t0
+
+        while pending or active:
+            # 1) admit whatever the policy allows right now
+            arrived = [r for r in pending if r.arrival_s <= now()]
+            if self.policy == "serial":
+                admit_cap = 1 if not active else 0
+            elif self.policy == "static":
+                admit_cap = sess.config.slots if not active else 0
+            else:
+                admit_cap = sess.config.slots - len(active)
+            for req in arrived[:max(admit_cap, 0)]:
+                if not self._boundary(req, None, "serve_admit"):
+                    pending.remove(req)
+                    continue
+                slot = sess.try_alloc(len(req.prompt), req.max_new)
+                if slot is None:
+                    break  # pool full: stays queued for a later boundary
+                pending.remove(req)
+                first, _ = sess.prefill(slot, req.prompt)
+                req.ttft_s = now() - req.arrival_s
+                req.tokens.append(first)
+                active[slot] = req
+                if len(req.tokens) >= req.max_new or first == req.eos_id:
+                    self._finish(req, slot, active, now)
+
+            if not active:
+                if pending:
+                    # idle until the next arrival (open-loop replay)
+                    wait = min(r.arrival_s for r in pending) - now()
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+                continue
+
+            # 2) per-request decode boundaries (deterministic slot order)
+            for slot in sorted(active):
+                req = active[slot]
+                if not self._boundary(req, slot, "serve_decode"):
+                    del active[slot]
+
+            if not active:
+                continue
+
+            # 3) one fixed-shape decode step advances every survivor
+            step_tokens, _ = sess.step()
+            for slot in sorted(active):
+                req = active[slot]
+                req.tokens.append(step_tokens[slot])
+                if (len(req.tokens) >= req.max_new
+                        or step_tokens[slot] == req.eos_id):
+                    self._finish(req, slot, active, now)
+
+        return queue, now()
+
+    def _finish(self, req, slot, active, now):
+        active.pop(slot, None)
+        if self._boundary(req, slot, "serve_respond"):
+            req.done_s = now()
+            self.session.release(slot)
+
+
+def _percentile(values, pct):
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    idx = min(int(round((pct / 100.0) * (len(vals) - 1))), len(vals) - 1)
+    return float(vals[idx])
+
+
+def summarize(requests, makespan_s):
+    """Latency/throughput rollup the bench emits per policy."""
+    done = [r for r in requests if r.done_s >= 0.0 and not r.failed]
+    failed = [r for r in requests if r.failed]
+    ttfts = [r.ttft_s for r in done if r.ttft_s >= 0.0]
+    per_token = []
+    total_tokens = 0
+    for r in done:
+        total_tokens += len(r.tokens)
+        if len(r.tokens) > 1 and r.ttft_s >= 0.0:
+            decode_span = (r.done_s - r.arrival_s) - r.ttft_s
+            per_token.append(decode_span / (len(r.tokens) - 1))
+    return {
+        "completed": len(done),
+        "failed": len(failed),
+        "total_tokens": total_tokens,
+        "makespan_s": float(makespan_s),
+        "tokens_per_sec": (total_tokens / makespan_s) if makespan_s > 0
+        else 0.0,
+        "ttft_p50_s": _percentile(ttfts, 50),
+        "ttft_p99_s": _percentile(ttfts, 99),
+        "per_token_p50_s": _percentile(per_token, 50),
+        "per_token_p99_s": _percentile(per_token, 99),
+    }

@@ -1,0 +1,406 @@
+"""InferenceSession: bucketed AOT executables over the paged KV cache.
+
+The serving analogue of ``fused.TrainStep.compile`` (PR 4): every
+executable the session will ever run is compiled up front with
+``jax.jit(...).lower(*avals).compile()`` —
+
+* one **prefill** executable per sequence-length bucket (prompts are
+  right-padded to the smallest bucket that fits), and
+* one fixed-shape **decode** executable advancing *all* batch slots by
+  a single token against the paged KV pools.
+
+Because every input shape is frozen (pools, page tables, token/length
+vectors), the compiled-executable count is exactly
+``len(buckets) + 1`` for the session's lifetime.  Each executable gets
+a ``compile_cache`` recompile guard seeded at compile time; a dispatch
+that would need a new trace (a bug) trips ``MXNET_RECOMPILE_WARN`` /
+``RecompileStorm`` just like training steps do.
+
+Model load goes through the v2 elastic checkpoint restore
+(:meth:`InferenceSession.from_checkpoint`), so an N-process training
+run's shards serve directly in a single process.
+
+Env knobs (see docs/env_vars.md): ``MXNET_SERVE_SLOTS``,
+``MXNET_SERVE_PAGE``, ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_NEW``,
+``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+from ..base import MXNetError, get_env
+from .kv_cache import PagedKVCache
+from .model import ModelConfig, config_from_params, decode_step, exact_mode, \
+    prefill_forward
+
+__all__ = ["ServeConfig", "InferenceSession"]
+
+
+def _parse_buckets(raw):
+    if isinstance(raw, str):
+        parts = [p for p in raw.replace(";", ",").split(",") if p.strip()]
+        raw = [int(p) for p in parts]
+    buckets = tuple(sorted(set(int(b) for b in raw)))
+    if not buckets:
+        raise MXNetError("ServeConfig: empty bucket set")
+    return buckets
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Capacity knobs for one :class:`InferenceSession`.
+
+    ``buckets`` are padded prefill lengths (each a multiple of
+    ``page_size``); ``max_new`` caps tokens generated per request;
+    ``num_pages`` sizes the shared KV pool (default: full reservation
+    capacity for ``slots`` worst-case requests).
+    """
+
+    slots: int = 4
+    page_size: int = 16
+    buckets: tuple = (16, 32, 64)
+    max_new: int = 32
+    num_pages: int = 0  # 0 = slots * max_pages_per_slot
+    exact: bool = True
+
+    @classmethod
+    def from_env(cls, **overrides):
+        vals = dict(
+            slots=get_env("MXNET_SERVE_SLOTS", cls.slots, int),
+            page_size=get_env("MXNET_SERVE_PAGE", cls.page_size, int),
+            buckets=_parse_buckets(
+                get_env("MXNET_SERVE_BUCKETS", "16,32,64", str)),
+            max_new=get_env("MXNET_SERVE_MAX_NEW", cls.max_new, int),
+            num_pages=get_env("MXNET_SERVE_PAGES", 0, int),
+            exact=exact_mode(),
+        )
+        vals.update(overrides)
+        return cls(**vals)
+
+    def __post_init__(self):
+        object.__setattr__(self, "buckets", _parse_buckets(self.buckets))
+        if self.slots < 1 or self.page_size < 1 or self.max_new < 1:
+            raise MXNetError("ServeConfig: slots/page_size/max_new must "
+                             "be >= 1")
+        for b in self.buckets:
+            if b % self.page_size:
+                raise MXNetError(
+                    "ServeConfig: bucket %d is not a multiple of page_size "
+                    "%d (prefill writes whole pages)" % (b, self.page_size))
+
+    @property
+    def max_pages_per_slot(self):
+        worst = max(self.buckets) + self.max_new
+        return -(-worst // self.page_size)
+
+    @property
+    def pool_pages(self):
+        return self.num_pages or self.slots * self.max_pages_per_slot
+
+
+class _Executable(object):
+    """One AOT-compiled entry point + its recompile guard."""
+
+    __slots__ = ("name", "compiled", "jitted", "guard", "aval_sig",
+                 "memory", "fallbacks")
+
+    def __init__(self, name, compiled, jitted, guard, aval_sig, memory):
+        self.name = name
+        self.compiled = compiled
+        self.jitted = jitted
+        self.guard = guard
+        self.aval_sig = aval_sig
+        self.memory = memory  # dict from memory_analysis(), at compile time
+        self.fallbacks = 0
+
+
+class InferenceSession(object):
+    """Compile-once serving session for the built-in transformer LM.
+
+    ``params`` is a flat name->array dict (raw ``jax.numpy`` arrays,
+    numpy arrays, or NDArray) under the training parameter names;
+    ``num_heads`` is required unless recoverable from a checkpoint
+    symbol.  All executables are compiled in ``__init__`` — steady-state
+    serving never traces.
+    """
+
+    def __init__(self, params, num_heads, config=None):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import compile_cache, profiler
+
+        compile_cache.ensure_initialized()
+        self.config = config or ServeConfig.from_env()
+        cfg = self.config
+        self.params = {}
+        for k, v in params.items():
+            if k in ("data", "softmax_label"):
+                continue
+            arr = getattr(v, "_data", v)
+            self.params[k] = jnp.asarray(arr, jnp.float32)
+        self.model = config_from_params(self.params, num_heads=num_heads)
+        if max(cfg.buckets) + cfg.max_new > self.model.max_len:
+            raise MXNetError(
+                "ServeConfig worst case %d (bucket %d + max_new %d) exceeds "
+                "the model's max_len %d"
+                % (max(cfg.buckets) + cfg.max_new, max(cfg.buckets),
+                   cfg.max_new, self.model.max_len))
+        self.cache = PagedKVCache(
+            num_layers=self.model.num_layers,
+            num_heads=self.model.num_heads,
+            head_dim=self.model.head_dim,
+            page_size=cfg.page_size,
+            num_pages=cfg.pool_pages,
+            slots=cfg.slots,
+            max_pages_per_slot=cfg.max_pages_per_slot)
+        self._slot_tokens = {}  # slot -> next token to feed the decoder
+        self._exes = {}
+        # Recompile guards live in the process-global registry; embed the
+        # model + capacity fingerprint in the guard name so two sessions
+        # with different shapes (different avals) don't share a guard and
+        # read each other's compiles as retraces.  Identical-config
+        # sessions deliberately share: same avals -> same signature.
+        self._guard_prefix = (
+            "InferenceSession(%dL-d%d-h%d-V%d-s%d-p%d-m%d-n%d)"
+            % (self.model.num_layers, self.model.d_model,
+               self.model.num_heads, self.model.vocab_size, cfg.slots,
+               cfg.page_size, cfg.max_pages_per_slot, cfg.pool_pages))
+        self._compile_all()
+
+    # -- compilation ------------------------------------------------------
+    def _aot(self, name, fn, avals, donate_argnums):
+        """``TrainStep.compile``-style AOT build of one executable."""
+        import jax
+
+        from .. import compile_cache, profiler
+        from ..compile_cache import registry, signature_of
+
+        jitted = jax.jit(fn, donate_argnums=donate_argnums)
+        hits_before = compile_cache.cache_stats()["hits"]
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*avals).compile()
+        dt = time.perf_counter() - t0
+        cache_hit = compile_cache.cache_stats()["hits"] > hits_before
+        flops = None
+        code_bytes = None
+        memory = {}
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0)) or None
+        except Exception:
+            pass
+        try:
+            mem = compiled.memory_analysis()
+            for attr in ("generated_code_size_in_bytes",
+                         "argument_size_in_bytes",
+                         "output_size_in_bytes",
+                         "temp_size_in_bytes"):
+                val = getattr(mem, attr, None)
+                if val is not None:
+                    memory[attr] = int(val)
+            code_bytes = memory.get("generated_code_size_in_bytes")
+        except Exception:
+            pass
+        profiler.compile_event("%s.%s" % (self._guard_prefix, name), dt,
+                               flops=flops, executable_bytes=code_bytes,
+                               cache_hit=cache_hit)
+        guard = registry.guard("%s.%s" % (self._guard_prefix, name))
+        sig = signature_of(avals)
+        guard.observe(sig)
+        self._exes[name] = _Executable(name, compiled, jitted, guard,
+                                       sig, memory)
+
+    def _compile_all(self):
+        import jax
+        import numpy as np
+
+        cfg = self.config
+        model = self.model
+        exact = bool(cfg.exact)
+        psize = cfg.page_size
+        f32 = jax.numpy.float32
+        i32 = jax.numpy.int32
+        sds = jax.ShapeDtypeStruct
+        param_avals = {k: sds(v.shape, v.dtype)
+                       for k, v in self.params.items()}
+        pool_shape = self.cache.k_pool.shape
+        pool_aval = sds(pool_shape, f32)
+        max_pages = cfg.max_pages_per_slot
+
+        def decode_fn(params, tokens, lengths, tables, k_pool, v_pool):
+            return decode_step(params, tokens, lengths, tables, k_pool,
+                               v_pool, model, psize, exact=exact)
+
+        self._aot(
+            "decode", decode_fn,
+            (param_avals, sds((cfg.slots,), i32), sds((cfg.slots,), i32),
+             sds((cfg.slots, max_pages), i32), pool_aval, pool_aval),
+            donate_argnums=(4, 5))
+
+        for bucket in cfg.buckets:
+            def prefill_fn(params, tokens, length, table_row, k_pool,
+                           v_pool):
+                return prefill_forward(params, tokens, length, table_row,
+                                       k_pool, v_pool, model, psize,
+                                       exact=exact)
+
+            self._aot(
+                "prefill_%d" % bucket, prefill_fn,
+                (param_avals, sds((1, bucket), i32), sds((), i32),
+                 sds((max_pages,), i32), pool_aval, pool_aval),
+                donate_argnums=(4, 5))
+
+    @classmethod
+    def from_checkpoint(cls, directory, prefix="model", epoch=None,
+                        num_heads=None, config=None):
+        """Load params through the v2 elastic checkpoint restore and
+        build a session.  An N-process training run's shards assemble
+        in this single process; ``num_heads`` is read from the saved
+        symbol when present."""
+        from ..checkpoint import CheckpointManager
+
+        state = CheckpointManager(directory, prefix=prefix).load(epoch=epoch)
+        if num_heads is None and state.symbol is not None:
+            num_heads = _num_heads_from_symbol(state.symbol)
+        if num_heads is None:
+            raise MXNetError(
+                "from_checkpoint: pass num_heads= (the checkpoint symbol "
+                "does not record a MultiHeadAttention op)")
+        params = dict(state.arg_params)
+        params.update(state.aux_params or {})
+        return cls(params, num_heads=num_heads, config=config)
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch(self, name, args):
+        from ..compile_cache import signature_of
+
+        rec = self._exes[name]
+        sig = signature_of(args)
+        rec.guard.observe(sig)
+        try:
+            return rec.compiled(*args)
+        except Exception:
+            # Shape/dtype drift from the compiled avals (guarded above)
+            # falls back to the lazy jit rather than failing the request.
+            rec.fallbacks += 1
+            return rec.jitted(*args)
+
+    # -- request lifecycle ------------------------------------------------
+    def bucket_for(self, prompt_len):
+        for b in self.config.buckets:
+            if prompt_len <= b:
+                return b
+        raise MXNetError(
+            "prompt of %d tokens exceeds the largest prefill bucket %d"
+            % (prompt_len, max(self.config.buckets)))
+
+    def try_alloc(self, prompt_len, max_new=None):
+        """Reserve a slot for a request, or return ``None`` when the
+        cache can't admit it right now."""
+        if prompt_len < 1:
+            raise MXNetError("empty prompt")
+        self.bucket_for(prompt_len)  # validates length
+        max_new = self.config.max_new if max_new is None else int(max_new)
+        if max_new > self.config.max_new:
+            raise MXNetError("max_new %d exceeds the session cap %d"
+                             % (max_new, self.config.max_new))
+        return self.cache.alloc(prompt_len, max_new)
+
+    def prefill(self, slot, prompt_tokens):
+        """Run the bucketed prefill for ``slot``; returns
+        ``(first_token, last_logits)``."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        p = int(prompt.shape[0])
+        bucket = self.bucket_for(p)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :p] = prompt
+        args = (self.params, jnp.asarray(toks), jnp.asarray(p, jnp.int32),
+                self.cache.table_row(slot), self.cache.k_pool,
+                self.cache.v_pool)
+        first, last_logits, k_pool, v_pool = self._dispatch(
+            "prefill_%d" % bucket, args)
+        self.cache.k_pool = k_pool
+        self.cache.v_pool = v_pool
+        self.cache.lengths[slot] = p
+        first = int(first)
+        self._slot_tokens[slot] = first
+        return first, np.asarray(last_logits)
+
+    def step(self):
+        """Advance every active slot one token with the single decode
+        executable; returns ``(tokens, logits)`` where ``tokens`` maps
+        slot -> emitted token id and ``logits`` is the (slots, vocab)
+        array (inactive rows are garbage by design)."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        cfg = self.config
+        tokens = np.zeros((cfg.slots,), np.int32)
+        for slot, tok in self._slot_tokens.items():
+            tokens[slot] = tok
+        args = (self.params, jnp.asarray(tokens),
+                self.cache.device_lengths(), self.cache.device_tables(),
+                self.cache.k_pool, self.cache.v_pool)
+        next_toks, logits, k_pool, v_pool = self._dispatch("decode", args)
+        self.cache.k_pool = k_pool
+        self.cache.v_pool = v_pool
+        next_np = np.asarray(next_toks)
+        out = {}
+        for slot in list(self._slot_tokens):
+            self.cache.lengths[slot] += 1
+            tok = int(next_np[slot])
+            self._slot_tokens[slot] = tok
+            out[slot] = tok
+        return out, np.asarray(logits)
+
+    def release(self, slot):
+        self._slot_tokens.pop(slot, None)
+        self.cache.release(slot)
+
+    def active_slots(self):
+        return sorted(self._slot_tokens)
+
+    # -- accounting -------------------------------------------------------
+    @property
+    def executables(self):
+        """name -> compiled executable (fixed set: buckets + decode)."""
+        return {name: rec.compiled for name, rec in self._exes.items()}
+
+    def memory_analysis(self, name="decode"):
+        """Compile-time ``memory_analysis()`` numbers for one
+        executable — the decode entry is the flat per-step watermark."""
+        return dict(self._exes[name].memory)
+
+    def guard_report(self):
+        return {name: rec.guard.snapshot() for name, rec in
+                self._exes.items()}
+
+    def fallback_count(self):
+        return sum(rec.fallbacks for rec in self._exes.values())
+
+
+def _num_heads_from_symbol(symbol):
+    """Pull ``num_heads`` out of a saved symbol's MultiHeadAttention
+    node, if the checkpoint recorded one."""
+    try:
+        graph = json.loads(symbol.tojson())
+    except Exception:
+        return None
+    for node in graph.get("nodes", []):
+        op = (node.get("op") or "").lower()
+        if "multiheadattention" in op.replace("_", ""):
+            attrs = node.get("attrs") or node.get("param") or {}
+            if "num_heads" in attrs:
+                try:
+                    return int(attrs["num_heads"])
+                except (TypeError, ValueError):
+                    pass
+    return None

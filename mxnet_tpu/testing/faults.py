@@ -56,7 +56,14 @@ with ``path=`` — ``bitflip``/``truncate`` here model post-write disk
 rot), ``collective`` (kvstore DCN barrier / cross-replica sum),
 ``numerics`` (Module's fused step — poison one batch element with the
 returned nan/inf), ``step`` (top of every fit batch — ``hang`` here
-trips the step watchdog).
+trips the step watchdog), ``serve_queue`` (the serving scheduler —
+crossed at *every* request boundary) plus its phase-specific companions
+``serve_admit`` / ``serve_decode`` / ``serve_respond`` (admission,
+per-request decode-step, and response boundaries; a fault fails that
+one request and releases its slot — surviving slots keep decoding, the
+isolation the serve chaos tests assert).  The serve sites fire in
+deterministic slot order each step, so ``after=N`` picks a specific
+request.
 
 The parsed spec auto-refreshes when the env var string changes; call
 :func:`reset` to re-arm counters when reusing the same string (tests).
