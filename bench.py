@@ -303,6 +303,22 @@ def main():
         except Exception as exc:  # keep the primary metric robust
             result["transformer_error"] = str(exc)[:200]
         _emit_partial()
+    # ZeRO A/B row: the sharded update's state shrink (~1/N per
+    # replica) and step-rate ratio vs the replicated update, over the
+    # local device mesh (bench_fit.measure_zero_ab; skipped when the
+    # host exposes a single device).  Cheap MLP config — the claim
+    # under test is the collective swap, not model FLOPs.
+    if not fp32 and "--resnet-only" not in sys.argv:
+        try:
+            import bench_fit
+
+            zsym = bench_fit.build_sym(512, 1024, 10)
+            zrow = bench_fit.measure_zero_ab(zsym, 64, 512)
+            for k, v in zrow.items():
+                result[k] = v
+        except Exception as exc:  # keep the primary metric robust
+            result["zero_ab_error"] = str(exc)[:200]
+        _emit_partial()
     # serving summary row: continuous-batching speedup over serial plus
     # the continuous tokens/s and tail TTFT (bench_serve.py has the
     # full per-policy breakdown and the bit-exactness/KV-flat probes)

@@ -88,6 +88,54 @@ def measure_pure_step(sym, batch, feat, iters=60):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def measure_zero_ab(sym, batch, feat, iters=30):
+    """zero=on vs zero=off A/B over the device mesh: step rate, the
+    per-replica optimizer-state bytes (the ZeRO 1/N claim) and the
+    per-step fresh-param all-gather bytes.  Adam, so the state is real
+    (two moments per weight); skipped on a single-device host where the
+    sharded update auto-declines."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.fused import TrainStep
+    from mxnet_tpu.parallel import create_mesh
+
+    ndev = len(jax.devices())
+    if ndev < 2 or batch % ndev:
+        return {}
+    mesh = create_mesh({"data": ndev})
+    out = {"zero_ndev": ndev}
+    rates = {}
+    for mode in ("off", "on"):
+        step = TrainStep(sym, optimizer="adam",
+                         optimizer_params={"learning_rate": 0.125,
+                                           "rescale_grad": 1.0 / batch},
+                         mesh=mesh, zero=mode)
+        shapes = {"data": (batch, feat), "softmax_label": (batch,)}
+        params, aux, states = step.init_state(shapes)
+        rng = jax.random.PRNGKey(0)
+        bd = {"data": jax.random.normal(rng, shapes["data"], "float32"),
+              "softmax_label": jax.numpy.zeros(shapes["softmax_label"],
+                                               "float32")}
+        params, aux, states, out_ = step(params, aux, states, bd, rng)
+        float(np.asarray(out_[0][0, 0]))  # compile + force
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, aux, states, out_ = step(params, aux, states, bd, rng)
+        float(np.asarray(out_[0][0, 0]))
+        rates[mode] = batch * iters / (time.perf_counter() - t0)
+        rep = step.memory_report(params, states)
+        out["opt_state_bytes_%s" % mode] = int(rep["opt_state_bytes"])
+        if mode == "on":
+            out["update_gather_bytes"] = int(rep["update_gather_bytes"])
+    out["zero_off_images_per_sec"] = round(rates["off"], 2)
+    out["zero_on_images_per_sec"] = round(rates["on"], 2)
+    out["zero_step_ratio"] = round(rates["on"] / rates["off"], 4)
+    out["zero_state_shrink"] = round(
+        out["opt_state_bytes_off"] / max(1, out["opt_state_bytes_on"]), 3)
+    return out
+
+
 def make_host_work_iter(base, repeats):
     """Wrap a DataIter with a fixed slab of numpy work per batch — the
     stand-in for decode/augment cost.  Runs on whatever thread consumes
@@ -264,6 +312,9 @@ def main():
         result["pipeline_speedup"] = round(fit_s / nopipe_s, 4)
     # checkpoint write cost on the training thread, sync vs async
     result.update(measure_ckpt_save(sym, X, y, batch))
+    # ZeRO sharded update A/B: state bytes must shrink ~1/N at >=95%
+    # of the replicated step rate
+    result.update(measure_zero_ab(sym, batch, feat))
     # compile_s/step_s split + cache counters (fit's AOT warmup and the
     # pure-step AOT compile both record through profiler.compile_event)
     result.update(bench_util.compile_summary())

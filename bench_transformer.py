@@ -83,6 +83,13 @@ def measure(argv=None):
     except Exception:
         _RESULT["attn_peak_bytes"] = None
     params, aux, states = step.init_state(shapes)
+    # optimizer-state residency beside the attention peak: per-replica
+    # state bytes plus the per-step fresh-param all-gather volume (0
+    # unless the ZeRO sharded update is active — needs a >=2-way mesh)
+    mem_rep = step.memory_report(params, states)
+    _RESULT["opt_state_bytes"] = int(mem_rep.get("opt_state_bytes") or 0)
+    _RESULT["update_gather_bytes"] = int(
+        mem_rep.get("update_gather_bytes") or 0)
     rng = jax.random.PRNGKey(0)
     toks = jnp.asarray(
         np.random.RandomState(0).randint(

@@ -187,7 +187,8 @@ class CheckpointState:
     """Everything ``fit(resume_from=...)`` needs to continue a run."""
 
     def __init__(self, epoch, nbatch, num_update, symbol, arg_params,
-                 aux_params, states_path=None, prefix=None, manifest=None):
+                 aux_params, states_path=None, prefix=None, manifest=None,
+                 opt_states=None):
         self.epoch = int(epoch)          # completed epochs
         self.nbatch = int(nbatch)        # extra batches into epoch `epoch`
         self.num_update = int(num_update)
@@ -197,6 +198,10 @@ class CheckpointState:
         self.states_path = states_path   # optimizer states file, or None
         self.prefix = prefix
         self.manifest = manifest         # v2 manifest dict, or None (v1)
+        # canonical (weight-shaped, by-name) fused optimizer states from
+        # a ZeRO piece-window save, or None — consumed by
+        # Module.set_fused_optimizer_states on resume
+        self.opt_states = opt_states
 
     def __repr__(self):
         return ("CheckpointState(epoch=%d, nbatch=%d, num_update=%d, "
@@ -299,13 +304,22 @@ class CheckpointManager:
 
     # -- save -----------------------------------------------------------
     def save(self, module=None, epoch=0, nbatch=0, symbol=None,
-             arg_params=None, aux_params=None):
+             arg_params=None, aux_params=None, zero_states=None,
+             num_update=None):
         """Write one checkpoint.  Pass a bound ``module`` (params, aux,
         symbol and optimizer states are pulled from it) or explicit
         ``symbol``/``arg_params``/``aux_params``.  ``epoch`` counts
         completed epochs; ``nbatch > 0`` marks a mid-epoch preemption
         point.  Every rank writes its shards, rank 0 merges + publishes
         the manifest, every rank barriers; returns the epoch tag.
+
+        ``zero_states``: a ``parallel.zero.export_states`` descriptor for
+        module-less callers driving ``TrainStep`` directly (a module's
+        ZeRO states are exported automatically); the sharded optimizer
+        state rides the same piece-window format as the params, so every
+        rank contributes its own 1/N windows and ANY topology can
+        reassemble them on load.  ``num_update`` overrides the update
+        count recorded in the manifest (module-less saves).
 
         With async writes on, only the device→host snapshot happens on
         this thread; serialization and publish run on the
@@ -325,12 +339,17 @@ class CheckpointManager:
         aux_params = aux_params or {}
 
         if int(get_env("MXNET_CKPT_FORMAT", 2, int)) < 2:
+            if zero_states is not None:
+                raise MXNetError(
+                    "ZeRO-sharded optimizer state needs the v2 "
+                    "piece-window checkpoint format (MXNET_CKPT_FORMAT=2)")
             return self._save_v1(module, epoch, nbatch, symbol,
                                  arg_params, aux_params)
 
         os.makedirs(self.directory, exist_ok=True)
         snap = self._snapshot(module, epoch, nbatch, symbol, arg_params,
-                              aux_params)
+                              aux_params, zero_states=zero_states,
+                              num_update=num_update)
         if self.async_writes and self._async_eligible():
             self._join_writer()  # depth-1 bound: one write in flight
             t = threading.Thread(target=self._commit_guarded, args=(snap,),
@@ -359,34 +378,63 @@ class CheckpointManager:
         return False
 
     def _snapshot(self, module, epoch, nbatch, symbol, arg_params,
-                  aux_params):
+                  aux_params, zero_states=None, num_update=None):
         """Device→host snapshot, on the calling thread: after this
         returns, the training loop may mutate params freely."""
         rank = self._rank()
         params_meta, pieces, piece_map = {}, {}, {}
+
+        def _add(key, arr):
+            meta, owned = _host_pieces(arr, rank)
+            params_meta[key] = meta
+            for i, (idx, data) in enumerate(owned):
+                pkey = "%s/%d" % (key, i)
+                pieces[pkey] = data
+                piece_map[pkey] = {"param": key, "index": idx}
+
         for tag, params in (("arg", arg_params), ("aux", aux_params)):
             for name, arr in params.items():
-                key = "%s:%s" % (tag, name)
-                meta, owned = _host_pieces(arr, rank)
-                params_meta[key] = meta
-                for i, (idx, data) in enumerate(owned):
-                    pkey = "%s/%d" % (key, i)
-                    pieces[pkey] = data
-                    piece_map[pkey] = {"param": key, "index": idx}
+                _add("%s:%s" % (tag, name), arr)
+        if zero_states is None and self.save_optimizer_states and \
+                module is not None:
+            exporter = getattr(module, "_export_zero_states", None)
+            if exporter is not None:
+                zero_states = exporter()
+        zero_meta = None
+        if zero_states is not None:
+            # the sharded optimizer state pieces ride the params format:
+            # each flat leaf is a sharded jax.Array whose addressable 1/N
+            # windows this rank owns (unsharded leaves go whole via rank
+            # 0), so elastic reassembly on load is the same code path
+            zero_meta = {}
+            for name, ent in zero_states.items():
+                zero_meta[name] = {
+                    "structure": ent["structure"],
+                    "num_leaves": len(ent["leaves"]),
+                    "flat": [bool(f) for f in ent["flat"]],
+                    "logical": int(ent["logical"]),
+                    "canonical_shape": [int(s)
+                                        for s in ent["canonical_shape"]],
+                }
+                for j, leaf in enumerate(ent["leaves"]):
+                    _add("opt:%s/%d" % (name, j), leaf)
         states = None
-        if rank == 0 and self.save_optimizer_states and \
-                module is not None and \
+        if zero_meta is None and rank == 0 and \
+                self.save_optimizer_states and module is not None and \
                 getattr(module, "optimizer_initialized", False):
             states = self._states_blob(module)
         opt = getattr(module, "_optimizer", None) \
             if module is not None else None
+        if num_update is None:
+            num_update = int(getattr(opt, "num_update", 0) or 0)
         return {"epoch": epoch, "nbatch": int(nbatch),
-                "num_update": int(getattr(opt, "num_update", 0) or 0),
+                "num_update": int(num_update),
                 "symbol_json": symbol.tojson() if symbol is not None
                 else None,
                 "rank": rank, "nproc": self._num_workers(),
                 "params_meta": params_meta, "pieces": pieces,
-                "piece_map": piece_map, "states": states}
+                "piece_map": piece_map, "states": states,
+                "zero_meta": zero_meta}
 
     def _states_blob(self, module):
         """Optimizer states as bytes (the module API writes files, so
@@ -482,7 +530,8 @@ class CheckpointManager:
                 "num_processes": snap["nproc"],
                 "params": snap["params_meta"],
                 "shards": self._merge_sidecars(epoch, snap["nproc"]),
-                "states": states_entry}
+                "states": states_entry,
+                "zero_states": snap.get("zero_meta")}
             atomic_replace(self._manifest_path(epoch),
                            lambda tmp: _write_json(tmp, manifest))
             self._gc()
@@ -656,6 +705,7 @@ class CheckpointManager:
                     "(quarantined as *.corrupt): %s"
                     % (epoch, self.prefix, "; ".join(problems)))
         arrays = self._assemble(manifest)
+        opt_states = self._reassemble_zero(manifest, arrays)
         arg_params, aux_params = {}, {}
         resolved_mesh, rule_shardings = self._restore_layout(
             mesh, sharding, arrays)
@@ -678,8 +728,39 @@ class CheckpointManager:
             nbatch=manifest.get("nbatch", 0),
             num_update=manifest.get("num_update", 0), symbol=symbol,
             arg_params=arg_params, aux_params=aux_params,
-            states_path=states if os.path.exists(states) else None,
-            prefix=self.prefix, manifest=manifest)
+            states_path=states if os.path.exists(states)
+            and opt_states is None else None,
+            prefix=self.prefix, manifest=manifest, opt_states=opt_states)
+
+    def _reassemble_zero(self, manifest, arrays):
+        """Pop ``opt:`` entries out of the assembled arrays and rebuild
+        the canonical (unsharded, full-shape) optimizer-state dict a
+        ZeRO save recorded in the manifest.  The caller hands this to
+        ``Module.set_fused_optimizer_states``; because the leaves are
+        full host arrays the restore topology is free to differ from
+        the save topology (N-replica shards → M replicas or unsharded)."""
+        zmeta = manifest.get("zero_states")
+        if not zmeta:
+            # drop stray opt: keys so the arg/aux routing never sees them
+            for key in [k for k in arrays if k.startswith("opt:")]:
+                arrays.pop(key)
+            return None
+        from .parallel import zero as _zero
+
+        opt_states = {}
+        for name, ent in zmeta.items():
+            leaves = []
+            for j in range(int(ent["num_leaves"])):
+                arr = arrays.pop("opt:%s/%d" % (name, j))
+                if ent["flat"][j]:
+                    arr = arr.reshape(-1)[:int(ent["logical"])] \
+                        .reshape([int(s) for s in ent["canonical_shape"]])
+                leaves.append(arr)
+            opt_states[name] = _zero.state_unflatten(
+                ent["structure"], leaves)
+        for key in [k for k in arrays if k.startswith("opt:")]:
+            arrays.pop(key)
+        return opt_states
 
     def _load_v1(self, epoch):
         from . import model as model_mod

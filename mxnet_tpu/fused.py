@@ -35,6 +35,15 @@ Extra TPU-first knobs the reference exposes differently:
   ``(K, batch, …)`` super-batch and ``lax.scan``s K donated updates in
   ONE device call, amortizing Python dispatch for small models (fed by
   ``io.DevicePrefetchIter(steps_per_call=K)``; see docs/performance.md).
+* ``zero='auto'|'on'|'off'`` (``MXNET_ZERO``) — ZeRO-style sharded
+  weight update (arXiv 2004.13336): gradients reduce-scatter over the
+  data axis, optimizer state and the update live on the local 1/N flat
+  tile, fresh params all-gather — ~1/N optimizer-state memory and
+  update FLOPs per replica (see ``parallel/zero.py`` and
+  docs/performance.md).  ``auto`` engages on a ≥2-device data axis with
+  replicated params; composes with the DDP grad overlap (the bucketed
+  psum becomes a bucketed psum_scatter), ``steps_per_call``, health
+  guards, the dynamic loss scaler, and AOT ``compile()``.
 * ``health=StepHealth(...)`` — run-health sentinel: the step
   additionally returns a global gradient norm, an all-params non-finite
   flag, and (with a :class:`~mxnet_tpu.health.DynamicLossScaler`) the
@@ -70,6 +79,22 @@ def _buffer_key(x):
         return ("id", id(x))
 
 
+def _place(tree, shardings):
+    """Place ``tree`` per ``shardings`` (one sharding broadcast over the
+    tree, or a {name: sharding-or-subtree} dict), multiprocess-safe via
+    :func:`parallel.zero.put`."""
+    import jax
+
+    from .parallel.zero import put
+
+    if shardings is None:
+        return tree
+    if isinstance(shardings, dict):
+        return {n: jax.tree.map(put, tree[n], shardings[n])
+                for n in tree}
+    return jax.tree.map(lambda x: put(x, shardings), tree)
+
+
 def _resolve_remat(remat):
     import jax
 
@@ -93,7 +118,7 @@ class TrainStep:
                  label_names=("softmax_label",), dtype="float32",
                  batch_sharding_axis="data", compute_dtype=None,
                  remat=None, fixed_param_names=(), param_sharding=None,
-                 steps_per_call=1, health=None):
+                 steps_per_call=1, health=None, zero=None):
         import jax
         import jax.numpy as jnp
 
@@ -174,15 +199,35 @@ class TrainStep:
         # arm here (best effort — first TrainStep in the process, before
         # the backend initializes)
         from .parallel import overlap as _overlap
+        from .parallel import zero as _zero
 
         _overlap.arm_latency_hiding()
+        # decline warnings scope to THIS step: a rebuilt TrainStep with a
+        # different config re-reports its own decline reasons
+        self._overlap_warner = warner = _overlap.DeclineWarner()
         ddp_ax = _overlap.ddp_axis(mesh, batch_sharding_axis,
-                                   param_sharding)
+                                   param_sharding, warner=warner)
         ddp_bucket = _overlap.grad_bucket_bytes()
         # reverse graph-construction order approximates the order
         # backward produces gradients in
         ddp_order = tuple(reversed(self.param_names))
         self.grad_overlap_axis = ddp_ax
+
+        # ZeRO sharded update (arXiv 2004.13336): optimizer state and the
+        # weight update tile 1/N over the data axis — gradients arrive
+        # reduce-scattered, the update runs on the local flat tile, fresh
+        # params all-gather for the next forward
+        zax = _zero.zero_axis(mesh, batch_sharding_axis, param_sharding,
+                              mode=zero, warn=warner.warn)
+        self.zero_axis = zax
+        zero_n = int(mesh.shape[zax]) if zax is not None else 0
+        zero_min = _zero.min_param_bytes()
+        self._zero_n = zero_n
+        self._zero_min_bytes = zero_min
+        self._frozen = frozen
+        # set by Module when it drives this step, so the bounded sharded-
+        # update dispatch can attach the kvstore's peer diagnosis
+        self._kvstore = None
 
         def cast_compute(x):
             return x.astype(cdtype) if jnp.issubdtype(
@@ -207,6 +252,10 @@ class TrainStep:
                     loss = loss * hstate["loss_scale"]
                 return loss, (outs, new_aux)
 
+            # ZeRO tiling decision, recomputed at trace time from shapes
+            # only, so it always agrees with init_state/_abstract_inputs
+            zlay = (_zero.layout(params, zero_n, zero_min, frozen)
+                    if zax is not None else None)
             vag = None
             if ddp_ax is not None:
                 # None = this trace can't run the DDP path (indivisible
@@ -214,12 +263,24 @@ class TrainStep:
                 vag = _overlap.ddp_value_and_grad(
                     loss_fn, params, batch, rng, mesh, ddp_ax,
                     frozen=frozen, order=ddp_order,
-                    bucket_bytes=ddp_bucket)
+                    bucket_bytes=ddp_bucket, warner=warner,
+                    zero_layout=zlay if ddp_ax == zax else None)
             if vag is None:
                 vag = jax.value_and_grad(
                     lambda p: loss_fn(p, batch, rng),
                     has_aux=True)(params)
             (loss, (outs, new_aux)), grads = vag
+            if zlay is not None:
+                # normalize: sharded grads still at full shape came from
+                # the GSPMD fallback (or a declined DDP trace) — the
+                # sharding constraint on the flat form IS the
+                # reduce-scatter (DDP-path grads arrive already flat)
+                grads = dict(grads)
+                for k, ent in zlay.items():
+                    if (ent.sharded and k in grads
+                            and tuple(grads[k].shape) == ent.shape):
+                        grads[k] = _zero.shard_flat(grads[k], ent, mesh,
+                                                    zax)
             live = [k for k in sorted(grads) if k not in frozen]
             if scaler is not None:
                 inv = 1.0 / hstate["loss_scale"]
@@ -246,6 +307,14 @@ class TrainStep:
                     if k in frozen:
                         new_params[k] = params[k]
                         new_states[k] = states[k]
+                        continue
+                    if zlay is not None and zlay[k].sharded:
+                        new_params[k], new_states[k] = \
+                            opt_mod.sharded_fused_update(
+                                optimizer, params[k], g, states[k],
+                                lr * lr_mults[k], base_wd * wd_mults[k],
+                                t, jax.random.fold_in(rng, i + 1),
+                                mesh, zax, zlay[k])
                         continue
                     new_params[k], new_states[k] = optimizer.fused_update(
                         params[k], g, states[k],
@@ -367,9 +436,18 @@ class TrainStep:
                 from .parallel.sharding import param_sharding_rules
 
                 param_sharding_rules(param_sharding)
+        # AOT compile() works everywhere except shape-dependent
+        # param_sharding (fsdp resolves against concrete shapes)
+        self._aot_capable = not (
+            mesh is not None and param_sharding not in (None, "replicated"))
         if mesh is not None and param_sharding not in (None, "replicated"):
             # FSDP's largest-dim rule needs concrete parameter SHAPES, so
             # the jitted step is built lazily on the first call
+            self._jit_step = None
+        elif zax is not None:
+            # ZeRO state shardings resolve against the optimizer-state
+            # pytree structure: lazily from the first call's concrete
+            # states, or from compile()'s abstract ones
             self._jit_step = None
         elif mesh is not None:
             self._jit_step = self._build_jit()
@@ -418,6 +496,10 @@ class TrainStep:
         if sshard is None:
             sshard = repl if not isinstance(pshard, dict) else pshard
         bdict = {n: bshard for n in self.data_names + self.label_names}
+        # __call__ re-places host inputs onto these when the mesh spans
+        # processes (jit cannot auto-commit to non-addressable devices)
+        self._in_bshard = bdict
+        self._in_repl = repl
         in_sh = (pshard, repl, sshard, bdict, repl, None, None)
         out_sh = (pshard, repl, sshard, bshard)
         if self._health is not None:
@@ -457,6 +539,70 @@ class TrainStep:
         self._in_sshard = sshard
         return self._build_jit(pshard, sshard)
 
+    def _build_zero_jit(self, params, states):
+        """jit with the ZeRO state layout resolved: flat ``(padded,)``
+        state leaves tile ``P(axis)`` over the data axis, scalars and
+        unsharded params' states replicate, params stay replicated (the
+        all-gather lives inside the program)."""
+        from .parallel import zero as _zero
+        from .parallel.sharding import replicated
+
+        lay = self.zero_layout(params)
+        sshard = {n: _zero.state_sharding(states[n], lay[n], self.mesh,
+                                          self.zero_axis)
+                  for n in states}
+        self._in_pshard = replicated(self.mesh)
+        self._in_sshard = sshard
+        return self._build_jit(None, sshard)
+
+    def _spans_processes(self):
+        """True when the step's mesh holds devices this process cannot
+        address (a multi-controller pod run)."""
+        cached = getattr(self, "_spans_cache", None)
+        if cached is None:
+            import jax
+
+            mesh = self.mesh
+            cached = self._spans_cache = bool(
+                mesh is not None
+                and any(d.process_index != jax.process_index()
+                        for d in mesh.devices.flat))
+        return cached
+
+    def zero_layout(self, params):
+        """{name: ZeroParam} tiling decision for this step, or None when
+        the sharded update is off/declined.  Deterministic in parameter
+        shapes/dtypes (works on ShapeDtypeStructs too)."""
+        if self.zero_axis is None:
+            return None
+        from .parallel import zero as _zero
+
+        return _zero.layout(params, self._zero_n, self._zero_min_bytes,
+                            self._frozen)
+
+    def memory_report(self, params=None, states=None):
+        """Bench accounting: per-replica optimizer-state bytes (read from
+        the live state arrays' shardings — the ZeRO 1/N claim) and the
+        per-step fresh-param all-gather bytes, plus the AOT executable's
+        ``memory_analysis`` numbers when compiled."""
+        from .parallel import zero as _zero
+
+        out = {"zero": self.zero_axis is not None}
+        if states is not None:
+            out["opt_state_bytes"] = _zero.state_bytes_per_replica(states)
+        lay = self.zero_layout(params) if params is not None else None
+        out["update_gather_bytes"] = (
+            _zero.update_gather_bytes(lay) if lay is not None else 0)
+        if self._aot is not None:
+            try:
+                mem = self._aot.memory_analysis()
+                out["aot_argument_bytes"] = int(
+                    mem.argument_size_in_bytes)
+                out["aot_temp_bytes"] = int(mem.temp_size_in_bytes)
+            except Exception:
+                pass
+        return out
+
     def _abstract_inputs(self, shapes, dtype="float32"):
         """Abstract (params, aux, states, batch, rng, lr, t[, hstate])
         matching what ``__call__`` dispatches for per-step ``shapes``:
@@ -476,9 +622,14 @@ class TrainStep:
                   for n in self.param_names}
         aux = {n: S(tuple(all_shapes[n]), jnp.dtype("float32"))
                for n in self._aux_names}
-        states = {n: jax.eval_shape(self.optimizer.init_fused_state,
-                                    params[n])
-                  for n in self.param_names}
+        lay = self.zero_layout(params)
+        states = {}
+        for n in self.param_names:
+            w = params[n]
+            if lay is not None and lay[n].sharded:
+                # ZeRO layout: every weight-shaped leaf is born flat
+                w = S((lay[n].padded,), jnp.dtype(dtype))
+            states[n] = jax.eval_shape(self.optimizer.init_fused_state, w)
         K = self._steps_per_call
         batch = {}
         for n in self.data_names + self.label_names:
@@ -510,13 +661,17 @@ class TrainStep:
         from . import profiler
         from .compile_cache import cache_stats
 
-        if self._jit_step is None:
+        if self._jit_step is None and not self._aot_capable:
             raise MXNetError(
                 "AOT compile is unavailable with shape-dependent "
                 "param_sharding=%r: the sharded jit resolves against "
                 "concrete parameters on the first call"
                 % (self._param_sharding,))
         args = self._abstract_inputs(shapes, dtype=dtype)
+        if self._jit_step is None:
+            # ZeRO: the abstract states carry the flat layout, which is
+            # all the sharding resolution needs
+            self._jit_step = self._build_zero_jit(args[0], args[2])
         hits_before = cache_stats()["hits"]
         t0 = time.perf_counter()
         lowered = self._jit_step.lower(*args)
@@ -580,15 +735,34 @@ class TrainStep:
         params, aux, states = jax.tree.map(
             dedupe, (params, aux, states))
         if self._jit_step is None:
-            self._jit_step = self._build_sharded_jit(params, states)
+            if self.zero_axis is not None:
+                self._jit_step = self._build_zero_jit(params, states)
+            else:
+                self._jit_step = self._build_sharded_jit(params, states)
         if getattr(self, "_in_pshard", None) is not None:
             # committed single-device arrays cannot be auto-resharded to
             # a non-trivial layout by jit; place them explicitly (no-op
             # once the donated outputs carry the sharding)
-            params = jax.device_put(params, self._in_pshard)
-            states = jax.device_put(states, self._in_sshard)
+            params = _place(params, self._in_pshard)
+            states = _place(states, self._in_sshard)
         lr = self.lr if lr is None else lr
         t = jnp.asarray(t, "int32")
+        if self._spans_processes():
+            # pod run: EVERY array argument must be a global jax.Array —
+            # jit cannot place host batches/rng/scalars across processes
+            # itself.  The host batch is read as the GLOBAL batch (each
+            # rank materializes its own rows), matching the
+            # single-process semantics bit for bit.
+            repl = self._in_repl
+            aux = _place(aux, repl)
+            batch = _place(dict(batch), self._in_bshard)
+            rng = _place(rng, repl)
+            lr = _place(jnp.asarray(lr, "float32"), repl)
+            t = _place(t, repl)
+            if self._health is not None and self._hstate is None:
+                self._hstate = self._init_hstate()
+            if self._hstate is not None:
+                self._hstate = _place(self._hstate, repl)
         if self._health is None:
             call_args = (params, aux, states, batch, rng, lr, t)
         else:
@@ -598,19 +772,41 @@ class TrainStep:
                          self._hstate)
         sig = _signature_of(*call_args)
         self._recompile_guard.observe(sig)
-        out = None
-        if self._aot is not None and sig == self._aot_sig:
-            try:
-                out = self._aot(*call_args)
-            except Exception:
-                # Compiled executables validate avals/shardings before
-                # running (donation has not happened yet), so falling
-                # back to the lazy jit is safe; drop the AOT executable
-                # for good rather than re-failing every step.
-                self._aot = None
-                out = None
-        if out is None:
-            out = self._jit_step(*call_args)
+
+        def dispatch():
+            out = None
+            if self._aot is not None and sig == self._aot_sig:
+                try:
+                    out = self._aot(*call_args)
+                except Exception:
+                    # Compiled executables validate avals/shardings before
+                    # running (donation has not happened yet), so falling
+                    # back to the lazy jit is safe; drop the AOT
+                    # executable for good rather than re-failing every
+                    # step.
+                    self._aot = None
+                    out = None
+            if out is None:
+                out = self._jit_step(*call_args)
+            return out
+
+        if self.zero_axis is not None:
+            from .parallel import zero as _zero
+            from .testing import faults
+
+            def dispatch_zero():
+                # host-side boundaries of the in-program collectives:
+                # before dispatch = the gradient reduce-scatter, after
+                # the result = the fresh-param all-gather
+                faults.inject("zero_update")
+                res = dispatch()
+                faults.inject("zero_update")
+                return res
+
+            out = _zero.bounded_dispatch(dispatch_zero,
+                                         kvstore=self._kvstore)
+        else:
+            out = dispatch()
         if self._health is None:
             return out
         (params, aux, states, outs, self._hstate,
@@ -658,7 +854,16 @@ class TrainStep:
                 fan_in = int(np.prod(shp[1:])) if len(shp) > 1 else shp[0]
                 scale = (2.0 / max(1, fan_in)) ** 0.5
                 params[n] = scale * jax.random.normal(sub, shp, dtype)
-            states[n] = self.optimizer.init_fused_state(params[n])
+        lay = self.zero_layout(params)
+        if lay is not None:
+            from .parallel import zero as _zero
+        for n in self.param_names:
+            if lay is not None and lay[n].sharded:
+                states[n] = _zero.init_state(
+                    self.optimizer, params[n], lay[n], self.mesh,
+                    self.zero_axis)
+            else:
+                states[n] = self.optimizer.init_fused_state(params[n])
         for n in self._aux_names:
             shp = all_shapes[n]
             aux[n] = jnp.ones(shp, "float32") if n.endswith("_var") \

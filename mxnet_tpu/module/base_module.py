@@ -192,7 +192,8 @@ class BaseModule:
             prefetch_to_device=None, prefetch_depth=2,
             metric_sync_period=None, steps_per_call=None,
             checkpoint=None, checkpoint_period=1, resume_from=None,
-            health=None, loss_scale=None, step_timeout_s=None):
+            health=None, loss_scale=None, step_timeout_s=None,
+            zero=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``), pipelined: by default the train iterator
         is wrapped in :class:`~mxnet_tpu.io.DevicePrefetchIter` so batch
@@ -251,6 +252,9 @@ class BaseModule:
           (``MXNET_STEP_TIMEOUT_S``): a step making no progress for this
           long dumps all-thread stacks + health stats to an artifact and
           raises :class:`~mxnet_tpu.base.StepHung` instead of hanging.
+        * ``zero`` — 'auto' | 'on' | 'off': ZeRO-style sharding of the
+          optimizer state and the weight update over the mesh's data
+          axis (``MXNET_ZERO``; see ``docs/performance.md``).
         """
         from ..base import get_env
         from ..initializer import Uniform
@@ -310,6 +314,8 @@ class BaseModule:
             opt_kwargs["health"] = health
         if loss_scale is not None:
             opt_kwargs["loss_scale"] = loss_scale
+        if zero is not None:
+            opt_kwargs["zero"] = zero
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, **opt_kwargs)
         # env-driven activation (MXNET_HEALTH_MONITOR=1) happens inside
@@ -605,6 +611,12 @@ class BaseModule:
         if state.states_path is not None and \
                 hasattr(self, "load_optimizer_states"):
             self.load_optimizer_states(state.states_path)
+        elif getattr(state, "opt_states", None) and \
+                hasattr(self, "set_fused_optimizer_states"):
+            # ZeRO-sharded states come back from the v2 piece-window
+            # format as canonical weight-shaped trees, already assembled
+            # across whatever topology wrote them
+            self.set_fused_optimizer_states(state.opt_states)
         n = int(state.num_update)
         for o in self._optimizer_copies():
             o.begin_num_update = n

@@ -223,7 +223,7 @@ class Module(BaseModule):
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False, param_sharding=None,
                        compute_dtype=None, steps_per_call=None,
-                       health=None, loss_scale=None):
+                       health=None, loss_scale=None, zero=None):
         """``param_sharding``: 'replicated' (default), 'fsdp', 'tp', or a
         rule list (see ``parallel.sharding.param_sharding_rules``) —
         applied to the fused step's parameter/optimizer-state layouts
@@ -244,9 +244,14 @@ class Module(BaseModule):
         ``MXNET_HEALTH_MONITOR=1``); ``loss_scale``: 'dynamic', a fixed
         number, or a :class:`~mxnet_tpu.health.DynamicLossScaler` for
         low-precision runs (also via ``MXNET_LOSS_SCALE``).  See
-        docs/health_monitoring.md."""
+        docs/health_monitoring.md.
+
+        ``zero``: 'auto' (default) | 'on' | 'off' — ZeRO-style sharding
+        of the optimizer state and the weight update across the data
+        axis (``MXNET_ZERO``; see docs/performance.md)."""
         from ..base import get_env
         from ..health import DynamicLossScaler, resolve_monitor
+        from ..parallel import zero as _zero_mod
 
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
@@ -268,6 +273,8 @@ class Module(BaseModule):
         if compute_dtype is None:
             compute_dtype = get_env("MXNET_COMPUTE_DTYPE", "", str) or None
         self._compute_dtype = compute_dtype
+        # normalized to auto|on|off (explicit arg wins over MXNET_ZERO)
+        self._zero = _zero_mod.zero_mode(zero)
         kvstore_inst, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._exec.arg_dict)
 
@@ -388,6 +395,11 @@ class Module(BaseModule):
                 raise MXNetError(
                     "loss_scale was requested but the fused step is "
                     "unavailable: %s" % (reason,))
+            # an explicit ZeRO request only exists inside the fused step
+            if getattr(self, "_zero", None) == "on":
+                raise MXNetError(
+                    "zero=on was requested but the fused step is "
+                    "unavailable: %s" % (reason,))
 
         if self._pipeline_stages > 1:
             if getattr(self, "_steps_per_call", 1) > 1:
@@ -489,7 +501,11 @@ class Module(BaseModule):
                 param_sharding=getattr(self, "_param_sharding", None),
                 compute_dtype=getattr(self, "_compute_dtype", None),
                 steps_per_call=getattr(self, "_steps_per_call", 1),
-                health=step_health)
+                health=step_health,
+                zero=getattr(self, "_zero", None))
+            # the sharded-update dispatch attaches the kvstore's peer
+            # diagnosis to bounded-collective timeouts
+            self._fused._kvstore = self._kvstore
         except Exception as e:  # fall back to the split path
             if getattr(self, "_compute_dtype", None) is not None:
                 raise MXNetError(
@@ -513,6 +529,10 @@ class Module(BaseModule):
                     "param_sharding=%r was requested but the fused step "
                     "could not be built: %s"
                     % (self._param_sharding, e)) from e
+            if getattr(self, "_zero", None) == "on":
+                raise MXNetError(
+                    "zero=on was requested but the fused step could not "
+                    "be built: %s" % (e,)) from e
             self.logger.debug("fused step unavailable: %s", e)
             self._fused = None
         if self._fused is None and self._mesh is not None and \
@@ -523,18 +543,67 @@ class Module(BaseModule):
 
     def _init_fused_states(self):
         """Seed fused optimizer states, honoring any states preloaded into
-        the updater (checkpoint resume)."""
+        the updater (checkpoint resume) or handed over canonically by the
+        elastic ZeRO restore.  Under the sharded update every seed —
+        fresh, updater-preloaded, or canonical — lands in the flat 1/N
+        zero layout (re-tiling is bit-exact: padding lanes are zeros)."""
         o = self._optimizer
+        fused = getattr(self, "_fused", None)
+        lay = None
+        if fused is not None and getattr(fused, "zero_axis", None):
+            pdict = {n: self._exec.arg_dict[n]._data
+                     for n in self._param_names}
+            lay = fused.zero_layout(pdict)
         states = {}
         preloaded = self._updater.states if self._updater is not None else \
             (self._kvstore.updater.states
              if self._kvstore is not None and self._kvstore.updater else {})
+        canonical = getattr(self, "_preloaded_zero_states", None) or {}
         for i, n in enumerate(self._param_names):
-            if i in preloaded and preloaded[i] is not None:
-                states[n] = o.fused_state_from_nd(preloaded[i])
+            if n in canonical:
+                st = canonical[n]
+            elif i in preloaded and preloaded[i] is not None:
+                st = o.fused_state_from_nd(preloaded[i])
             else:
-                states[n] = o.init_fused_state(self._exec.arg_dict[n]._data)
+                st = None
+            if lay is not None:
+                from ..parallel import zero as _zero
+
+                if st is None:
+                    states[n] = _zero.init_state(
+                        o, pdict[n], lay[n], fused.mesh, fused.zero_axis)
+                else:
+                    states[n] = _zero.shard_state(
+                        st, lay[n], fused.mesh, fused.zero_axis)
+            else:
+                states[n] = st if st is not None else \
+                    o.init_fused_state(self._exec.arg_dict[n]._data)
+        self._preloaded_zero_states = None
         return states
+
+    def set_fused_optimizer_states(self, states):
+        """Hand the fused step canonical (weight-shaped, by-name) fused
+        optimizer states in memory — the elastic checkpoint's ZeRO
+        restore path.  Applied (and re-tiled to the live layout) when the
+        fused step next seeds its states."""
+        assert self.binded
+        self._preloaded_zero_states = dict(states)
+        self._fused_states = None
+
+    def _export_zero_states(self):
+        """v2-checkpoint export descriptor of the live ZeRO-sharded fused
+        states (``parallel.zero.export_states``), or None when the fused
+        step is not running the sharded update."""
+        fused = getattr(self, "_fused", None)
+        if fused is None or not getattr(fused, "zero_axis", None) or \
+                getattr(self, "_fused_states", None) is None:
+            return None
+        from ..parallel import zero as _zero
+
+        pdict = {n: self._exec.arg_dict[n]._data
+                 for n in self._param_names}
+        return _zero.export_states(self._fused_states,
+                                   fused.zero_layout(pdict))
 
     def prepare_compiled(self, dtype="float32"):
         """AOT warmup: lower-and-compile the fused train step for the
@@ -549,7 +618,8 @@ class Module(BaseModule):
         assert self.binded, "call bind before prepare_compiled"
         fused = getattr(self, "_fused", None)
         if fused is None or not hasattr(fused, "compile") or \
-                getattr(fused, "_jit_step", None) is None:
+                (getattr(fused, "_jit_step", None) is None and
+                 not getattr(fused, "_aot_capable", False)):
             return None
         shapes = {d.name: d.shape for d in self._data_shapes}
         shapes.update({l.name: l.shape
@@ -826,8 +896,25 @@ class Module(BaseModule):
             import pickle
 
             o = self._optimizer
-            states = {i: o.fused_state_to_nd(self._fused_states[n],
-                                             self._context[0])
+            src = self._fused_states
+            fused = getattr(self, "_fused", None)
+            if fused is not None and getattr(fused, "zero_axis", None):
+                import jax
+
+                if jax.process_count() > 1:
+                    raise MXNetError(
+                        "save_optimizer_states cannot pickle ZeRO-sharded "
+                        "state in a multi-process run (remote shards are "
+                        "not addressable from this host); save through "
+                        "the v2 elastic CheckpointManager instead")
+                from ..parallel import zero as _zero
+
+                pdict = {n: self._exec.arg_dict[n]._data
+                         for n in self._param_names}
+                lay = fused.zero_layout(pdict)
+                src = {n: _zero.unshard_state(src[n], lay[n])
+                       for n in src}
+            states = {i: o.fused_state_to_nd(src[n], self._context[0])
                       for i, n in enumerate(self._param_names)}
             with open(fname, "wb") as f:
                 f.write(pickle.dumps(states))
@@ -846,6 +933,8 @@ class Module(BaseModule):
             with open(fname, "rb") as f:
                 self._updater.set_states(f.read())
         # force the fused path to re-seed from the freshly loaded states
+        # (and drop any stale canonical ZeRO handover)
+        self._preloaded_zero_states = None
         self._fused_states = None
 
     def reshape(self, data_shapes, label_shapes=None):

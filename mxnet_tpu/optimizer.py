@@ -192,6 +192,33 @@ def global_norm_scale(norm, max_norm, dtype="float32"):
     return jnp.minimum(1.0, max_norm / (norm + 1e-12)).astype(dtype)
 
 
+def sharded_fused_update(optimizer, weight, flat_grad, state, lr, wd, t,
+                         rng, mesh, axis, entry):
+    """ZeRO sharded-update driver for one parameter (arXiv 2004.13336).
+
+    ``flat_grad`` is the already reduce-scattered gradient: flat
+    ``(entry.padded,)``, tiled ``P(axis)`` over the data axis.  The full
+    ``weight`` is sliced down to the matching flat tile, the optimizer's
+    ``fused_update`` runs on 1/N of the elements (its state lives only
+    on that tile), and the fresh parameter is all-gathered back to the
+    replicated weight shape.  Under GSPMD all three moves are sharding
+    constraints, so XLA's latency-hiding scheduler can overlap the
+    gather with the next forward.  Padding lanes carry zeros in and are
+    dropped at the gather, so the elementwise math is bit-identical to
+    the replicated update."""
+    import jax
+
+    from .parallel import zero as _zero
+
+    wflat = _zero.shard_flat(weight, entry, mesh, axis)
+    new_flat, new_state = optimizer.fused_update(
+        wflat, flat_grad, state, lr, wd, t, rng)
+    new_state = jax.tree.map(
+        jax.lax.with_sharding_constraint, new_state,
+        _zero.state_sharding(new_state, entry, mesh, axis))
+    return _zero.gather_param(new_flat, entry, mesh), new_state
+
+
 def _tree_jax_to_nd(x, ctx):
     if x is None:
         return None

@@ -15,6 +15,7 @@ from . import sequence
 from . import pipeline
 from . import expert
 from . import overlap
+from . import zero
 from .mesh import (create_mesh, current_mesh, set_mesh, mesh_scope,
                    init_distributed)
 from .sequence import ring_attention, sequence_parallel_attention
@@ -22,6 +23,7 @@ from .pipeline import pipeline_apply, split_symbol, PipelineTrainStep
 from .expert import moe_ffn, routed_moe_ffn
 
 __all__ = ["mesh", "collectives", "sharding", "sequence", "overlap",
+           "zero",
            "create_mesh",
            "current_mesh", "set_mesh", "mesh_scope", "init_distributed", "ring_attention",
            "sequence_parallel_attention", "pipeline", "expert",

@@ -57,7 +57,32 @@ _LHS_FLAGS = (
     "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
 )
 
-_warned = set()
+class DeclineWarner:
+    """One-shot decline reporter with an explicit scope.
+
+    Decline warnings must fire once per *consumer*, not once per
+    process: a second ``TrainStep`` built with a different config in
+    the same process has its own decline reasons to report, so each
+    step owns a :class:`DeclineWarner` and passes it down.  The
+    module-level default (``_warned``) keeps the old once-per-process
+    behavior for direct callers."""
+
+    def __init__(self):
+        self.seen = set()
+
+    def warn(self, key, msg):
+        if key not in self.seen:
+            self.seen.add(key)
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    def discard(self, key):
+        self.seen.discard(key)
+
+
+_default_warner = DeclineWarner()
+# back-compat alias: tests/pre-existing callers reach the process-wide
+# key set through ``overlap._warned``
+_warned = _default_warner.seen
 
 # (axis_name, replica_count) while the DDP local step is being traced,
 # else None.  Batch-global ops consult this: under shard_map they see
@@ -92,10 +117,8 @@ def ddp_pmean(x):
     return lax.pmean(x, _ddp_ctx[0])
 
 
-def _warn_once(key, msg):
-    if key not in _warned:
-        _warned.add(key)
-        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+def _warn_once(key, msg, warner=None):
+    (warner or _default_warner).warn(key, msg)
 
 
 def overlap_mode():
@@ -141,13 +164,13 @@ def arm_latency_hiding():
     return True
 
 
-def ddp_axis(mesh, batch_axis, param_sharding=None):
+def ddp_axis(mesh, batch_axis, param_sharding=None, warner=None):
     """The mesh axis the explicit DDP reduction runs over, or None.
 
     Eligible: a live mesh whose only non-trivial axis is the batch axis
     (pure data parallelism) with replicated parameters — sharded-param
-    styles (fsdp/zero) already reduce-scatter through GSPMD and have
-    their own overlap story.
+    styles (fsdp) already reduce-scatter through GSPMD and have their
+    own overlap story.  ``warner``: per-consumer decline reporter.
     """
     if overlap_mode() == "off":
         return None
@@ -162,7 +185,7 @@ def ddp_axis(mesh, batch_axis, param_sharding=None):
         if overlap_mode() == "on":
             _warn_once("mesh", "MXNET_GRAD_OVERLAP=on but the mesh has "
                        "non-batch axes %r; using the GSPMD reduction"
-                       % (dict(mesh.shape),))
+                       % (dict(mesh.shape),), warner)
         return None
     return batch_axis
 
@@ -200,7 +223,8 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 
 
 def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
-                       frozen=frozenset(), order=None, bucket_bytes=None):
+                       frozen=frozenset(), order=None, bucket_bytes=None,
+                       warner=None, zero_layout=None):
     """Explicit data-parallel ``value_and_grad`` with bucketed reduction.
 
     ``loss_fn(p, b, r) -> (loss, (outs, new_aux))`` must compute the
@@ -211,6 +235,13 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
     when this trace cannot run the DDP path (caller falls back to the
     GSPMD reduction).  Called at trace time inside the fused step's
     ``jit``.
+
+    ``zero_layout`` ({name: ``parallel.zero.ZeroParam``}, sharing this
+    ``axis``): sharded members of each bucket come back *reduce-
+    scattered* — one tuple ``psum_scatter`` per bucket instead of the
+    tuple ``psum`` — as flat ``(padded,)`` arrays tiled ``P(axis)``;
+    unsharded members keep the full psum.  Same overlap schedule, 1/N
+    of the reduction's receive bytes.
     """
     import math
 
@@ -223,7 +254,7 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
         if b.ndim == 0 or b.shape[0] % n:
             _warn_once("batch", "grad-overlap declined: batch input %r "
                        "shape %r not divisible by %s=%d"
-                       % (k, tuple(b.shape), axis, n))
+                       % (k, tuple(b.shape), axis, n), warner)
             return None
 
     def full_vag(p, b, r):
@@ -250,7 +281,7 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
         else:
             _warn_once("outs", "grad-overlap declined: output leaf shape "
                        "%r does not carry the batch on its leading dim"
-                       % (tuple(gl.shape),))
+                       % (tuple(gl.shape),), warner)
             return None
     outs_spec = jax.tree.unflatten(jax.tree.structure(g_outs),
                                    out_specs_leaves)
@@ -263,7 +294,13 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
              for k in live}
     buckets = bucket_partition(live, sizes, bucket_bytes)
 
+    def _is_scattered(k):
+        return (zero_layout is not None and k in zero_layout
+                and zero_layout[k].sharded)
+
     def local_step(p, b, r):
+        from . import zero as _zero
+
         # decorrelate stochastic ops (dropout) across replicas
         r = jax.random.fold_in(r, lax.axis_index(axis))
         (loss, (outs, new_aux)), grads = full_vag(p, b, r)
@@ -271,18 +308,32 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
         # one tuple all-reduce per bucket, reverse production order:
         # bucket i's collective depends only on its own gradients, so
         # the scheduler can issue it while backward still computes the
-        # earlier layers' buckets
+        # earlier layers' buckets.  Under the zero layout the bucket's
+        # sharded members flatten/pad first and reduce-SCATTER instead:
+        # each replica keeps only its 1/N tile of the summed gradient.
         for bucket in buckets:
-            summed = lax.psum(tuple(grads[k] for k in bucket), axis)
-            for k, g in zip(bucket, summed):
-                grads[k] = g
+            plain = [k for k in bucket if not _is_scattered(k)]
+            scat = [k for k in bucket if _is_scattered(k)]
+            if plain:
+                summed = lax.psum(tuple(grads[k] for k in plain), axis)
+                for k, g in zip(plain, summed):
+                    grads[k] = g
+            if scat:
+                tiles = lax.psum_scatter(
+                    tuple(_zero.flat_pad(grads[k], zero_layout[k])
+                          for k in scat),
+                    axis, scatter_dimension=0, tiled=True)
+                for k, g in zip(scat, tiles):
+                    grads[k] = g
         loss = lax.psum(loss, axis)
         new_aux = lax.pmean(new_aux, axis)
         return (loss, (outs, new_aux)), grads
 
     bspec = {k: P(axis) for k in batch}
+    gspec = {k: (P(axis) if _is_scattered(k) else P())
+             for k in g_grads}
     spec_tree = ((P(), (outs_spec, jax.tree.map(lambda _: P(), g_aux))),
-                 jax.tree.map(lambda _: P(), dict(g_grads)))
+                 gspec)
     fn = _shard_map(local_step, mesh, (P(), bspec, P()), spec_tree)
     # trace the local step under the DDP context so batch-global ops
     # (SoftmaxOutput normalization, BatchNorm training stats) widen

@@ -1,0 +1,436 @@
+"""ZeRO-style cross-replica sharding of optimizer state and the update.
+
+Data-parallel replicas each hold the full parameters plus the full
+optimizer state, and every replica redundantly computes the identical
+weight update.  Following "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (arXiv 2004.13336), this module
+partitions each parameter's *flattened* update evenly across the data
+axis: gradients arrive reduce-SCATTERED instead of all-reduced, the
+optimizer state exists only for the local 1/N tile, the update runs on
+that tile, and the fresh parameters are all-gathered for the next
+forward — cutting optimizer-state memory and update FLOPs per replica
+by ~1/N at the cost of one all-gather that XLA's latency-hiding
+scheduler overlaps with the next step's compute.
+
+Layout contract: a sharded parameter's gradient, weight, and every
+weight-shaped optimizer-state leaf are carried as 1-D arrays of
+``padded = ceil(size / N) * N`` elements (zero-padded), sharded
+``PartitionSpec(axis)`` over the data axis — even byte tiling, the same
+stance as :func:`~mxnet_tpu.parallel.overlap.bucket_partition`.  Scalar
+state leaves (e.g. Nadam's schedule product) stay replicated.  Padding
+lanes hold zeros on entry and whatever the update writes is discarded
+at the gather, so the elementwise update math is bit-identical to the
+unsharded step.
+
+Two execution paths compose:
+
+* the PR 6 explicit-DDP path swaps each bucket's tuple ``psum`` for a
+  tuple ``psum_scatter`` over the sharded members (see
+  ``overlap.ddp_value_and_grad(zero_layout=...)``);
+* the GSPMD fallback expresses the same thing as sharding constraints
+  (flat grad → ``P(axis)``, updated flat param → replicated), and XLA
+  inserts the reduce-scatter / all-gather.
+
+Eligibility (``MXNET_ZERO=auto|on|off``): a live mesh whose data axis
+has >= 2 devices and replicated parameters.  Model-parallel or fsdp
+parameter sharding declines (those layouts already shard state), as do
+parameters smaller than ``MXNET_ZERO_MIN_PARAM_BYTES`` (the all-gather
+latency is not worth 1/N of a tiny buffer).
+"""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError, get_env
+
+__all__ = ["zero_mode", "min_param_bytes", "zero_axis", "ZeroParam",
+           "layout", "put", "shard_flat", "gather_param", "init_state",
+           "shard_state", "unshard_state", "state_structure",
+           "state_leaves", "state_unflatten", "export_states",
+           "bounded_dispatch", "state_bytes_per_replica",
+           "update_gather_bytes"]
+
+DEFAULT_MIN_PARAM_BYTES = 1024
+
+
+def zero_mode(mode=None):
+    """Resolve the sharded-update mode: an explicit ``mode`` wins, else
+    ``MXNET_ZERO`` (default ``auto``)."""
+    raw = mode if mode is not None else get_env("MXNET_ZERO", "auto", str)
+    raw = str(raw).strip().lower() or "auto"
+    if raw in ("0", "off", "false", "no"):
+        return "off"
+    if raw in ("1", "on", "true", "yes"):
+        return "on"
+    if raw == "auto":
+        return "auto"
+    raise MXNetError("MXNET_ZERO/zero must be auto|on|off (got %r)"
+                     % (mode,))
+
+
+def min_param_bytes():
+    """``MXNET_ZERO_MIN_PARAM_BYTES``: parameters below this size keep
+    the replicated update (default %d)."""
+    return max(0, int(get_env("MXNET_ZERO_MIN_PARAM_BYTES",
+                              DEFAULT_MIN_PARAM_BYTES, int)))
+
+
+min_param_bytes.__doc__ %= DEFAULT_MIN_PARAM_BYTES
+
+
+def zero_axis(mesh, batch_axis, param_sharding=None, mode=None,
+              warn=None):
+    """The mesh axis the sharded update tiles over, or None (declined).
+
+    ``warn``: optional ``warn(key, msg)`` callable (the per-TrainStep
+    decline reporter) — called only when the user forced ``on`` and the
+    step cannot honor it."""
+    mode = zero_mode(mode)
+    if mode == "off":
+        return None
+
+    def _decline(key, msg):
+        if mode == "on" and warn is not None:
+            warn(key, msg)
+        return None
+
+    if param_sharding not in (None, "replicated"):
+        return _decline(
+            "zero-params",
+            "MXNET_ZERO=on but param_sharding=%r already shards the "
+            "parameters (fsdp/tp carry their own state layout); using "
+            "the replicated update" % (param_sharding,))
+    if mesh is None or batch_axis not in getattr(mesh, "shape", {}):
+        return _decline(
+            "zero-mesh",
+            "MXNET_ZERO=on but there is no mesh with a %r axis; using "
+            "the replicated update" % (batch_axis,))
+    if int(mesh.shape[batch_axis]) < 2:
+        return _decline(
+            "zero-axis",
+            "MXNET_ZERO=on but mesh axis %r has a single device — "
+            "nothing to shard the update over" % (batch_axis,))
+    return batch_axis
+
+
+class ZeroParam:
+    """Per-parameter tiling decision: ``sharded`` params carry their
+    grad/weight/state as flat ``(padded,)`` arrays tiled over the data
+    axis; unsharded ones keep the replicated update."""
+
+    __slots__ = ("name", "shape", "dtype", "logical", "padded", "sharded")
+
+    def __init__(self, name, shape, dtype, logical, padded, sharded):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtype
+        self.logical = int(logical)
+        self.padded = int(padded)
+        self.sharded = bool(sharded)
+
+    def __repr__(self):
+        return ("ZeroParam(%s, shape=%r, logical=%d, padded=%d, "
+                "sharded=%r)" % (self.name, self.shape, self.logical,
+                                 self.padded, self.sharded))
+
+
+def layout(params, ndev, min_bytes=None, frozen=frozenset()):
+    """{name: :class:`ZeroParam`} for a params dict of arrays or
+    ``ShapeDtypeStruct``s.  Deterministic in shapes/dtypes only, so the
+    trace-time callers and the state-allocation callers always agree."""
+    import numpy as np
+
+    if min_bytes is None:
+        min_bytes = min_param_bytes()
+    ndev = int(ndev)
+    out = {}
+    for name, arr in params.items():
+        shape = tuple(int(s) for s in arr.shape)
+        dtype = np.dtype(arr.dtype)
+        logical = int(math.prod(shape)) if shape else 1
+        padded = max(1, -(-logical // ndev)) * ndev
+        sharded = (name not in frozen and ndev > 1
+                   and logical * dtype.itemsize >= min_bytes)
+        out[name] = ZeroParam(name, shape, dtype, logical, padded, sharded)
+    return out
+
+
+def _axis_sharding(mesh, axis):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def _replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def put(x, sharding):
+    """``jax.device_put`` onto ``sharding``, multiprocess-safe.
+
+    ``device_put`` refuses a target sharding whose devices are not all
+    addressable from this process, so on a pod the host->global
+    placement goes through ``jax.make_array_from_callback``: ``x`` is
+    read as the GLOBAL value and each process materializes only the
+    windows it owns — the same single-controller semantics the
+    single-process path gets for free."""
+    import jax
+
+    if sharding is None:
+        return x
+    if getattr(x, "sharding", None) == sharding:
+        return x
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    host = np.asarray(x)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
+def flat_pad(x, entry):
+    """Flatten ``x`` to 1-D and zero-pad to ``entry.padded`` elements
+    (pure reshape/pad; traceable)."""
+    import jax.numpy as jnp
+
+    flat = jnp.reshape(x, (-1,))
+    if entry.padded > entry.logical:
+        flat = jnp.pad(flat, (0, entry.padded - entry.logical))
+    return flat
+
+
+def shard_flat(x, entry, mesh, axis):
+    """Flatten+pad ``x`` and constrain it onto ``P(axis)`` — under
+    GSPMD this is the reduce-scatter (for a pending-sum gradient) or a
+    local slice (for a replicated weight)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        flat_pad(x, entry), _axis_sharding(mesh, axis))
+
+
+def gather_param(flat, entry, mesh):
+    """Replicate the updated flat shard (the all-gather), drop the
+    padding lanes, and restore the parameter's shape."""
+    import jax
+    import jax.numpy as jnp
+
+    full = jax.lax.with_sharding_constraint(flat, _replicated(mesh))
+    return jnp.reshape(full[:entry.logical], entry.shape)
+
+
+def state_sharding(states_tree, entry, mesh, axis):
+    """Pytree of ``NamedSharding`` matching one parameter's fused state:
+    flat ``(padded,)`` leaves tile over ``axis``, everything else
+    (scalars, schedules) replicates."""
+    import jax
+
+    shard = _axis_sharding(mesh, axis)
+    repl = _replicated(mesh)
+
+    def _leaf(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if entry.sharded and shape == (entry.padded,):
+            return shard
+        return repl
+
+    return jax.tree.map(_leaf, states_tree)
+
+
+def init_state(optimizer, weight, entry, mesh, axis):
+    """Fresh fused optimizer state for one parameter under the zero
+    layout: built from the flat padded weight so every weight-shaped
+    leaf is born ``(padded,)``, then placed with the 1/N tiling (the
+    per-replica allocation is ``padded / N`` elements per leaf)."""
+    import jax
+
+    if not entry.sharded:
+        return optimizer.init_fused_state(weight)
+    # build from a LOCAL flat weight (eager ops on non-addressable
+    # global arrays are illegal on pods), then place each leaf onto its
+    # 1/N tiling — transient full-size leaves are weight-order memory
+    state = optimizer.init_fused_state(flat_pad(weight, entry))
+    return jax.tree.map(
+        put, state, state_sharding(state, entry, mesh, axis))
+
+
+def shard_state(state, entry, mesh, axis):
+    """Canonical (weight-shaped) fused state -> the zero layout.  Used
+    when resuming from a checkpoint saved unsharded or by a different
+    topology: zero-padding is content-preserving, so the re-tiling is
+    bit-exact."""
+    import jax
+    import jax.numpy as jnp
+
+    if not entry.sharded:
+        return jax.tree.map(jnp.asarray, state)
+    shard = _axis_sharding(mesh, axis)
+    repl = _replicated(mesh)
+
+    def _leaf(leaf):
+        leaf = jnp.asarray(leaf)
+        if tuple(leaf.shape) == entry.shape:
+            return put(flat_pad(leaf, entry), shard)
+        return put(leaf, repl)
+
+    return jax.tree.map(_leaf, state)
+
+
+def unshard_state(state, entry):
+    """The zero layout -> canonical weight-shaped fused state (host
+    numpy).  Requires the flat leaves to be addressable from this
+    process — multi-process runs checkpoint through the v2 piece-window
+    path instead (each rank writes its own windows)."""
+    import jax
+    import numpy as np
+
+    if not entry.sharded:
+        return jax.tree.map(np.asarray, state)
+
+    def _leaf(leaf):
+        arr = np.asarray(leaf)
+        if arr.shape == (entry.padded,):
+            return arr[:entry.logical].reshape(entry.shape)
+        return arr
+
+    return jax.tree.map(_leaf, state)
+
+
+# -- checkpoint interchange ------------------------------------------------
+#
+# Fused states are tuple/None/array pytrees; the v2 checkpoint stores each
+# leaf as its own piece-windowed entry, so the tree shape must ride along
+# as a JSON-serializable descriptor.
+
+def state_structure(tree):
+    """JSON-serializable descriptor of a fused-state pytree: ``None``,
+    ``{"leaf": i}`` (i-th leaf in ``state_leaves`` order), or
+    ``{"tuple": [...]}``."""
+    counter = [0]
+
+    def _enc(node):
+        if node is None:
+            return None
+        if isinstance(node, (tuple, list)):
+            return {"tuple": [_enc(e) for e in node]}
+        i = counter[0]
+        counter[0] += 1
+        return {"leaf": i}
+
+    return _enc(tree)
+
+
+def state_leaves(tree):
+    """Leaves in ``state_structure`` order."""
+    out = []
+
+    def _walk(node):
+        if node is None:
+            return
+        if isinstance(node, (tuple, list)):
+            for e in node:
+                _walk(e)
+            return
+        out.append(node)
+
+    _walk(tree)
+    return out
+
+
+def state_unflatten(desc, leaves):
+    """Rebuild the fused-state pytree from its descriptor + leaves."""
+    def _dec(node):
+        if node is None:
+            return None
+        if "tuple" in node:
+            return tuple(_dec(e) for e in node["tuple"])
+        return leaves[int(node["leaf"])]
+
+    return _dec(desc)
+
+
+def export_states(states, lay):
+    """Checkpoint export descriptor for a fused-states dict under
+    ``lay`` (a :func:`layout` result): per parameter, the structure
+    descriptor, the raw leaves (flat sharded arrays stay sharded — the
+    v2 writer pieces them by addressable window), and the unpadding
+    metadata the restore needs."""
+    out = {}
+    for name, tree in states.items():
+        ent = lay[name]
+        leaves = state_leaves(tree)
+        flat = [ent.sharded and tuple(getattr(l, "shape", ())) ==
+                (ent.padded,) for l in leaves]
+        out[name] = {
+            "structure": state_structure(tree),
+            "leaves": leaves,
+            "flat": flat,
+            "logical": ent.logical,
+            "canonical_shape": list(ent.shape),
+        }
+    return out
+
+
+# -- accounting ------------------------------------------------------------
+
+def state_bytes_per_replica(states, ndev=None):
+    """Optimizer-state bytes ONE replica holds, read from the live
+    arrays' shardings (a sharded leaf contributes one shard's bytes).
+    This is the 1/N memory claim the bench rows report."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(states):
+        shape = tuple(getattr(leaf, "shape", ()))
+        itemsize = np.dtype(leaf.dtype).itemsize
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and shape:
+            shape = tuple(sharding.shard_shape(shape))
+        total += int(math.prod(shape) if shape else 1) * itemsize
+    return total
+
+
+def update_gather_bytes(lay):
+    """Bytes of fresh parameters the all-gather moves per step (the
+    padded flat size of every sharded parameter)."""
+    return sum(e.padded * e.dtype.itemsize
+               for e in lay.values() if e.sharded)
+
+
+# -- fault/bounded dispatch ------------------------------------------------
+
+def bounded_dispatch(call, kvstore=None, active=None):
+    """Run one sharded-update step under the kvstore's wall-clock bound.
+
+    The reduce-scatter and the param all-gather are collectives: one
+    wedged peer stalls every healthy replica inside the device call
+    forever.  When the ``zero_update`` fault site is armed, or the run
+    is genuinely multi-process, the step dispatch runs through
+    :func:`~mxnet_tpu.kvstore._run_bounded` with the PR 3 peer report as
+    the diagnosis — the same treatment the kvstore barrier gets.  The
+    single-process clean path stays a direct call (no watchdog thread
+    per step)."""
+    from ..testing import faults
+
+    if active is None:
+        active = faults.active("zero_update") or (
+            kvstore is not None and getattr(kvstore, "_is_dist", False))
+    if not active:
+        return call()
+    from ..kvstore import _run_bounded
+
+    diagnose = getattr(kvstore, "_peer_diagnose", None)
+    if diagnose is None:
+        def diagnose():
+            import jax
+
+            from ..health import peer_report
+
+            return peer_report(jax.process_count())
+    return _run_bounded(
+        call, "ZeRO sharded update (gradient reduce-scatter + parameter "
+        "all-gather)", diagnose=diagnose)

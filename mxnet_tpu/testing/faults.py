@@ -56,7 +56,12 @@ with ``path=`` — ``bitflip``/``truncate`` here model post-write disk
 rot), ``collective`` (kvstore DCN barrier / cross-replica sum),
 ``numerics`` (Module's fused step — poison one batch element with the
 returned nan/inf), ``step`` (top of every fit batch — ``hang`` here
-trips the step watchdog), ``serve_queue`` (the serving scheduler —
+trips the step watchdog), ``zero_update`` (around the ZeRO-sharded
+fused dispatch — the gradient reduce-scatter going in and the
+parameter all-gather coming out; arming it also bounds the dispatch,
+so ``delay`` past ``MXNET_KV_TIMEOUT_S`` surfaces the collective
+timeout with the kvstore's peer report attached even single-process),
+``serve_queue`` (the serving scheduler —
 crossed at *every* request boundary) plus its phase-specific companions
 ``serve_admit`` / ``serve_decode`` / ``serve_respond`` (admission,
 per-request decode-step, and response boundaries; a fault fails that

@@ -56,6 +56,33 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                 "all-to-all", "collective-permute")
 
 
+def _collective_kind(op):
+    """Normalize an HLO collective opcode to its base kind: the async
+    pairs (``all-reduce-start``/``-done``, ``all-gather-start``, …)
+    count as their base collective, so a psum → reduce-scatter swap in
+    the step program reads as exactly that in the roster and in
+    ``--diff`` — not as an opaque opcode shuffle."""
+    for kind in _COLLECTIVES:
+        if op == kind or op.startswith(kind + "-"):
+            return kind
+    return op
+
+
+def _kind_summary(payload):
+    """Per-kind {count, bytes} roster; derived from the raw collective
+    list so pre-existing artifacts diff fine.  ``-done`` halves of async
+    pairs are skipped to avoid double-counting one collective."""
+    kinds = {}
+    for c in payload.get("collectives") or []:
+        if c["op"].endswith("-done"):
+            continue
+        k = _collective_kind(c["op"])
+        ent = kinds.setdefault(k, {"count": 0, "bytes": 0})
+        ent["count"] += 1
+        ent["bytes"] += int(c.get("bytes") or 0)
+    return kinds
+
+
 def _shape_bytes(dtype, dims):
     n = _BYTES.get(dtype, 4)
     for d in dims.split(","):
@@ -110,8 +137,13 @@ def _fmt_bytes(n):
 
 
 def dump(out_path, model="transformer", batch=None, seq=None,
-         attn_impl=None):
-    """Compile one fused train step AOT and write the audit artifact."""
+         attn_impl=None, mesh=None, zero=None):
+    """Compile one fused train step AOT and write the audit artifact.
+
+    ``mesh=N`` compiles over an N-way data mesh so the gradient
+    collectives exist at all; dump once with ``--zero off`` and once
+    with ``--zero on`` and ``--diff`` the two to see the step's
+    all-reduce turn into a reduce-scatter + all-gather pair."""
     if attn_impl:
         os.environ["MXNET_ATTN_IMPL"] = attn_impl
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -137,13 +169,21 @@ def dump(out_path, model="transformer", batch=None, seq=None,
         shapes = {"data": (b, cfg["seq_len"]),
                   "softmax_label": (b, cfg["seq_len"])}
 
+    dev_mesh = None
+    if mesh:
+        from mxnet_tpu.parallel import create_mesh
+
+        dev_mesh = create_mesh({"data": int(mesh)})
     step = TrainStep(sym, optimizer="sgd",
-                     optimizer_params={"learning_rate": 0.01})
+                     optimizer_params={"learning_rate": 0.01},
+                     mesh=dev_mesh, zero=zero)
     step.compile(shapes)
     compiled = step._aot
     payload = {"kind": ARTIFACT_KIND, "pid": os.getpid(),
                "time": time.time(), "model": model, "shapes":
                {k: list(v) for k, v in shapes.items()},
+               "mesh": int(mesh) if mesh else None,
+               "zero": step.zero_axis is not None,
                "attn_impl": attn_impl or os.environ.get(
                    "MXNET_ATTN_IMPL", "auto")}
     try:
@@ -185,10 +225,15 @@ def print_report(path, payload):
     top_ops = sorted(counts.items(), key=lambda kv: -kv[1])[:8]
     print("    " + "  ".join("%s:%d" % kv for kv in top_ops))
     colls = payload.get("collectives") or []
+    kinds = _kind_summary(payload)
     print("  collectives: %d%s" % (
         len(colls),
-        "" if not colls else "  (" + ", ".join(sorted(
-            {c["op"] for c in colls})) + ")"))
+        "" if not kinds else "  (" + ", ".join(
+            "%s:%d" % (k, kinds[k]["count"])
+            for k in sorted(kinds)) + ")"))
+    for k in sorted(kinds):
+        print("    %-28s x%-4d %s" % (k, kinds[k]["count"],
+                                      _fmt_bytes(kinds[k]["bytes"])))
     for c in colls[:TOP_N]:
         print("    %-44s %-24s %s" % (c["name"], c["op"],
                                       _fmt_bytes(c["bytes"])))
@@ -212,6 +257,20 @@ def diff(path_a, path_b):
             pct = " (%+.1f%%)" % (100.0 * (vb - va) / va) if va else ""
             print("  %-20s %12s -> %12s%s"
                   % (k, _fmt_bytes(va), _fmt_bytes(vb), pct))
+    ka, kb = _kind_summary(a), _kind_summary(b)
+    kmoved = [(k, ka.get(k, {}).get("count", 0),
+               kb.get(k, {}).get("count", 0),
+               ka.get(k, {}).get("bytes", 0),
+               kb.get(k, {}).get("bytes", 0))
+              for k in sorted(set(ka) | set(kb))]
+    print("  collective drift (by kind, new minus old):")
+    if not any(na != nb or ba != bb for _, na, nb, ba, bb in kmoved):
+        print("    (identical collective mix)")
+    for k, na, nb, ba, bb in kmoved:
+        if na == nb and ba == bb:
+            continue
+        print("    %-28s x%d -> x%d   %s -> %s"
+              % (k, na, nb, _fmt_bytes(ba), _fmt_bytes(bb)))
     ca, cb = a.get("op_counts") or {}, b.get("op_counts") or {}
     drift = {op: cb.get(op, 0) - ca.get(op, 0)
              for op in set(ca) | set(cb)}
@@ -284,12 +343,21 @@ def main(argv=None):
     ap.add_argument("--attn-impl",
                     help="force MXNET_ATTN_IMPL for the dump "
                          "(flash|reference|auto)")
+    ap.add_argument("--mesh", type=int,
+                    help="compile the dump over an N-way data mesh "
+                         "(the gradient collectives only exist then)")
+    ap.add_argument("--zero", choices=("auto", "on", "off"),
+                    help="MXNET_ZERO mode for the dump; diff a "
+                         "--zero off dump against a --zero on one to "
+                         "see the all-reduce -> reduce-scatter + "
+                         "all-gather swap")
     ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                     help="compare two artifacts")
     args = ap.parse_args(argv)
     if args.dump:
         return dump(args.dump, model=args.model, batch=args.batch,
-                    seq=args.seq, attn_impl=args.attn_impl)
+                    seq=args.seq, attn_impl=args.attn_impl,
+                    mesh=args.mesh, zero=args.zero)
     if args.diff:
         return diff(*args.diff)
     if not args.paths:
