@@ -319,6 +319,22 @@ def main():
         except Exception as exc:  # keep the primary metric robust
             result["zero_ab_error"] = str(exc)[:200]
         _emit_partial()
+    # data-plane summary row: multiprocess decode pool vs the GIL-bound
+    # thread pool over real JPEGs (bench_fit.measure_decode_ab has the
+    # full A/B; small config here — the claim under test is decode
+    # scaling, not record volume)
+    if not fp32 and "--resnet-only" not in sys.argv:
+        try:
+            import bench_fit
+
+            drow = bench_fit.measure_decode_ab(n_images=128, epochs=1)
+            result["decode_pool_speedup"] = drow["decode_pool_speedup"]
+            result["decode_pool_images_per_sec"] = \
+                drow["decode_pool_images_per_sec"]
+            result["data_workers"] = drow["data_workers"]
+        except Exception as exc:  # keep the primary metric robust
+            result["decode_ab_error"] = str(exc)[:200]
+        _emit_partial()
     # serving summary row: continuous-batching speedup over serial plus
     # the continuous tokens/s and tail TTFT (bench_serve.py has the
     # full per-policy breakdown and the bit-exactness/KV-flat probes)

@@ -237,6 +237,116 @@ def measure_ckpt_save(sym, X, y, batch, saves=5):
     return out
 
 
+def measure_decode_ab(n_images=256, hw=64, batch=32, workers=None,
+                      epochs=2):
+    """Data-plane A/B over one real-JPEG record file: the classic
+    thread-pool ``ImageIter`` (GIL-bound decode) vs the multiprocess
+    ``DataServiceIter`` decode pool, same augmenter chain (rand-crop +
+    mirror + normalize) both sides.  The pool should scale with cores
+    where the thread pool serializes on the GIL."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import get_env
+    from mxnet_tpu.data_service import DataServiceIter
+    from mxnet_tpu.image import (CreateAugmenter, ImageIter,
+                                 RecordImageLoader)
+
+    workers = int(workers if workers is not None
+                  else get_env("MXNET_DATA_WORKERS", 0, int))
+    workers = workers or min(4, os.cpu_count() or 1)
+    shape = (3, hw - 8, hw - 8)  # rand-crop leaves room to move
+
+    def aug():
+        return CreateAugmenter(shape, rand_crop=True, rand_mirror=True,
+                               mean=True, std=True)
+
+    def run(iterator):
+        sum(1 for _ in iterator)  # warm epoch: pools up, caches hot
+        t0 = time.perf_counter()
+        total = 0
+        for _ in range(epochs):
+            iterator.reset()
+            total += sum(b.data[0].shape[0] for b in iterator)
+        return total / (time.perf_counter() - t0)
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "bench")
+        rs = np.random.RandomState(0)
+        rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                         "w")
+        for i in range(n_images):
+            img = (rs.rand(hw, hw, 3) * 255).astype("uint8")
+            rec.write_idx(i, recordio.pack_img(
+                recordio.IRHeader(0, float(i % 10), i, 0), img,
+                quality=95))
+        rec.close()
+
+        it = ImageIter(batch, shape, path_imgrec=prefix + ".rec",
+                       aug_list=aug())
+        thread_rate = run(it)
+        it.close()
+
+        record = recordio.MXIndexedRecordIO(prefix + ".idx",
+                                            prefix + ".rec", "r")
+        loader = RecordImageLoader(shape, record=record, aug_list=aug())
+        svc = DataServiceIter(loader, batch, seed=0, num_workers=workers)
+        try:
+            pool_rate = run(svc)
+        finally:
+            svc.close()
+    return {
+        "data_workers": workers,
+        "decode_thread_images_per_sec": round(thread_rate, 2),
+        "decode_pool_images_per_sec": round(pool_rate, 2),
+        "decode_pool_speedup": round(pool_rate / max(thread_rate, 1e-9),
+                                     3),
+    }
+
+
+def measure_input_attribution(sym, X, y, batch, epochs, host_work=0):
+    """Input-bound vs compute-bound attribution for the fit loop: wrap
+    the feeder in an instrumented :class:`DevicePrefetchIter` (fit's
+    ``prefetch_to_device`` is idempotent at ``steps_per_call=1``, so it
+    reuses the wrapper), and split each delivered batch's wall time into
+    the consumer's staging-ring wait (input starvation — the decode +
+    host→device path couldn't keep up) vs everything else (device step,
+    metrics, callbacks).  ``input_bound_frac`` near 0 means the ring hid
+    the input pipeline entirely; near 1 means fit is input-bound and
+    decode workers, not device FLOPs, are the lever."""
+    import mxnet_tpu as mx
+
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    if host_work:
+        it = make_host_work_iter(it, host_work)
+    dev = mx.io.DevicePrefetchIter(it)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    marks = []
+
+    def epoch_cb(epoch, sym_, arg_params, aux_params):
+        if not marks:  # time + attribute only the post-warmup epochs
+            dev.reset_stage_stats()
+        marks.append(time.perf_counter())
+
+    mod.fit(dev, num_epoch=epochs, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.01},
+            epoch_end_callback=epoch_cb,
+            prefetch_to_device=True, steps_per_call=1)
+    wall = marks[-1] - marks[0]
+    n = max(1, dev.batches_delivered)
+    frac = min(1.0, dev.stage_wait_s / max(wall, 1e-9))
+    return {
+        "input_wait_ms_per_batch": round(dev.stage_wait_s / n * 1e3, 3),
+        "step_ms_per_batch": round(wall / n * 1e3, 3),
+        "input_bound_frac": round(frac, 4),
+        "pipeline_bound": "input" if frac > 0.5 else "compute",
+    }
+
+
 def main():
     # watchdog + budget timers arm BEFORE the first jax/numpy touch:
     # backend init can hang, and an armed timer turns that into valid
@@ -310,6 +420,12 @@ def main():
         result["fit_nopipeline_images_per_sec"] = round(nopipe_s, 2)
         result["nopipeline_efficiency"] = round(nopipe_s / pure_s, 4)
         result["pipeline_speedup"] = round(fit_s / nopipe_s, 4)
+    # where the wall time goes: input starvation vs device step
+    result.update(measure_input_attribution(sym, X, y, batch,
+                                            max(3, epochs // 2),
+                                            host_work=host_work))
+    # multiprocess decode pool vs thread pool over real JPEGs
+    result.update(measure_decode_ab())
     # checkpoint write cost on the training thread, sync vs async
     result.update(measure_ckpt_save(sym, X, y, batch))
     # ZeRO sharded update A/B: state bytes must shrink ~1/N at >=95%

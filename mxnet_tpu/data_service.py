@@ -1,0 +1,455 @@
+"""Sharded deterministic data service: multiprocess decode behind the
+device-staging ring.
+
+Reference: the C++ ``ImageRecordIter`` (``src/io/iter_image_recordio_2.cc``
+— sharded multithreaded decode into a ``dmlc::ThreadedIter`` double
+buffer).  Python threads cannot reproduce its decode throughput (JPEG
+decode only partially releases the GIL, augmentation not at all), so the
+TPU build shards the decode across *processes* instead, and shards the
+shuffle across *hosts* — while keeping the emitted sample stream a pure
+function of ``(seed, epoch)``:
+
+* **Global shuffle, strided sharding.**  Every host builds the same
+  full-dataset permutation ``epoch_permutation(seed, epoch, n)`` from the
+  one shared seed and takes its ``rank::nproc`` stride.  Sample ``m`` of
+  global batch ``b`` is ``perm[b*G + m]`` (``G`` = global batch size)
+  regardless of how many processes split the work, so the *global* sample
+  sequence is identical at any process count — the property elastic
+  N-proc save → M-proc resume needs.
+
+* **Deterministic decode, any worker count.**  Workers receive
+  ``(epoch, batch_id, sample indices)`` tasks, seed their per-sample RNGs
+  from ``fold_in(seed, epoch, index)``, and the consumer reorders results
+  by batch id — so worker completion order, worker count (including 0 =
+  inline decode), and process start method never change the stream.
+
+* **O(1) seek.**  ``seek(epoch, nbatch)`` recomputes the permutation for
+  ``epoch`` and moves the cursor; nothing is replayed.  With a recordio-
+  backed loader the per-sample jump is the ``.idx`` offset lookup.
+
+Fault sites (``MXNET_FAULT_INJECT``): ``data_decode`` fires inside each
+decode task (``raise`` surfaces as a typed error at the consumer's
+``next()``; ``kill`` hard-exits the worker process so the consumer-side
+dead-worker detection must fire; ``delay`` models slow decode; hits are
+counted per worker process), ``data_service`` fires at the consumer's
+``next()``.
+"""
+from __future__ import annotations
+
+import os
+import queue as pyqueue
+import random as pyrandom
+import threading
+import time
+import traceback
+
+import numpy as np
+
+from .base import MXNetError, get_env
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["fold_in", "epoch_permutation", "seed_sample",
+           "DataServiceIter"]
+
+_MASK64 = (1 << 64) - 1
+
+
+def fold_in(seed, *vals):
+    """Mix ``seed`` with integer counters into a 64-bit key (splitmix64
+    finalizer per value).  Pure function: every host computes the same
+    key for the same ``(seed, epoch, index)`` — the substrate for both
+    the epoch permutation and per-sample augmentation RNG."""
+    h = (int(seed) ^ 0x9E3779B97F4A7C15) & _MASK64
+    for v in vals:
+        h = (h + 0x9E3779B97F4A7C15 + (int(v) & _MASK64)) & _MASK64
+        h = ((h ^ (h >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+        h = ((h ^ (h >> 27)) * 0x94D049BB133111EB) & _MASK64
+        h = h ^ (h >> 31)
+    return h
+
+
+def epoch_permutation(seed, epoch, n):
+    """The full-dataset permutation for ``epoch`` — identical on every
+    host (counter-based Philox keyed by ``fold_in(seed, epoch)``, so no
+    sequential RNG state leaks between epochs or hosts)."""
+    key = fold_in(seed, epoch)
+    return np.random.Generator(np.random.Philox(key=key)).permutation(int(n))
+
+
+def seed_sample(seed, epoch, index):
+    """Seed the process-local ``random`` and ``np.random`` streams for
+    one sample, so augmentation draws depend only on
+    ``(seed, epoch, index)`` — not on which worker decodes the sample or
+    what it decoded before."""
+    m = fold_in(seed, epoch, index)
+    pyrandom.seed(m)
+    np.random.seed(m & 0xFFFFFFFF)
+
+
+class _RemoteError:
+    """Picklable carrier for a worker-side exception (tracebacks do not
+    pickle; the string form crosses the process boundary instead)."""
+
+    def __init__(self, exc):
+        self.type_name = type(exc).__name__
+        self.message = str(exc)
+        self.traceback = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+
+    def to_error(self):
+        from .testing.faults import FaultInjected
+
+        cls = FaultInjected if self.type_name == "FaultInjected" \
+            else MXNetError
+        return cls("data service decode worker failed: %s: %s\n%s"
+                   % (self.type_name, self.message, self.traceback))
+
+
+def _decode_batch(loader, seed, epoch, indices):
+    """Decode one batch of global sample ``indices`` — shared by worker
+    processes and the inline (``num_workers=0``) path, so both produce
+    bit-identical results."""
+    from .testing import faults
+
+    faults.inject("data_decode")
+    imgs, labels = [], []
+    for i in indices:
+        seed_sample(seed, epoch, int(i))
+        img, lab = loader(int(i))
+        imgs.append(np.asarray(img))
+        labels.append(np.asarray(lab, np.float32))
+    return np.stack(imgs), np.stack(labels)
+
+
+def _decode_worker(loader, seed, task_q, result_q):
+    """Decode worker main loop.  Tasks are ``(gen, bid, epoch, indices)``;
+    ``None`` is the shutdown sentinel.  Results are ``(gen, bid, payload)``
+    where payload is the decoded pair or a :class:`_RemoteError`."""
+    from .testing import faults
+
+    # a fork can capture the module lock mid-acquire in some parent
+    # thread; replace it so the child cannot deadlock on it
+    faults.rearm_after_fork()
+    init = getattr(loader, "worker_init", None)
+    if init is not None:
+        init()  # e.g. re-open recordio privately (fork shares the offset)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        gen, bid, epoch, indices = task
+        try:
+            payload = _decode_batch(loader, seed, epoch, indices)
+        except faults.WorkerKilled:
+            os._exit(17)  # hard death: no result, no sentinel
+        except BaseException as exc:
+            payload = _RemoteError(exc)
+        result_q.put((gen, bid, payload))
+
+
+class DataServiceIter(DataIter):
+    """Deterministic sharded iterator over a picklable sample loader.
+
+    ``loader`` maps a global sample index to ``(array, label)`` — e.g.
+    :class:`~mxnet_tpu.image.RecordImageLoader` — and is pickled into
+    ``num_workers`` decode processes (0 = decode inline on the consumer
+    thread, same stream).  Optional loader attributes steer batch
+    assembly: ``fast``/``tail_mean``/``tail_std`` (uint8 HWC samples
+    finished by the jitted device tail), ``sample_shape``, ``label_width``,
+    ``data_name``/``label_name``.
+
+    This host emits batches ``order[b*bs:(b+1)*bs]`` of
+    ``order = epoch_permutation(seed, epoch, n)[rank::nproc]``; partial
+    trailing global batches are dropped so every host agrees on
+    ``steps_per_epoch``.  ``reset()`` advances to the next epoch (the
+    convention ``fit`` replays); ``seek(epoch, nbatch)`` jumps anywhere
+    in O(1).
+    """
+
+    def __init__(self, loader, batch_size, num_samples=None, seed=None,
+                 shuffle=True, num_workers=None, rank=None, nproc=None,
+                 inflight=None, start_method=None, poll_s=0.2,
+                 timeout_s=None):
+        super().__init__(batch_size)
+        self._loader = loader
+        self._num_samples = int(num_samples if num_samples is not None
+                                else len(loader))
+        self._seed = int(seed if seed is not None
+                         else get_env("MXNET_DATA_SEED", 0, int))
+        self.shuffle = shuffle
+        self._num_workers = int(num_workers if num_workers is not None
+                                else get_env("MXNET_DATA_WORKERS", 0, int))
+        self._rank = int(rank if rank is not None
+                         else os.environ.get("MXNET_WORKER_ID", "0"))
+        self._nproc = int(nproc if nproc is not None
+                          else os.environ.get("MXNET_NUM_WORKERS", "1"))
+        if self._nproc < 1 or not 0 <= self._rank < self._nproc:
+            raise MXNetError("invalid rank %d / nproc %d"
+                             % (self._rank, self._nproc))
+        self._steps = self._num_samples // (batch_size * self._nproc)
+        if self._steps < 1:
+            raise MXNetError(
+                "num_samples %d < one global batch (%d x %d procs)"
+                % (self._num_samples, batch_size, self._nproc))
+        self._inflight = int(inflight if inflight is not None
+                             else get_env("MXNET_DATA_INFLIGHT",
+                                          max(2, 2 * self._num_workers),
+                                          int))
+        self._start_method = start_method or get_env(
+            "MXNET_DATA_START_METHOD", "fork", str)
+        self._poll_s = float(poll_s)
+        self._timeout_s = float(timeout_s if timeout_s is not None
+                                else get_env("MXNET_DATA_TIMEOUT_S", 0.0,
+                                             float))
+        self._label_width = int(getattr(loader, "label_width", 1))
+        self._data_name = getattr(loader, "data_name", "data")
+        self._label_name = getattr(loader, "label_name", "softmax_label")
+        self._sample_shape = tuple(getattr(loader, "sample_shape", ()))
+        self._fast = bool(getattr(loader, "fast", False))
+        self._tail_mean = getattr(loader, "tail_mean", None)
+        self._tail_std = getattr(loader, "tail_std", None)
+        self._epoch = 0
+        self._cursor = 0     # next batch id to emit
+        self._issued = 0     # next batch id to submit to the pool
+        self._gen = 0        # bumped by seek: stale in-flight results drop
+        self._order = None
+        self._order_epoch = None
+        self._results = {}   # (gen, bid) -> payload, reorder buffer
+        self._error = None
+        self._closed = False
+        self._procs = []
+        self._task_q = None
+        self._result_q = None
+        self._ensure_workers()
+        self._submit_window()
+
+    # -- provide_* -------------------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self._sample_shape,
+                         np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._label_width == 1 else \
+            (self.batch_size, self._label_width)
+        return [DataDesc(self._label_name, shape, np.float32)]
+
+    @property
+    def steps_per_epoch(self):
+        return self._steps
+
+    # -- deterministic order --------------------------------------------
+    def _epoch_order(self):
+        if self._order is None or self._order_epoch != self._epoch:
+            if self.shuffle:
+                perm = epoch_permutation(self._seed, self._epoch,
+                                         self._num_samples)
+            else:
+                perm = np.arange(self._num_samples)
+            self._order = perm[self._rank::self._nproc]
+            self._order_epoch = self._epoch
+        return self._order
+
+    def _batch_indices(self, bid):
+        order = self._epoch_order()
+        return order[bid * self.batch_size:(bid + 1) * self.batch_size]
+
+    # -- worker pool -----------------------------------------------------
+    def _ensure_workers(self):
+        if self._num_workers <= 0 or self._procs:
+            return
+        import multiprocessing as mp
+
+        ctx = mp.get_context(self._start_method)
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._procs = [
+            ctx.Process(target=_decode_worker,
+                        args=(self._loader, self._seed, self._task_q,
+                              self._result_q),
+                        name="mxtpu-data-worker-%d" % i, daemon=True)
+            for i in range(self._num_workers)]
+        for p in self._procs:
+            p.start()
+
+    def _submit_window(self):
+        if not self._procs:
+            return
+        while self._issued < self._steps and \
+                self._issued - self._cursor < self._inflight:
+            self._task_q.put((self._gen, self._issued, self._epoch,
+                              self._batch_indices(self._issued)))
+            self._issued += 1
+
+    def _check_workers(self, bid):
+        dead = [p for p in self._procs
+                if not p.is_alive() and p.exitcode not in (0, None)]
+        if not dead and any(p.is_alive() for p in self._procs):
+            return
+        p = dead[0] if dead else self._procs[0]
+        err = MXNetError(
+            "data service decode worker %s died (exit code %s) without "
+            "delivering batch %d; the input pipeline is broken (worker "
+            "crashed or was killed)" % (p.name, p.exitcode, bid))
+        self._error = err
+        raise err
+
+    def _collect(self, bid):
+        """Block until batch ``bid`` of the current generation arrives,
+        buffering out-of-order results and dropping stale-generation ones
+        (pre-seek leftovers).  Poll-with-liveness instead of a blocking
+        get: a dead worker must surface as a typed error, not a hang."""
+        key = (self._gen, bid)
+        deadline = (time.monotonic() + self._timeout_s) \
+            if self._timeout_s > 0 else None
+        while key not in self._results:
+            try:
+                g, b, payload = self._result_q.get(timeout=self._poll_s)
+            except pyqueue.Empty:
+                self._check_workers(bid)
+                if deadline is not None and time.monotonic() > deadline:
+                    err = MXNetError(
+                        "data service timed out after %.1fs waiting for "
+                        "batch %d (MXNET_DATA_TIMEOUT_S)"
+                        % (self._timeout_s, bid))
+                    self._error = err
+                    raise err
+                continue
+            if g != self._gen:
+                continue
+            self._results[(g, b)] = payload
+        return self._results.pop(key)
+
+    # -- batch assembly --------------------------------------------------
+    def _assemble(self, data, labels, indices):
+        from .ndarray import NDArray, array
+
+        bs = self.batch_size
+        labels = labels.reshape(bs, -1)
+        labels = labels[:, 0] if self._label_width == 1 else labels
+        if self._fast:
+            from .image import _batch_tail_fn
+
+            dev = array(np.ascontiguousarray(data))
+            out = _batch_tail_fn(self._tail_mean, self._tail_std)(dev._data)
+            data_nd = NDArray(out, dev.context)
+        else:
+            data_nd = array(data.astype(np.float32, copy=False))
+        return DataBatch(data=[data_nd], label=[array(labels)], pad=0,
+                         index=np.asarray(indices),
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    # -- iteration -------------------------------------------------------
+    def next(self):
+        from .testing import faults
+
+        faults.inject("data_service")
+        if self._error is not None:
+            raise self._error  # dead pipeline stays dead until seek/reset
+        if self._closed or self._cursor >= self._steps:
+            raise StopIteration
+        bid = self._cursor
+        indices = self._batch_indices(bid)
+        if self._procs:
+            self._submit_window()
+            payload = self._collect(bid)
+            if isinstance(payload, _RemoteError):
+                err = payload.to_error()
+                self._error = err
+                raise err
+            data, labels = payload
+        else:
+            data, labels = _decode_batch(self._loader, self._seed,
+                                         self._epoch, indices)
+        self._cursor += 1
+        self._submit_window()
+        return self._assemble(data, labels, indices)
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            self._next_batch = None
+            return False
+
+    def getdata(self):
+        return self._next_batch.data
+
+    def getlabel(self):
+        return self._next_batch.label
+
+    def getindex(self):
+        return self._next_batch.index
+
+    def getpad(self):
+        return 0
+
+    # -- positioning -----------------------------------------------------
+    def seekable(self):
+        return True
+
+    def seek(self, epoch, nbatch):
+        """Jump to absolute position ``(epoch, nbatch)`` in O(1): bump the
+        generation (in-flight results from the old position are dropped
+        on arrival), recompute the epoch order lazily, and refill the
+        submission window from the new cursor."""
+        epoch, nbatch = int(epoch), int(nbatch)
+        if nbatch < 0 or nbatch > self._steps:
+            raise MXNetError("seek nbatch %d out of range [0, %d]"
+                             % (nbatch, self._steps))
+        self._gen += 1
+        self._results.clear()
+        self._error = None
+        self._closed = False
+        self._epoch = epoch
+        self._cursor = nbatch
+        self._issued = nbatch
+        self._ensure_workers()
+        self._submit_window()
+
+    def reset(self):
+        """Advance to the next epoch — the same "one reset per epoch"
+        contract ``fit`` and the O(steps) replay resume path assume."""
+        self.seek(self._epoch + 1, 0)
+
+    # -- teardown --------------------------------------------------------
+    def close(self, timeout=5):
+        """Shut the worker pool down deterministically: sentinels, join
+        with ``timeout``, terminate stragglers, release the queues.  The
+        iterator reports exhaustion until ``seek``/``reset`` (which
+        respawn the pool)."""
+        procs, self._procs = self._procs, []
+        if procs:
+            for _ in procs:
+                try:
+                    self._task_q.put_nowait(None)
+                except Exception:
+                    pass
+            deadline = time.monotonic() + timeout
+            for p in procs:
+                p.join(timeout=max(0.0, deadline - time.monotonic()))
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=1)
+            for q in (self._task_q, self._result_q):
+                try:
+                    q.close()
+                    q.cancel_join_thread()
+                except Exception:
+                    pass
+            self._task_q = self._result_q = None
+        self._results.clear()
+        self._closed = True
+
+    def __del__(self):
+        try:
+            if self._procs:
+                for p in self._procs:
+                    p.terminate()
+        except Exception:
+            pass

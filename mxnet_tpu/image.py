@@ -20,6 +20,7 @@ import io as _pyio
 import os
 import random
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -35,7 +36,8 @@ __all__ = ["imdecode", "imread", "imresize", "copyMakeBorder",
            "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
            "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "LightingAug", "ColorNormalizeAug",
-           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+           "RecordImageLoader"]
 
 _PIL_INTERP = None
 
@@ -430,6 +432,105 @@ def _split_device_tail(aug_list):
     return list(aug_list), None, None, False
 
 
+class RecordImageLoader:
+    """Picklable per-sample decode+augment kernel — the unit of work
+    shared by :class:`ImageIter` (thread pool) and
+    :class:`~mxnet_tpu.data_service.DataServiceIter` (process pool).
+
+    ``__call__(i)`` decodes sample ``i`` of ``keys`` and returns
+    ``(image, label)`` — uint8 HWC when the augmenter chain's
+    cast/normalize tail runs on device (``fast``), float32 CHW otherwise.
+    Pickling drops the (unpicklable) shared read lock, and the recordio
+    handle inside reopens at its saved offset on unpickle
+    (``MXRecordIO.__setstate__``); after a *fork* the handle still shares
+    the parent's file offset, so process-pool workers call
+    :meth:`worker_init` to re-open it privately.
+    """
+
+    def __init__(self, data_shape, record=None, imglist=None, keys=None,
+                 aug_list=None, label_width=1, data_name="data",
+                 label_name="softmax_label"):
+        if record is None and imglist is None:
+            raise MXNetError("RecordImageLoader needs record= or imglist=")
+        self.record = record
+        self.imglist = imglist
+        if keys is None:
+            keys = list(record.keys) if record is not None \
+                else list(range(len(imglist)))
+        self.keys = list(keys)
+        self.aug_list = CreateAugmenter(data_shape) if aug_list is None \
+            else aug_list
+        (self.host_augs, self.tail_mean, self.tail_std,
+         self.fast) = _split_device_tail(self.aug_list)
+        self.sample_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self._lock = None
+
+    def __len__(self):
+        return len(self.keys)
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_lock"] = None
+        return d
+
+    def worker_init(self):
+        """Per-process re-arm for decode workers: a private file offset
+        (a forked child shares the parent's) and no lock (the worker is
+        single-threaded)."""
+        self._lock = None
+        if self.record is not None:
+            self.record._reopen_read()
+
+    def _read(self, key):
+        if self.record is not None:
+            if self._lock is not None:
+                with self._lock:
+                    raw = self.record.read_idx(key)
+            else:
+                raw = self.record.read_idx(key)
+            header, img = recordio.unpack_img(raw)
+            return img, header.label
+        label, fname = self.imglist[key]
+        return imread(fname), label
+
+    def load_float(self, key):
+        """Classic path: full augmenter chain per image, float32 CHW."""
+        img, label = self._read(key)
+        for aug in self.aug_list:
+            img = aug(img)
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.sample_shape
+        if img.shape[:2] != (h, w):
+            img = imresize(img.astype(np.uint8), w, h)
+            img = np.asarray(img, np.float32).reshape(h, w, c)
+        return img.transpose(2, 0, 1), np.asarray(label, np.float32)
+
+    def load_uint8(self, key):
+        """Fast path: decode + host (shape-only) augs, uint8 HWC out; the
+        cast/transpose/normalize tail runs on device per batch."""
+        img, label = self._read(key)
+        for aug in self.host_augs:
+            img = aug(img)
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.sample_shape
+        if img.shape[:2] != (h, w):
+            img = imresize(img.astype(np.uint8), w, h)
+            img = np.asarray(img).reshape(h, w, c)
+        return img.astype(np.uint8, copy=False), \
+            np.asarray(label, np.float32)
+
+    def __call__(self, i):
+        key = self.keys[int(i)]
+        return self.load_uint8(key) if self.fast else self.load_float(key)
+
+
 class ImageIter(DataIter):
     """Image iterator over RecordIO (or an image list) with augmenters —
     the reference's Python ``ImageIter``, doubling as the backing for
@@ -438,6 +539,12 @@ class ImageIter(DataIter):
     Supports ``part_index``/``num_parts`` sharding (each worker reads a
     contiguous slice of the key space, like ``dmlc::InputSplit``),
     shuffling, and a thread pool for decode+augment.
+
+    With ``seed=`` the per-epoch shuffle order becomes a pure function of
+    ``(seed, epoch)`` (counter-based permutation over this shard's keys),
+    which makes the iterator *seekable*: ``seek(epoch, nbatch)`` jumps in
+    O(1) instead of replaying.  Unseeded shuffle keeps the legacy
+    global-``random`` in-place shuffle.
     """
 
     def __init__(self, batch_size, data_shape, label_width=1,
@@ -445,7 +552,7 @@ class ImageIter(DataIter):
                  path_root="", shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", last_batch_handle="pad",
-                 num_threads=4, **kwargs):
+                 num_threads=4, seed=None, **kwargs):
         super().__init__(batch_size)
         if num_parts < 1 or not 0 <= part_index < num_parts:
             raise MXNetError("invalid part_index %d / num_parts %d"
@@ -492,17 +599,31 @@ class ImageIter(DataIter):
                              % (part_index, num_parts, total))
         self.aug_list = CreateAugmenter(data_shape) if aug_list is None \
             else aug_list
-        # device-tail fast path: host stays uint8, cast/transpose/
-        # normalize run jitted on device per BATCH
-        (self._host_augs, self._tail_mean, self._tail_std,
-         self._fast_tail) = _split_device_tail(self.aug_list)
+        # the per-sample decode kernel is a standalone picklable object
+        # (shared with the multiprocess data service); device-tail fast
+        # path: host stays uint8, cast/transpose/normalize run jitted on
+        # device per BATCH
+        self._loader = RecordImageLoader(
+            data_shape, record=self.record, imglist=self.imglist,
+            keys=self.keys, aug_list=self.aug_list,
+            label_width=label_width, data_name=data_name,
+            label_name=label_name)
+        self._host_augs = self._loader.host_augs
+        self._tail_mean = self._loader.tail_mean
+        self._tail_std = self._loader.tail_std
+        self._fast_tail = self._loader.fast
         # a 1-core host gains nothing from a decode pool (GIL thrash
         # with the consumer); run decode inline there
         self._serial = num_threads <= 1 or (os.cpu_count() or 1) <= 1
+        self._num_threads = num_threads
         self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self._closed = False
         # record seek+read must be atomic (one shared file handle across
         # the decode pool); decode/augment run outside the lock
         self._rec_lock = threading.Lock()
+        self._loader._lock = self._rec_lock
+        self._seed = seed
+        self._epoch = -1  # reset() below starts epoch 0
         self.cur = 0
         self._order = list(self.keys)
         self.reset()
@@ -518,58 +639,89 @@ class ImageIter(DataIter):
             (self.batch_size, self.label_width)
         return [DataDesc(self.label_name, shape, np.float32)]
 
-    def reset(self):
-        if self.shuffle:
+    def _reorder(self):
+        """Recompute this epoch's sample order.  Seeded: a counter-based
+        permutation keyed by ``(seed, epoch)`` — position-addressable,
+        so ``seek`` can land anywhere.  Unseeded: the legacy in-place
+        ``random.shuffle`` (history-dependent, not seekable)."""
+        if not self.shuffle:
+            return
+        if self._seed is not None:
+            from .data_service import epoch_permutation
+
+            perm = epoch_permutation(self._seed, self._epoch,
+                                     len(self.keys))
+            self._order = [self.keys[i] for i in perm]
+        else:
             random.shuffle(self._order)
+
+    def _reopen_pool(self):
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=self._num_threads)
+        self._closed = False
+
+    def reset(self):
+        self._epoch += 1
+        self._reorder()
         if self.record is not None:
             self.record.reset()
         self.cur = 0
+        self._reopen_pool()
+
+    def seekable(self):
+        return (not self.shuffle) or self._seed is not None
+
+    def seek(self, epoch, nbatch):
+        """O(1) jump to ``(epoch, nbatch)``: recompute the seeded epoch
+        permutation and place the cursor via the recordio index — no
+        batches decoded or replayed."""
+        if not self.seekable():
+            raise MXNetError(
+                "ImageIter with shuffle=True but no seed= is not "
+                "seekable; pass seed= for position-addressable epochs")
+        self._epoch = int(epoch)
+        self._reorder()
+        if not self.shuffle:
+            self._order = list(self.keys)
+        self.cur = int(nbatch) * self.batch_size
+        self._reopen_pool()
+
+    def close(self, timeout=5):
+        """Shut the decode pool down deterministically (same
+        join-with-timeout contract as the prefetchers'
+        ``_ThreadedPrefetchTeardown.close``): cancel queued work, join
+        the pool threads with ``timeout``, warn if any survive.  The
+        iterator reports exhaustion until ``reset``/``seek`` (which
+        recreate the pool)."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+            threads = list(getattr(pool, "_threads", ()))
+            deadline = time.monotonic() + timeout
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if any(t.is_alive() for t in threads):
+                import logging
+
+                logging.warning("ImageIter decode pool did not exit "
+                                "within %ss on close()", timeout)
+        self._closed = True
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def _load_one(self, key):
-        if self.record is not None:
-            with self._rec_lock:
-                raw = self.record.read_idx(key)
-            header, img = recordio.unpack_img(raw)
-            label = header.label
-        else:
-            label, fname = self.imglist[key]
-            img = imread(fname)
-        for aug in self.aug_list:
-            img = aug(img)
-        img = np.asarray(img, np.float32)
-        if img.ndim == 2:
-            img = img[:, :, None]
-        c, h, w = self.data_shape
-        if img.shape[:2] != (h, w):
-            img = imresize(img.astype(np.uint8), w, h)
-            img = np.asarray(img, np.float32).reshape(h, w, c)
-        return img.transpose(2, 0, 1), np.asarray(label, np.float32)
+        return self._loader.load_float(key)
 
     def _load_one_uint8(self, key):
         """Fast-path loader: decode + host (shape-only) augs, uint8 HWC
         out; the cast/transpose/normalize tail runs on device."""
-        if self.record is not None:
-            with self._rec_lock:
-                raw = self.record.read_idx(key)
-            header, img = recordio.unpack_img(raw)
-            label = header.label
-        else:
-            label, fname = self.imglist[key]
-            img = imread(fname)
-        for aug in self._host_augs:
-            img = aug(img)
-        img = np.asarray(img)
-        if img.ndim == 2:
-            img = img[:, :, None]
-        c, h, w = self.data_shape
-        if img.shape[:2] != (h, w):
-            img = imresize(img.astype(np.uint8), w, h)
-            img = np.asarray(img).reshape(h, w, c)
-        return img.astype(np.uint8, copy=False), \
-            np.asarray(label, np.float32)
+        return self._loader.load_uint8(key)
 
     def next(self):
-        if self.cur >= len(self._order):
+        if self._closed or self.cur >= len(self._order):
             raise StopIteration
         want = self._order[self.cur:self.cur + self.batch_size]
         pad = self.batch_size - len(want)

@@ -9,19 +9,22 @@ thread double-buffering host→device transfers (the role of
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DevicePrefetchIter", "prefetch_to_device",
            "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter", "ImageDetRecordIter"]
+           "LibSVMIter", "ImageDetRecordIter",
+           "DataServiceIter", "fold_in", "epoch_permutation"]
 
 
 def _queue_get_or_die(q, thread, what, poll_s=0.2):
@@ -128,6 +131,24 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- seekable protocol (O(1) resume) --------------------------------
+    def seekable(self):
+        """True when :meth:`seek` can jump this iterator to an absolute
+        ``(epoch, nbatch)`` position without replaying batches — the O(1)
+        resume path ``fit(resume_from=...)`` prefers over O(steps)
+        replay.  Seekability requires the stream to be a pure function of
+        position (deterministic or seeded shuffle)."""
+        return False
+
+    def seek(self, epoch, nbatch):
+        """Position the stream so the next batch drawn is batch ``nbatch``
+        of epoch ``epoch`` (both 0-based), exactly as if ``epoch`` resets
+        and ``nbatch`` draws had been replayed."""
+        raise MXNetError(
+            "%s is not seekable (unseeded shuffle makes the stream a "
+            "function of RNG history, not position); resume falls back "
+            "to O(steps) replay" % type(self).__name__)
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize data/label inputs to a list of (name, array) (reference
@@ -174,6 +195,7 @@ class NDArrayIter(DataIter):
         # so its draw position differs between cold start and resume)
         self._rng = np.random.RandomState(seed) if seed is not None \
             else np.random
+        self._seed = seed
         self.last_batch_handle = last_batch_handle
         if last_batch_handle == "discard":
             self.num_data = (self.num_data // batch_size) * batch_size
@@ -205,6 +227,29 @@ class NDArrayIter(DataIter):
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
+
+    def seekable(self):
+        return (not self.shuffle) or self._seed is not None
+
+    def seek(self, epoch, nbatch):
+        """O(1)-in-steps jump: rebuild the private shuffle RNG at its
+        epoch-``epoch`` state (one in-place shuffle per epoch boundary,
+        exactly the draws replayed resets would make — the constructor's
+        reset is shuffle #1 for epoch 0) and place the cursor directly;
+        no batches are drawn."""
+        if not self.seekable():
+            raise MXNetError(
+                "NDArrayIter with shuffle=True but no seed= is not "
+                "seekable: the shuffle order is a function of global RNG "
+                "history, not of (epoch, nbatch)")
+        epoch, nbatch = int(epoch), int(nbatch)
+        if self.shuffle:
+            self.idx = np.arange(self.idx.shape[0])
+            rng = np.random.RandomState(self._seed)
+            for _ in range(epoch + 1):
+                rng.shuffle(self.idx)
+            self._rng = rng
+        self.cursor = nbatch * self.batch_size - self.batch_size
 
     def _getdata(self, data_source):
         assert self.cursor < self.num_data
@@ -315,6 +360,40 @@ class _ThreadedPrefetchTeardown(object):
             self._worker_error = pending
             raise pending
 
+    def _halt(self):
+        """Stop the worker WITHOUT restarting it and clear queue/error
+        state — the shared first half of ``reset()`` and ``seek()``.
+        Drain so a worker blocked on a full queue can observe the stop
+        and exit; it may still enqueue the batch it was holding, so
+        drain again AFTER the join so no stale batch survives into the
+        restarted stream."""
+        self._stop.set()
+        self._drain()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._drain()
+        self._worker_error = None
+        self._exhausted = False
+
+    def seekable(self):
+        return all(getattr(i, "seekable", lambda: False)()
+                   for i in self.iters)
+
+    def seek(self, epoch, nbatch):
+        """Jump the whole pipeline: halt the staging worker, seek every
+        inner iterator to ``(epoch, nbatch)``, restart streaming from
+        the new position.  ``nbatch`` counts raw inner batches (the
+        units ``fit`` checkpoints), independent of any pack factor."""
+        if not self.seekable():
+            raise MXNetError(
+                "%s cannot seek: inner iterator(s) %s are not seekable"
+                % (type(self).__name__,
+                   [type(i).__name__ for i in self.iters]))
+        self._halt()
+        for i in self.iters:
+            i.seek(epoch, nbatch)
+        self._start()
+
     def __del__(self):
         self._stop.set()
 
@@ -379,17 +458,7 @@ class PrefetchingIter(_ThreadedPrefetchTeardown, DataIter):
         self._thread.start()
 
     def reset(self):
-        self._stop.set()
-        # Drain so a worker blocked on a full queue can observe _stop and
-        # exit; it may still enqueue the batch it was holding, so drain
-        # again AFTER the join to guarantee no stale pre-reset batch
-        # survives into the next epoch.
-        self._drain()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._drain()
-        self._worker_error = None
-        self._exhausted = False
+        self._halt()
         for i in self.iters:
             i.reset()
         self._start()
@@ -488,6 +557,12 @@ class DevicePrefetchIter(_ThreadedPrefetchTeardown, DataIter):
         self._worker_error = None
         self._warned_drop = False
         self._exhausted = False
+        # consumer-side staging-wait accounting: how long next() blocked
+        # on the ring vs how many batches it delivered.  When the ratio
+        # is high the pipeline is INPUT-bound (decode/transfer cannot
+        # keep up with the device); bench_fit.py reports the attribution
+        self.stage_wait_s = 0.0
+        self.batches_delivered = 0
         self._start()
 
     @property
@@ -602,19 +677,14 @@ class DevicePrefetchIter(_ThreadedPrefetchTeardown, DataIter):
         self._thread.start()
 
     def reset(self):
-        # same protocol as PrefetchingIter.reset: stop, drain so a worker
-        # blocked on the full queue can exit, join, drain the batch it
-        # may still have enqueued, then restart on freshly reset inners
-        self._stop.set()
-        self._drain()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._drain()
-        self._worker_error = None
-        self._exhausted = False
+        self._halt()
         for i in self.iters:
             i.reset()
         self._start()
+
+    def reset_stage_stats(self):
+        self.stage_wait_s = 0.0
+        self.batches_delivered = 0
 
     def iter_next(self):
         if self._worker_error is not None:
@@ -625,6 +695,7 @@ class DevicePrefetchIter(_ThreadedPrefetchTeardown, DataIter):
             # keep returning False (the worker is gone — a fresh get()
             # would block forever); reset() restarts the stream
             return False
+        t0 = time.perf_counter()
         try:
             batch = _queue_get_or_die(self._queue, self._thread,
                                       "DevicePrefetchIter")
@@ -637,6 +708,8 @@ class DevicePrefetchIter(_ThreadedPrefetchTeardown, DataIter):
         if isinstance(batch, Exception):
             self._worker_error = batch
             raise batch
+        self.stage_wait_s += time.perf_counter() - t0
+        self.batches_delivered += 1
         self.current_batch = batch
         return True
 
@@ -827,16 +900,27 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
                     std_r=0.0, std_g=0.0, std_b=0.0,
                     max_random_contrast=0, max_random_illumination=0,
                     preprocess_threads=4, prefetch_buffer=2,
-                    data_name="data", label_name="softmax_label", **kwargs):
+                    data_name="data", label_name="softmax_label",
+                    num_workers=None, seed=None, **kwargs):
     """RecordIO-backed image iterator (reference C iterator
     ``ImageRecordIter``, ``src/io/iter_image_recordio_2.cc:513`` + the
     default augmenter chain ``src/io/image_aug_default.cc``).
 
-    Factory with the C iterator's parameter surface: builds an
-    :class:`~mxnet_tpu.image.ImageIter` with the matching augmenter list
-    (resize -> crop -> mirror -> jitter -> normalize), threaded decode,
-    ``part_index``/``num_parts`` sharding, and wraps it in
-    :class:`PrefetchingIter` so host decode overlaps device steps.
+    Factory with the C iterator's parameter surface.  Two backends:
+
+    * ``num_workers > 0`` (or ``MXNET_DATA_WORKERS``): the sharded
+      deterministic data service — a :class:`DataServiceIter` over a
+      picklable :class:`~mxnet_tpu.image.RecordImageLoader` with a
+      multiprocess decode pool, cross-host global shuffle from ``seed``
+      (``rank::nproc`` striding via ``part_index``/``num_parts``), and
+      O(1) ``seek`` resume.
+    * otherwise the classic :class:`~mxnet_tpu.image.ImageIter` with the
+      matching augmenter list (resize -> crop -> mirror -> jitter ->
+      normalize), threaded decode, and contiguous
+      ``part_index``/``num_parts`` sharding.
+
+    Either backend is wrapped in :class:`PrefetchingIter` so host-side
+    batch assembly overlaps device steps.
     """
     from . import image as img_mod
 
@@ -851,12 +935,28 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         data_shape, resize=resize, rand_crop=rand_crop,
         rand_mirror=rand_mirror, mean=mean, std=std,
         contrast=max_random_contrast, brightness=max_random_illumination)
+    workers = int(num_workers if num_workers is not None
+                  else get_env("MXNET_DATA_WORKERS", 0, int))
+    if workers > 0:
+        from . import recordio as rec_mod
+        from .image import RecordImageLoader
+
+        idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+        record = rec_mod.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+        loader = RecordImageLoader(
+            data_shape, record=record, aug_list=aug_list,
+            label_width=label_width, data_name=data_name,
+            label_name=label_name)
+        svc = DataServiceIter(
+            loader, batch_size, seed=seed, shuffle=shuffle,
+            num_workers=workers, rank=part_index, nproc=num_parts)
+        return PrefetchingIter(svc, prefetch_depth=prefetch_buffer)
     inner = img_mod.ImageIter(
         batch_size, data_shape, label_width=label_width,
         path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
         part_index=part_index, num_parts=num_parts, aug_list=aug_list,
         data_name=data_name, label_name=label_name,
-        num_threads=preprocess_threads, **kwargs)
+        num_threads=preprocess_threads, seed=seed, **kwargs)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
 
 
@@ -882,3 +982,10 @@ def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
                          max_objects=max_objects, aug_list=aug_list,
                          num_threads=preprocess_threads, **kwargs)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)
+
+
+# the data-service layer builds on the iterator ABC above; imported last
+# to avoid a circular import, re-exported here so the data plane has one
+# front door (``mxnet_tpu.io``)
+from .data_service import (DataServiceIter, epoch_permutation,  # noqa: E402
+                           fold_in)

@@ -700,12 +700,26 @@ class BaseModule:
         hmon.soft_reset()
 
     def _fast_forward_data(self, train_data, epochs, nbatch):
-        """Replay the raw data stream to a mid-run position: one
-        ``reset()`` per completed epoch reproduces the shuffle-RNG draw
-        sequence an uninterrupted run performs at its epoch boundaries
-        (given the same process-level seeding — see
+        """Fast-forward the raw data stream to a mid-run position.
+
+        Seekable pipelines (seeded :class:`~mxnet_tpu.io.NDArrayIter`,
+        the data service, seeded :class:`~mxnet_tpu.image.ImageIter`,
+        and any prefetch wrapper over them) jump in O(1):
+        ``seek(epochs, nbatch)`` recomputes the epoch permutation from
+        the seed and places the cursor — no decode, no replay, bit-exact
+        at any process count.  Everything else falls back to O(steps)
+        replay: one ``reset()`` per completed epoch reproduces the
+        shuffle-RNG draw sequence an uninterrupted run performs at its
+        epoch boundaries (given the same process-level seeding — see
         ``docs/fault_tolerance.md``), then ``nbatch`` batches are drawn
         and discarded."""
+        can_seek = getattr(train_data, "seekable", None)
+        if can_seek is not None and can_seek():
+            train_data.seek(int(epochs), int(nbatch))
+            self.logger.info(
+                "resume fast-forward: O(1) seek to epoch %d batch %d",
+                int(epochs), int(nbatch))
+            return
         for _ in range(int(epochs)):
             train_data.reset()
         for skipped in range(int(nbatch)):

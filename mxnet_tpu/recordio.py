@@ -131,22 +131,53 @@ class MXRecordIO:
     def __del__(self):
         self.close()
 
-    # pickling support mirrors the reference (reopen on restore)
+    def _reopen_read(self, offset=0):
+        """(Re)open the underlying file read-only at ``offset``, bypassing
+        :meth:`open` so subclass index state survives.  Two callers: the
+        unpickle path below, and post-fork re-arm — a forked decode worker
+        inherits the parent's open file *description*, so seeks in the
+        child would race the parent's reads until the child re-opens
+        privately."""
+        if self.is_open:
+            self._fp.close()
+        self._fp = open(self.uri, "rb")
+        if offset:
+            self._fp.seek(offset)
+        self.writable = False
+        self.is_open = True
+
+    # -- pickling: read handles survive the trip into decode worker
+    # processes, resuming at the byte offset they were pickled at.
     def __getstate__(self):
+        if self.is_open and self.writable:
+            raise MXNetError(
+                "cannot pickle a writable MXRecordIO handle for %s: the "
+                "restored copy would have to reopen with 'w' and truncate "
+                "the file; close() it first" % self.uri)
         d = dict(self.__dict__)
+        d["_pickle_offset"] = self._fp.tell() if self.is_open else 0
         d["is_open"] = False
-        del d["_fp"]
+        d.pop("_fp", None)
         return d
 
     def __setstate__(self, d):
+        offset = d.pop("_pickle_offset", 0)
         self.__dict__.update(d)
-        if d.get("flag") is not None:
-            self.open()
+        # readers reopen in place at the saved offset; a pickled *closed*
+        # writer stays closed (the old behavior of calling open() here
+        # would have truncated the file on restore)
+        if self.flag == "r":
+            self._reopen_read(offset)
 
 
 class MXIndexedRecordIO(MXRecordIO):
     """Random-access reader/writer with an index sidecar (reference
-    ``MXIndexedRecordIO``)."""
+    ``MXIndexedRecordIO``).
+
+    Read handles pickle like the base class, and the in-memory index
+    (``idx``/``keys``) travels inside the pickle — the restored reader is
+    immediately ``read_idx``-able with no sidecar re-read or frame
+    rescan, even if the ``.idx`` file has since disappeared."""
 
     def __init__(self, idx_path, uri, flag, key_type=int):
         self.idx_path = idx_path

@@ -68,7 +68,13 @@ per-request decode-step, and response boundaries; a fault fails that
 one request and releases its slot — surviving slots keep decoding, the
 isolation the serve chaos tests assert).  The serve sites fire in
 deterministic slot order each step, so ``after=N`` picks a specific
-request.
+request.  ``data_decode`` fires inside each data-service decode task
+(in the worker *process* with ``num_workers > 0`` — hits are counted
+per process — or inline on the consumer thread with 0): ``raise``
+surfaces as a typed error at the consumer's ``next()``, ``kill``
+hard-exits the worker so the consumer-side dead-worker detection must
+fire instead of hanging the ring, ``delay`` models slow decode.
+``data_service`` fires at the consumer's ``next()`` itself.
 
 The parsed spec auto-refreshes when the env var string changes; call
 :func:`reset` to re-arm counters when reusing the same string (tests).
@@ -81,7 +87,8 @@ import time
 
 from ..base import MXNetError
 
-__all__ = ["FaultInjected", "WorkerKilled", "inject", "reset", "active"]
+__all__ = ["FaultInjected", "WorkerKilled", "inject", "reset", "active",
+           "rearm_after_fork"]
 
 ENV_VAR = "MXNET_FAULT_INJECT"
 
@@ -157,6 +164,16 @@ def reset():
     with _lock:
         _env_snapshot = None
         _refresh_locked()
+
+
+def rearm_after_fork():
+    """Replace the module lock in a freshly forked child.  A fork can
+    land while another parent thread holds ``_lock``; the child inherits
+    the locked state with no owner, so every later :func:`inject` there
+    would deadlock.  Decode worker processes call this first."""
+    global _lock
+
+    _lock = threading.RLock()
 
 
 def active(site=None):
