@@ -304,10 +304,11 @@ def main():
             result["transformer_error"] = str(exc)[:200]
         _emit_partial()
     # ZeRO A/B row: the sharded update's state shrink (~1/N per
-    # replica) and step-rate ratio vs the replicated update, over the
-    # local device mesh (bench_fit.measure_zero_ab; skipped when the
-    # host exposes a single device).  Cheap MLP config — the claim
-    # under test is the collective swap, not model FLOPs.
+    # replica), the ZeRO-3 at-rest param shrink + step-rate ratios vs
+    # the replicated update, over the local device mesh
+    # (bench_fit.measure_zero_ab; skipped when the host exposes a
+    # single device).  Cheap MLP config — the claim under test is the
+    # collective swap, not model FLOPs.
     if not fp32 and "--resnet-only" not in sys.argv:
         try:
             import bench_fit

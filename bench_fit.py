@@ -89,10 +89,11 @@ def measure_pure_step(sym, batch, feat, iters=60):
 
 
 def measure_zero_ab(sym, batch, feat, iters=30):
-    """zero=on vs zero=off A/B over the device mesh: step rate, the
-    per-replica optimizer-state bytes (the ZeRO 1/N claim) and the
-    per-step fresh-param all-gather bytes.  Adam, so the state is real
-    (two moments per weight); skipped on a single-device host where the
+    """zero=off vs zero=on vs zero=3 A/B over the device mesh: step
+    rate, the per-replica optimizer-state bytes (the ZeRO 1/N claim),
+    the per-replica at-rest parameter bytes (the ZeRO-3 1/N claim), and
+    the per-step gather traffic.  Adam, so the state is real (two
+    moments per weight); skipped on a single-device host where the
     sharded update auto-declines."""
     import jax
     import numpy as np
@@ -106,7 +107,7 @@ def measure_zero_ab(sym, batch, feat, iters=30):
     mesh = create_mesh({"data": ndev})
     out = {"zero_ndev": ndev}
     rates = {}
-    for mode in ("off", "on"):
+    for mode in ("off", "on", "3"):
         step = TrainStep(sym, optimizer="adam",
                          optimizer_params={"learning_rate": 0.125,
                                            "rescale_grad": 1.0 / batch},
@@ -125,14 +126,25 @@ def measure_zero_ab(sym, batch, feat, iters=30):
         float(np.asarray(out_[0][0, 0]))
         rates[mode] = batch * iters / (time.perf_counter() - t0)
         rep = step.memory_report(params, states)
-        out["opt_state_bytes_%s" % mode] = int(rep["opt_state_bytes"])
+        tag = "zero3" if mode == "3" else mode
+        out["opt_state_bytes_%s" % tag] = int(rep["opt_state_bytes"])
+        out["params_bytes_at_rest_%s" % tag] = \
+            int(rep["params_bytes_per_replica"])
+        out["gather_bytes_per_step_%s" % tag] = \
+            int(rep["gather_bytes_per_step"])
         if mode == "on":
             out["update_gather_bytes"] = int(rep["update_gather_bytes"])
     out["zero_off_images_per_sec"] = round(rates["off"], 2)
     out["zero_on_images_per_sec"] = round(rates["on"], 2)
+    out["zero3_images_per_sec"] = round(rates["3"], 2)
     out["zero_step_ratio"] = round(rates["on"] / rates["off"], 4)
+    out["zero3_step_ratio"] = round(rates["3"] / rates["off"], 4)
+    out["zero3_vs_zero1_step_ratio"] = round(rates["3"] / rates["on"], 4)
     out["zero_state_shrink"] = round(
         out["opt_state_bytes_off"] / max(1, out["opt_state_bytes_on"]), 3)
+    out["zero3_params_shrink"] = round(
+        out["params_bytes_at_rest_off"]
+        / max(1, out["params_bytes_at_rest_zero3"]), 3)
     return out
 
 

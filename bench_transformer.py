@@ -90,6 +90,15 @@ def measure(argv=None):
     _RESULT["opt_state_bytes"] = int(mem_rep.get("opt_state_bytes") or 0)
     _RESULT["update_gather_bytes"] = int(
         mem_rep.get("update_gather_bytes") or 0)
+    # ZeRO-3 residency columns: at-rest per-replica param bytes (1/N
+    # when params are sharded at rest) and the total per-step gather
+    # traffic (2x the sharded footprint under zero=3: forward bucket
+    # gathers + backward re-gathers; the stage-1 trailing gather
+    # otherwise)
+    _RESULT["params_bytes_at_rest"] = int(
+        mem_rep.get("params_bytes_per_replica") or 0)
+    _RESULT["gather_bytes_per_step"] = int(
+        mem_rep.get("gather_bytes_per_step") or 0)
     rng = jax.random.PRNGKey(0)
     toks = jnp.asarray(
         np.random.RandomState(0).randint(

@@ -305,7 +305,7 @@ class CheckpointManager:
     # -- save -----------------------------------------------------------
     def save(self, module=None, epoch=0, nbatch=0, symbol=None,
              arg_params=None, aux_params=None, zero_states=None,
-             num_update=None):
+             zero_params=None, num_update=None):
         """Write one checkpoint.  Pass a bound ``module`` (params, aux,
         symbol and optimizer states are pulled from it) or explicit
         ``symbol``/``arg_params``/``aux_params``.  ``epoch`` counts
@@ -318,8 +318,14 @@ class CheckpointManager:
         ZeRO states are exported automatically); the sharded optimizer
         state rides the same piece-window format as the params, so every
         rank contributes its own 1/N windows and ANY topology can
-        reassemble them on load.  ``num_update`` overrides the update
-        count recorded in the manifest (module-less saves).
+        reassemble them on load.  ``zero_params``: the matching
+        ``parallel.zero.export_params`` descriptor for ZeRO-3 runs —
+        the at-rest flat parameter tiles ride the same piece windows
+        under their ``arg:`` keys (a module's tiles are exported
+        automatically), and load reassembles them back to canonical
+        shapes, so a ZeRO-3 save restores into ANY topology including
+        ``zero=off``.  ``num_update`` overrides the update count
+        recorded in the manifest (module-less saves).
 
         With async writes on, only the device→host snapshot happens on
         this thread; serialization and publish run on the
@@ -339,7 +345,7 @@ class CheckpointManager:
         aux_params = aux_params or {}
 
         if int(get_env("MXNET_CKPT_FORMAT", 2, int)) < 2:
-            if zero_states is not None:
+            if zero_states is not None or zero_params is not None:
                 raise MXNetError(
                     "ZeRO-sharded optimizer state needs the v2 "
                     "piece-window checkpoint format (MXNET_CKPT_FORMAT=2)")
@@ -349,6 +355,7 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         snap = self._snapshot(module, epoch, nbatch, symbol, arg_params,
                               aux_params, zero_states=zero_states,
+                              zero_params=zero_params,
                               num_update=num_update)
         if self.async_writes and self._async_eligible():
             self._join_writer()  # depth-1 bound: one write in flight
@@ -378,7 +385,8 @@ class CheckpointManager:
         return False
 
     def _snapshot(self, module, epoch, nbatch, symbol, arg_params,
-                  aux_params, zero_states=None, num_update=None):
+                  aux_params, zero_states=None, zero_params=None,
+                  num_update=None):
         """Device→host snapshot, on the calling thread: after this
         returns, the training loop may mutate params freely."""
         rank = self._rank()
@@ -395,6 +403,30 @@ class CheckpointManager:
         for tag, params in (("arg", arg_params), ("aux", aux_params)):
             for name, arr in params.items():
                 _add("%s:%s" % (tag, name), arr)
+        if zero_params is None and module is not None:
+            exporter = getattr(module, "_export_zero_params", None)
+            if exporter is not None:
+                zero_params = exporter()
+        zparams_meta = None
+        if zero_params:
+            # ZeRO-3 at-rest tiles ride the same piece windows under
+            # their arg: keys, REPLACING any canonical entry of the same
+            # name added above — each rank contributes its own 1/N
+            # windows, and the load path trims the flat padding back to
+            # the canonical shape (manifest "zero_params" records how)
+            zparams_meta = {}
+            for name, ent in zero_params.items():
+                key = "arg:%s" % name
+                for pk in [k for k, info in piece_map.items()
+                           if info["param"] == key]:
+                    pieces.pop(pk, None)
+                    piece_map.pop(pk, None)
+                zparams_meta[name] = {
+                    "logical": int(ent["logical"]),
+                    "canonical_shape": [int(s)
+                                        for s in ent["canonical_shape"]],
+                }
+                _add(key, ent["leaf"])
         if zero_states is None and self.save_optimizer_states and \
                 module is not None:
             exporter = getattr(module, "_export_zero_states", None)
@@ -434,7 +466,7 @@ class CheckpointManager:
                 "rank": rank, "nproc": self._num_workers(),
                 "params_meta": params_meta, "pieces": pieces,
                 "piece_map": piece_map, "states": states,
-                "zero_meta": zero_meta}
+                "zero_meta": zero_meta, "zparams_meta": zparams_meta}
 
     def _states_blob(self, module):
         """Optimizer states as bytes (the module API writes files, so
@@ -531,7 +563,8 @@ class CheckpointManager:
                 "params": snap["params_meta"],
                 "shards": self._merge_sidecars(epoch, snap["nproc"]),
                 "states": states_entry,
-                "zero_states": snap.get("zero_meta")}
+                "zero_states": snap.get("zero_meta"),
+                "zero_params": snap.get("zparams_meta")}
             atomic_replace(self._manifest_path(epoch),
                            lambda tmp: _write_json(tmp, manifest))
             self._gc()
@@ -706,14 +739,27 @@ class CheckpointManager:
                     % (epoch, self.prefix, "; ".join(problems)))
         arrays = self._assemble(manifest)
         opt_states = self._reassemble_zero(manifest, arrays)
+        # ZeRO-3 saves record params as flat padded tiles; trim them
+        # back to canonical shapes BEFORE layout/reshard so the restore
+        # topology (any N, or zero=off) sees ordinary full params.  The
+        # saved spec described the flat tile layout and no longer
+        # applies.
+        zparams = manifest.get("zero_params") or {}
+        for name, ent in zparams.items():
+            key = "arg:%s" % name
+            if key in arrays:
+                arrays[key] = arrays[key].reshape(-1)[
+                    :int(ent["logical"])].reshape(
+                    [int(s) for s in ent["canonical_shape"]])
         arg_params, aux_params = {}, {}
         resolved_mesh, rule_shardings = self._restore_layout(
             mesh, sharding, arrays)
         for key, arr in arrays.items():
             tag, name = key.split(":", 1)
-            nd = self._reshard(key, arr,
-                               (manifest["params"].get(key) or {})
-                               .get("spec"),
+            spec = (manifest["params"].get(key) or {}).get("spec")
+            if tag == "arg" and name in zparams:
+                spec = None
+            nd = self._reshard(key, arr, spec,
                                resolved_mesh, rule_shardings.get(key))
             (arg_params if tag == "arg" else aux_params)[name] = nd
         symbol = None

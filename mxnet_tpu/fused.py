@@ -35,7 +35,7 @@ Extra TPU-first knobs the reference exposes differently:
   ``(K, batch, …)`` super-batch and ``lax.scan``s K donated updates in
   ONE device call, amortizing Python dispatch for small models (fed by
   ``io.DevicePrefetchIter(steps_per_call=K)``; see docs/performance.md).
-* ``zero='auto'|'on'|'off'`` (``MXNET_ZERO``) — ZeRO-style sharded
+* ``zero='auto'|'on'|'off'|'3'`` (``MXNET_ZERO``) — ZeRO-style sharded
   weight update (arXiv 2004.13336): gradients reduce-scatter over the
   data axis, optimizer state and the update live on the local 1/N flat
   tile, fresh params all-gather — ~1/N optimizer-state memory and
@@ -43,7 +43,14 @@ Extra TPU-first knobs the reference exposes differently:
   docs/performance.md).  ``auto`` engages on a ≥2-device data axis with
   replicated params; composes with the DDP grad overlap (the bucketed
   psum becomes a bucketed psum_scatter), ``steps_per_call``, health
-  guards, the dynamic loss scaler, and AOT ``compile()``.
+  guards, the dynamic loss scaler, and AOT ``compile()``.  ``'3'``
+  (ZeRO-3) additionally keeps the PARAMS at rest as those 1/N tiles:
+  forward gathers them layer-bucket by layer-bucket
+  (``MXNET_ZERO_GATHER_BUCKET_MB``), backward re-gathers via remat, the
+  update writes tiles, and the trailing full all-gather disappears —
+  per-replica param residency ~1/N, live full params O(max bucket).
+  Callers feed at-rest params from ``init_state`` or
+  ``pack_params(...)`` (Module does this itself).
 * ``health=StepHealth(...)`` — run-health sentinel: the step
   additionally returns a global gradient norm, an all-params non-finite
   flag, and (with a :class:`~mxnet_tpu.health.DynamicLossScaler`) the
@@ -108,6 +115,29 @@ def _resolve_remat(remat):
             raise MXNetError("unknown remat policy %r" % remat)
         return policy
     return remat  # a jax checkpoint policy callable
+
+
+_Z3_TAG = "zero3_gather"
+
+
+def _z3_tag(x):
+    """Name a gathered full parameter for the ZeRO-3 remat policy."""
+    try:
+        from jax.ad_checkpoint import checkpoint_name
+    except ImportError:  # ancient jax: no names, params stay residuals
+        return x
+    return checkpoint_name(x, _Z3_TAG)
+
+
+def _z3_remat_policy():
+    """Save every forward residual EXCEPT the tagged gathered params, so
+    backward re-issues the bucket all-gathers (deterministic, bit-exact)
+    instead of holding O(model) full params alive across the step."""
+    import jax
+
+    pol = getattr(jax.checkpoint_policies,
+                  "save_anything_except_these_names", None)
+    return pol(_Z3_TAG) if pol is not None else None
 
 
 class TrainStep:
@@ -216,15 +246,33 @@ class TrainStep:
         # ZeRO sharded update (arXiv 2004.13336): optimizer state and the
         # weight update tile 1/N over the data axis — gradients arrive
         # reduce-scattered, the update runs on the local flat tile, fresh
-        # params all-gather for the next forward
+        # params all-gather for the next forward.  Stage 3 keeps the
+        # params themselves at rest as those flat tiles and gathers them
+        # bucket-by-bucket on demand inside forward (re-gathered by the
+        # rematerialized backward), with no trailing full all-gather.
+        zmode = _zero.zero_mode(zero)
         zax = _zero.zero_axis(mesh, batch_sharding_axis, param_sharding,
-                              mode=zero, warn=warner.warn)
+                              mode=zmode, warn=warner.warn)
         self.zero_axis = zax
         zero_n = int(mesh.shape[zax]) if zax is not None else 0
         zero_min = _zero.min_param_bytes()
         self._zero_n = zero_n
         self._zero_min_bytes = zero_min
         self._frozen = frozen
+        self.zero3 = z3_mode = zax is not None and zmode == "3"
+        # the tiling layout, cached from CANONICAL shapes the first time
+        # it is computed (init_state / compile / pack_params): under
+        # ZeRO-3 the live params are flat tiles, so recomputing from
+        # them would mis-tile — every later caller reads the cache
+        self._zero_lay = None
+        z3_bucket = _zero.gather_bucket_bytes()
+        if z3_mode and ddp_ax is None and _overlap.overlap_mode() != "off":
+            warner.warn(
+                "zero3-gather",
+                "zero=3: the bucketed gather prefetch needs the explicit "
+                "DDP path (pure data-parallel mesh, MXNET_GRAD_OVERLAP); "
+                "params stay sharded at rest with GSPMD-scheduled "
+                "gathers instead")
         # set by Module when it drives this step, so the bounded sharded-
         # update dispatch can attach the kvstore's peer diagnosis
         self._kvstore = None
@@ -252,10 +300,41 @@ class TrainStep:
                     loss = loss * hstate["loss_scale"]
                 return loss, (outs, new_aux)
 
-            # ZeRO tiling decision, recomputed at trace time from shapes
-            # only, so it always agrees with init_state/_abstract_inputs
-            zlay = (_zero.layout(params, zero_n, zero_min, frozen)
-                    if zax is not None else None)
+            # ZeRO tiling decision: the canonical-shape layout, cached
+            # (under ZeRO-3 the traced params are flat at-rest tiles, so
+            # recomputing here from live shapes would mis-tile)
+            zlay = self.zero_layout(params) if zax is not None else None
+            z3 = z3_mode and zlay is not None
+            if z3:
+                # ZeRO-3 on-demand gather: layer buckets in FORWARD
+                # (graph-construction) order, one schedulable collective
+                # per bucket, issued back-to-back ahead of the compute
+                # that consumes them.  Each gathered full param is
+                # tagged; the remat policy below refuses to save tagged
+                # values as residuals, so backward re-issues the bucket
+                # gathers in reverse order as it needs them — live full
+                # params stay O(max bucket), not O(model).
+                z3_names = [p for p in self.param_names
+                            if zlay[p].sharded]
+                z3_sizes = {p: zlay[p].padded * zlay[p].dtype.itemsize
+                            for p in z3_names}
+                z3_buckets = (_overlap.bucket_partition(
+                    z3_names, z3_sizes, z3_bucket) if z3_names else [])
+                base_loss_fn = loss_fn
+
+                def z3_loss_fn(p, b, r):
+                    full = dict(p)
+                    for bucket in z3_buckets:
+                        gathered = _zero.gather_bucket(
+                            [p[q] for q in bucket],
+                            [zlay[q] for q in bucket], mesh, zax)
+                        for q, fp in zip(bucket, gathered):
+                            full[q] = _z3_tag(fp)
+                    return base_loss_fn(full, b, r)
+
+                policy = _z3_remat_policy()
+                loss_fn = (jax.checkpoint(z3_loss_fn, policy=policy)
+                           if policy is not None else z3_loss_fn)
             vag = None
             if ddp_ax is not None:
                 # None = this trace can't run the DDP path (indivisible
@@ -264,7 +343,8 @@ class TrainStep:
                     loss_fn, params, batch, rng, mesh, ddp_ax,
                     frozen=frozen, order=ddp_order,
                     bucket_bytes=ddp_bucket, warner=warner,
-                    zero_layout=zlay if ddp_ax == zax else None)
+                    zero_layout=zlay if ddp_ax == zax else None,
+                    zero_rest=z3)
             if vag is None:
                 vag = jax.value_and_grad(
                     lambda p: loss_fn(p, batch, rng),
@@ -274,13 +354,21 @@ class TrainStep:
                 # normalize: sharded grads still at full shape came from
                 # the GSPMD fallback (or a declined DDP trace) — the
                 # sharding constraint on the flat form IS the
-                # reduce-scatter (DDP-path grads arrive already flat)
+                # reduce-scatter (DDP-path grads arrive already flat).
+                # ZeRO-3 grads are born flat everywhere (the gather's
+                # transpose reduce-scatters); pin their tile layout so
+                # the GSPMD fallback lands them scattered, not summed
+                # full-size first.
                 grads = dict(grads)
                 for k, ent in zlay.items():
-                    if (ent.sharded and k in grads
-                            and tuple(grads[k].shape) == ent.shape):
+                    if not ent.sharded or k not in grads:
+                        continue
+                    if tuple(grads[k].shape) == ent.shape:
                         grads[k] = _zero.shard_flat(grads[k], ent, mesh,
                                                     zax)
+                    elif z3 and tuple(grads[k].shape) == (ent.padded,):
+                        grads[k] = jax.lax.with_sharding_constraint(
+                            grads[k], _zero._axis_sharding(mesh, zax))
             live = [k for k in sorted(grads) if k not in frozen]
             if scaler is not None:
                 inv = 1.0 / hstate["loss_scale"]
@@ -309,12 +397,18 @@ class TrainStep:
                         new_states[k] = states[k]
                         continue
                     if zlay is not None and zlay[k].sharded:
-                        new_params[k], new_states[k] = \
-                            opt_mod.sharded_fused_update(
-                                optimizer, params[k], g, states[k],
-                                lr * lr_mults[k], base_wd * wd_mults[k],
-                                t, jax.random.fold_in(rng, i + 1),
-                                mesh, zax, zlay[k])
+                        # stage 1 slices the replicated weight down to
+                        # its tile and gathers the fresh param back;
+                        # stage 3 runs on the at-rest tile directly and
+                        # returns it still tiled — the next forward's
+                        # bucket gather replaces the trailing all-gather
+                        driver = (opt_mod.sharded_fused_update_at_rest
+                                  if z3 else opt_mod.sharded_fused_update)
+                        new_params[k], new_states[k] = driver(
+                            optimizer, params[k], g, states[k],
+                            lr * lr_mults[k], base_wd * wd_mults[k],
+                            t, jax.random.fold_in(rng, i + 1),
+                            mesh, zax, zlay[k])
                         continue
                     new_params[k], new_states[k] = optimizer.fused_update(
                         params[k], g, states[k],
@@ -542,8 +636,10 @@ class TrainStep:
     def _build_zero_jit(self, params, states):
         """jit with the ZeRO state layout resolved: flat ``(padded,)``
         state leaves tile ``P(axis)`` over the data axis, scalars and
-        unsharded params' states replicate, params stay replicated (the
-        all-gather lives inside the program)."""
+        unsharded params' states replicate.  Stage 1 keeps the params
+        replicated (the all-gather lives inside the program); stage 3
+        pins the at-rest flat params ``P(axis)`` in AND out — fresh
+        tiles leave the step still sharded."""
         from .parallel import zero as _zero
         from .parallel.sharding import replicated
 
@@ -551,9 +647,16 @@ class TrainStep:
         sshard = {n: _zero.state_sharding(states[n], lay[n], self.mesh,
                                           self.zero_axis)
                   for n in states}
-        self._in_pshard = replicated(self.mesh)
+        pshard = None
+        if self.zero3:
+            tiled = _zero._axis_sharding(self.mesh, self.zero_axis)
+            repl = replicated(self.mesh)
+            pshard = {n: (tiled if lay[n].sharded else repl)
+                      for n in params}
+        self._in_pshard = (pshard if pshard is not None
+                           else replicated(self.mesh))
         self._in_sshard = sshard
-        return self._build_jit(None, sshard)
+        return self._build_jit(pshard, sshard)
 
     def _spans_processes(self):
         """True when the step's mesh holds devices this process cannot
@@ -572,27 +675,82 @@ class TrainStep:
     def zero_layout(self, params):
         """{name: ZeroParam} tiling decision for this step, or None when
         the sharded update is off/declined.  Deterministic in parameter
-        shapes/dtypes (works on ShapeDtypeStructs too)."""
+        shapes/dtypes (works on ShapeDtypeStructs too).  Cached on first
+        computation — which must see CANONICAL shapes (``init_state``,
+        ``compile``, ``pack_params`` all qualify), because under ZeRO-3
+        the live params are flat tiles the tiling cannot be derived
+        from."""
         if self.zero_axis is None:
             return None
+        if self._zero_lay is not None:
+            return self._zero_lay
         from .parallel import zero as _zero
 
-        return _zero.layout(params, self._zero_n, self._zero_min_bytes,
-                            self._frozen)
+        self._zero_lay = _zero.layout(params, self._zero_n,
+                                      self._zero_min_bytes, self._frozen)
+        return self._zero_lay
+
+    def pack_params(self, params):
+        """Canonical full params -> this step's at-rest layout: under
+        ZeRO-3 sharded entries become flat 1/N tiles placed ``P(axis)``
+        (bit-exact round trip — padding is zeros); identity otherwise.
+        Module calls this before the first fused step; direct ZeRO-3
+        callers must feed ``__call__`` packed params (``init_state``
+        already returns them packed)."""
+        lay = self.zero_layout(params)
+        if not self.zero3 or lay is None:
+            return params
+        from .parallel import zero as _zero
+
+        return _zero.pack_params(params, lay, self.mesh, self.zero_axis)
+
+    def unpack_params(self, params):
+        """At-rest params -> canonical host numpy dict (identity unless
+        ZeRO-3).  Requires the tiles to be addressable."""
+        lay = self._zero_lay
+        if not self.zero3 or lay is None:
+            return params
+        from .parallel import zero as _zero
+
+        return _zero.unpack_params(params, lay)
 
     def memory_report(self, params=None, states=None):
-        """Bench accounting: per-replica optimizer-state bytes (read from
-        the live state arrays' shardings — the ZeRO 1/N claim) and the
-        per-step fresh-param all-gather bytes, plus the AOT executable's
-        ``memory_analysis`` numbers when compiled."""
+        """Bench accounting, labeled per column: ``opt_state_bytes`` and
+        ``params_bytes_per_replica`` are what ONE replica holds at rest
+        (read from the live arrays' shardings — full-model params under
+        zero=off/stage-1, ~1/N tiles under ZeRO-3), and their sum is
+        ``total_state_bytes_per_replica`` — params included, so the
+        stage-1-vs-3 A/B compares like with like.
+        ``update_gather_bytes`` is the stage-1 trailing fresh-param
+        all-gather (0 under ZeRO-3 — there is none);
+        ``gather_bytes_per_step`` is the per-step param-gather traffic
+        whichever stage moves it (stage 1: the trailing gather; ZeRO-3:
+        forward bucket gathers + the backward re-gather).  AOT
+        ``memory_analysis`` numbers ride along when compiled."""
         from .parallel import zero as _zero
 
-        out = {"zero": self.zero_axis is not None}
+        out = {"zero": self.zero_axis is not None, "zero3": self.zero3}
         if states is not None:
             out["opt_state_bytes"] = _zero.state_bytes_per_replica(states)
-        lay = self.zero_layout(params) if params is not None else None
-        out["update_gather_bytes"] = (
-            _zero.update_gather_bytes(lay) if lay is not None else 0)
+        if params is not None:
+            out["params_bytes_per_replica"] = \
+                _zero.params_bytes_per_replica(params)
+            if states is not None:
+                out["total_state_bytes_per_replica"] = (
+                    out["opt_state_bytes"]
+                    + out["params_bytes_per_replica"])
+        lay = self._zero_lay
+        if lay is None and params is not None:
+            lay = self.zero_layout(params)
+        if lay is None:
+            out["update_gather_bytes"] = 0
+            out["gather_bytes_per_step"] = 0
+        elif self.zero3:
+            out["update_gather_bytes"] = 0
+            out["gather_bytes_per_step"] = _zero.zero3_gather_bytes(lay)
+        else:
+            out["update_gather_bytes"] = _zero.update_gather_bytes(lay)
+            out["gather_bytes_per_step"] = out["update_gather_bytes"]
         if self._aot is not None:
             try:
                 mem = self._aot.memory_analysis()
@@ -630,6 +788,11 @@ class TrainStep:
                 # ZeRO layout: every weight-shaped leaf is born flat
                 w = S((lay[n].padded,), jnp.dtype(dtype))
             states[n] = jax.eval_shape(self.optimizer.init_fused_state, w)
+        if self.zero3 and lay is not None:
+            # ZeRO-3: the step's param arguments are the at-rest tiles
+            params = {n: (S((lay[n].padded,), jnp.dtype(dtype))
+                          if lay[n].sharded else params[n])
+                      for n in params}
         K = self._steps_per_call
         batch = {}
         for n in self.data_names + self.label_names:
@@ -796,15 +959,25 @@ class TrainStep:
 
             def dispatch_zero():
                 # host-side boundaries of the in-program collectives:
-                # before dispatch = the gradient reduce-scatter, after
-                # the result = the fresh-param all-gather
+                # before dispatch = the gradient reduce-scatter (and,
+                # under ZeRO-3, the forward bucket all-gathers), after
+                # the result = the stage-1 fresh-param all-gather
                 faults.inject("zero_update")
+                if self.zero3:
+                    faults.inject("zero_gather")
                 res = dispatch()
                 faults.inject("zero_update")
                 return res
 
+            what = None
+            active = None
+            if self.zero3 and faults.active("zero_gather"):
+                active = True
+                what = ("ZeRO-3 bucketed parameter all-gather (forward "
+                        "bucket gathers + backward re-gather)")
             out = _zero.bounded_dispatch(dispatch_zero,
-                                         kvstore=self._kvstore)
+                                         kvstore=self._kvstore,
+                                         active=active, what=what)
         else:
             out = dispatch()
         if self._health is None:
@@ -868,6 +1041,11 @@ class TrainStep:
             shp = all_shapes[n]
             aux[n] = jnp.ones(shp, "float32") if n.endswith("_var") \
                 else jnp.zeros(shp, "float32")
+        if lay is not None and self.zero3:
+            # ZeRO-3: hand back the params already at rest (flat 1/N
+            # tiles), matching what __call__ expects and returns
+            params = _zero.pack_params(params, lay, self.mesh,
+                                       self.zero_axis)
         return params, aux, states
 
 

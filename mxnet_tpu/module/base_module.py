@@ -252,9 +252,12 @@ class BaseModule:
           (``MXNET_STEP_TIMEOUT_S``): a step making no progress for this
           long dumps all-thread stacks + health stats to an artifact and
           raises :class:`~mxnet_tpu.base.StepHung` instead of hanging.
-        * ``zero`` — 'auto' | 'on' | 'off': ZeRO-style sharding of the
-          optimizer state and the weight update over the mesh's data
-          axis (``MXNET_ZERO``; see ``docs/performance.md``).
+        * ``zero`` — 'auto' | 'on' | 'off' | '3': ZeRO-style sharding of
+          the optimizer state and the weight update over the mesh's
+          data axis; '3' additionally keeps the parameters themselves
+          at rest as flat 1/N tiles, re-gathered bucket by bucket
+          inside each step (``MXNET_ZERO``; see
+          ``docs/performance.md``).
         """
         from ..base import get_env
         from ..initializer import Uniform

@@ -193,6 +193,35 @@ class Module(BaseModule):
                 fused._packed_states = None
                 fused._packed_aux = None
                 self._pipeline_stale = False
+        # same stance for ZeRO-3 at-rest tiles: external writes to
+        # arg_dict win; the next step repacks from the canonical dict
+        if getattr(self, "_zero3_params", None) is not None:
+            self._zero3_params = None
+            self._zero3_stale = False
+
+    def _sync_zero3(self):
+        """Unpack ZeRO-3 at-rest parameter tiles back into the executor
+        arg_dict (lazy sync point, mirroring ``_sync_pipeline``)."""
+        if not getattr(self, "_zero3_stale", False):
+            return
+        import jax.numpy as jnp
+
+        live = self._fused.unpack_params(self._zero3_params)
+        for n, v in live.items():
+            self._exec.arg_dict[n]._set_data(jnp.asarray(v))
+        self._zero3_stale = False
+
+    def _export_zero_params(self):
+        """Flat ZeRO-3 parameter tiles for elastic checkpointing, or
+        ``None`` when params are not sharded at rest."""
+        fused = getattr(self, "_fused", None)
+        if fused is None or not getattr(fused, "zero3", False):
+            return None
+        if getattr(self, "_zero3_params", None) is None:
+            return None
+        from ..parallel import zero as _zero_mod
+
+        return _zero_mod.export_params(self._zero3_params, fused._zero_lay)
 
     def _sync_pipeline(self):
         """Gather live packed pipeline params/states back into the
@@ -212,6 +241,7 @@ class Module(BaseModule):
     def get_params(self):
         assert self.binded and self.params_initialized
         self._sync_pipeline()
+        self._sync_zero3()
         arg_params = {n: self._exec.arg_dict[n].copy()
                       for n in self._param_names}
         aux_params = {n: self._exec.aux_dict[n].copy()
@@ -396,10 +426,10 @@ class Module(BaseModule):
                     "loss_scale was requested but the fused step is "
                     "unavailable: %s" % (reason,))
             # an explicit ZeRO request only exists inside the fused step
-            if getattr(self, "_zero", None) == "on":
+            if getattr(self, "_zero", None) in ("on", "3"):
                 raise MXNetError(
-                    "zero=on was requested but the fused step is "
-                    "unavailable: %s" % (reason,))
+                    "zero=%s was requested but the fused step is "
+                    "unavailable: %s" % (self._zero, reason))
 
         if self._pipeline_stages > 1:
             if getattr(self, "_steps_per_call", 1) > 1:
@@ -529,10 +559,10 @@ class Module(BaseModule):
                     "param_sharding=%r was requested but the fused step "
                     "could not be built: %s"
                     % (self._param_sharding, e)) from e
-            if getattr(self, "_zero", None) == "on":
+            if getattr(self, "_zero", None) in ("on", "3"):
                 raise MXNetError(
-                    "zero=on was requested but the fused step could not "
-                    "be built: %s" % (e,)) from e
+                    "zero=%s was requested but the fused step could not "
+                    "be built: %s" % (self._zero, e)) from e
             self.logger.debug("fused step unavailable: %s", e)
             self._fused = None
         if self._fused is None and self._mesh is not None and \
@@ -638,7 +668,21 @@ class Module(BaseModule):
         from ..ndarray import NDArray
 
         o = self._optimizer
-        params = {n: self._exec.arg_dict[n]._data for n in self._param_names}
+        z3 = getattr(self._fused, "zero3", False)
+        if z3 and getattr(self, "_zero3_params", None) is not None:
+            # ZeRO-3 steady state: params live step-side as flat 1/N
+            # tiles; arg_dict is synced lazily (_sync_zero3) on read
+            params = self._zero3_params
+        else:
+            params = {n: self._exec.arg_dict[n]._data
+                      for n in self._param_names}
+            if z3:
+                # first step (or after an external arg_dict write): tile
+                # the canonical params into the at-rest layout — this is
+                # also the canonical-shape seeding point for the cached
+                # zero layout
+                params = self._fused.pack_params(params)
+                self._zero3_params = params
         aux = {n: self._exec.aux_dict[n]._data for n in self._aux_names}
         if self._fused_states is None:
             self._fused_states = self._init_fused_states()
@@ -703,6 +747,13 @@ class Module(BaseModule):
             # the step; arg_dict is synced lazily (_sync_pipeline) when
             # something reads it (eval forward, get_params, checkpoint)
             self._pipeline_stale = True
+        elif z3:
+            # at-rest tiles stay step-side; aux (batchnorm stats) are
+            # canonical-shaped and land in aux_dict as usual
+            self._zero3_params = new_params
+            self._zero3_stale = True
+            for n, v in new_aux.items():
+                self._exec.aux_dict[n]._set_data(v)
         else:
             for n, v in new_params.items():
                 self._exec.arg_dict[n]._set_data(v)
@@ -715,6 +766,7 @@ class Module(BaseModule):
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self._sync_pipeline()
+        self._sync_zero3()
         if is_train is None:
             is_train = self.for_training
         inputs = {}

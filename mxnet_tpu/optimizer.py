@@ -219,6 +219,29 @@ def sharded_fused_update(optimizer, weight, flat_grad, state, lr, wd, t,
     return _zero.gather_param(new_flat, entry, mesh), new_state
 
 
+def sharded_fused_update_at_rest(optimizer, flat_weight, flat_grad, state,
+                                 lr, wd, t, rng, mesh, axis, entry):
+    """ZeRO-3 sharded-update driver for one parameter.
+
+    Like :func:`sharded_fused_update` but the weight is ALREADY the flat
+    ``(entry.padded,)`` at-rest tile and stays that way: no slice going
+    in, no trailing all-gather coming out — the next step's forward
+    gathers on demand.  Same elementwise tile math, so bit-identical to
+    both the replicated and the stage-1 update."""
+    import jax
+
+    from .parallel import zero as _zero
+
+    shard = _zero._axis_sharding(mesh, axis)
+    wflat = jax.lax.with_sharding_constraint(flat_weight, shard)
+    new_flat, new_state = optimizer.fused_update(
+        wflat, flat_grad, state, lr, wd, t, rng)
+    new_state = jax.tree.map(
+        jax.lax.with_sharding_constraint, new_state,
+        _zero.state_sharding(new_state, entry, mesh, axis))
+    return jax.lax.with_sharding_constraint(new_flat, shard), new_state
+
+
 def _tree_jax_to_nd(x, ctx):
     if x is None:
         return None

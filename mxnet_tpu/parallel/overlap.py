@@ -224,7 +224,7 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 
 def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
                        frozen=frozenset(), order=None, bucket_bytes=None,
-                       warner=None, zero_layout=None):
+                       warner=None, zero_layout=None, zero_rest=False):
     """Explicit data-parallel ``value_and_grad`` with bucketed reduction.
 
     ``loss_fn(p, b, r) -> (loss, (outs, new_aux))`` must compute the
@@ -242,6 +242,15 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
     tuple ``psum`` — as flat ``(padded,)`` arrays tiled ``P(axis)``;
     unsharded members keep the full psum.  Same overlap schedule, 1/N
     of the reduction's receive bytes.
+
+    ``zero_rest`` (ZeRO-3): the sharded members of ``params`` are
+    ALREADY the flat at-rest tiles (in_spec ``P(axis)``), ``loss_fn``
+    gathers them on demand, and AD's transpose of that
+    ``all_gather(tiled=True)`` is itself the ``psum_scatter`` — their
+    gradients arrive pre-reduce-scattered exactly where backward
+    produces them, so they are EXCLUDED from the bucketed reduction
+    (summing them again would double-count).  Only the unsharded
+    leftovers ride the psum buckets.
     """
     import math
 
@@ -288,15 +297,17 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
 
     if bucket_bytes is None:
         bucket_bytes = grad_bucket_bytes()
-    live = [k for k in (order if order is not None else sorted(g_grads))
-            if k in g_grads and k not in frozen]
-    sizes = {k: math.prod(g_grads[k].shape) * g_grads[k].dtype.itemsize
-             for k in live}
-    buckets = bucket_partition(live, sizes, bucket_bytes)
 
     def _is_scattered(k):
         return (zero_layout is not None and k in zero_layout
                 and zero_layout[k].sharded)
+
+    live = [k for k in (order if order is not None else sorted(g_grads))
+            if k in g_grads and k not in frozen
+            and not (zero_rest and _is_scattered(k))]
+    sizes = {k: math.prod(g_grads[k].shape) * g_grads[k].dtype.itemsize
+             for k in live}
+    buckets = bucket_partition(live, sizes, bucket_bytes) if live else []
 
     def local_step(p, b, r):
         from . import zero as _zero
@@ -332,9 +343,13 @@ def ddp_value_and_grad(loss_fn, params, batch, rng, mesh, axis,
     bspec = {k: P(axis) for k in batch}
     gspec = {k: (P(axis) if _is_scattered(k) else P())
              for k in g_grads}
+    # ZeRO-3 at-rest tiles enter sharded P(axis); everything else
+    # (full params, zero-1 replicated weights) enters replicated
+    pspec = ({k: (P(axis) if _is_scattered(k) else P()) for k in params}
+             if zero_rest else P())
     spec_tree = ((P(), (outs_spec, jax.tree.map(lambda _: P(), g_aux))),
                  gspec)
-    fn = _shard_map(local_step, mesh, (P(), bspec, P()), spec_tree)
+    fn = _shard_map(local_step, mesh, (pspec, bspec, P()), spec_tree)
     # trace the local step under the DDP context so batch-global ops
     # (SoftmaxOutput normalization, BatchNorm training stats) widen
     # their reductions to the global batch

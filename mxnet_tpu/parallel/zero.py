@@ -36,6 +36,22 @@ has >= 2 devices and replicated parameters.  Model-parallel or fsdp
 parameter sharding declines (those layouts already shard state), as do
 parameters smaller than ``MXNET_ZERO_MIN_PARAM_BYTES`` (the all-gather
 latency is not worth 1/N of a tiny buffer).
+
+``MXNET_ZERO=3`` extends the stance to the parameters themselves
+(ZeRO-3): sharded params live *at rest* as the same flat 1/N tiles the
+optimizer state already uses, and the fused step gathers them back
+layer-bucket by layer-bucket (``MXNET_ZERO_GATHER_BUCKET_MB``, the
+:func:`~mxnet_tpu.parallel.overlap.bucket_partition` grouping in
+forward order) just ahead of the compute that consumes them.  The
+gathered copies are tagged for rematerialization, so backward re-issues
+the bucket gathers in reverse order instead of keeping every full
+parameter alive as a residual — live full-param memory is O(max
+bucket), not O(model) — and the update runs directly on the tiles with
+no trailing full all-gather (the next step gathers on demand).  The
+gather is ``lax.all_gather(tiled=True)`` on the explicit-DDP path
+(whose transpose IS the reduce-scatter, landing each grad already
+tiled) and a sharding constraint under GSPMD; both are bit-exact vs the
+replicated step for the same reason the stage-1 tiling is.
 """
 from __future__ import annotations
 
@@ -44,27 +60,33 @@ import math
 from ..base import MXNetError, get_env
 
 __all__ = ["zero_mode", "min_param_bytes", "zero_axis", "ZeroParam",
-           "layout", "put", "shard_flat", "gather_param", "init_state",
+           "layout", "put", "shard_flat", "gather_param", "gather_bucket",
+           "init_state", "pack_params", "unpack_param", "unpack_params",
            "shard_state", "unshard_state", "state_structure",
            "state_leaves", "state_unflatten", "export_states",
-           "bounded_dispatch", "state_bytes_per_replica",
-           "update_gather_bytes"]
+           "export_params", "bounded_dispatch", "state_bytes_per_replica",
+           "params_bytes_per_replica", "update_gather_bytes",
+           "zero3_gather_bytes", "gather_bucket_bytes"]
 
 DEFAULT_MIN_PARAM_BYTES = 1024
+DEFAULT_GATHER_BUCKET_MB = 4.0
 
 
 def zero_mode(mode=None):
     """Resolve the sharded-update mode: an explicit ``mode`` wins, else
-    ``MXNET_ZERO`` (default ``auto``)."""
+    ``MXNET_ZERO`` (default ``auto``).  ``"3"`` selects ZeRO-3 (params
+    sharded at rest on top of the stage-1 sharded update)."""
     raw = mode if mode is not None else get_env("MXNET_ZERO", "auto", str)
     raw = str(raw).strip().lower() or "auto"
     if raw in ("0", "off", "false", "no"):
         return "off"
     if raw in ("1", "on", "true", "yes"):
         return "on"
+    if raw in ("3", "zero3", "z3"):
+        return "3"
     if raw == "auto":
         return "auto"
-    raise MXNetError("MXNET_ZERO/zero must be auto|on|off (got %r)"
+    raise MXNetError("MXNET_ZERO/zero must be auto|on|off|3 (got %r)"
                      % (mode,))
 
 
@@ -76,6 +98,19 @@ def min_param_bytes():
 
 
 min_param_bytes.__doc__ %= DEFAULT_MIN_PARAM_BYTES
+
+
+def gather_bucket_bytes():
+    """``MXNET_ZERO_GATHER_BUCKET_MB``: target bytes per ZeRO-3 forward
+    param-gather bucket (default %s MB).  Smaller buckets start the
+    first layer's compute sooner and cap live gathered-param memory;
+    larger ones amortize collective launch overhead."""
+    mb = get_env("MXNET_ZERO_GATHER_BUCKET_MB", DEFAULT_GATHER_BUCKET_MB,
+                 float)
+    return max(1, int(mb * 1024 * 1024))
+
+
+gather_bucket_bytes.__doc__ %= DEFAULT_GATHER_BUCKET_MB
 
 
 def zero_axis(mesh, batch_axis, param_sharding=None, mode=None,
@@ -90,26 +125,26 @@ def zero_axis(mesh, batch_axis, param_sharding=None, mode=None,
         return None
 
     def _decline(key, msg):
-        if mode == "on" and warn is not None:
+        if mode in ("on", "3") and warn is not None:
             warn(key, msg)
         return None
 
     if param_sharding not in (None, "replicated"):
         return _decline(
             "zero-params",
-            "MXNET_ZERO=on but param_sharding=%r already shards the "
+            "MXNET_ZERO=%s but param_sharding=%r already shards the "
             "parameters (fsdp/tp carry their own state layout); using "
-            "the replicated update" % (param_sharding,))
+            "the replicated update" % (mode, param_sharding))
     if mesh is None or batch_axis not in getattr(mesh, "shape", {}):
         return _decline(
             "zero-mesh",
-            "MXNET_ZERO=on but there is no mesh with a %r axis; using "
-            "the replicated update" % (batch_axis,))
+            "MXNET_ZERO=%s but there is no mesh with a %r axis; using "
+            "the replicated update" % (mode, batch_axis))
     if int(mesh.shape[batch_axis]) < 2:
         return _decline(
             "zero-axis",
-            "MXNET_ZERO=on but mesh axis %r has a single device — "
-            "nothing to shard the update over" % (batch_axis,))
+            "MXNET_ZERO=%s but mesh axis %r has a single device — "
+            "nothing to shard the update over" % (mode, batch_axis))
     return batch_axis
 
 
@@ -220,6 +255,68 @@ def gather_param(flat, entry, mesh):
 
     full = jax.lax.with_sharding_constraint(flat, _replicated(mesh))
     return jnp.reshape(full[:entry.logical], entry.shape)
+
+
+def gather_bucket(flats, entries, mesh, axis):
+    """ZeRO-3 on-demand gather of one layer bucket: flat 1/N tiles back
+    to full parameter shapes.  Context-aware: inside the explicit-DDP
+    ``shard_map`` trace the tiles are LOCAL values and the gather is one
+    tuple ``lax.all_gather(tiled=True)`` per bucket (a single
+    schedulable collective whose transpose is the grad reduce-scatter);
+    under GSPMD it is a replication constraint per tensor and XLA
+    places/combines the gathers itself."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import overlap as _overlap
+
+    ctx = _overlap._ddp_ctx
+    if ctx is not None:
+        from jax import lax
+
+        fulls = lax.all_gather(tuple(flats), ctx[0], axis=0, tiled=True)
+    else:
+        repl = _replicated(mesh)
+        fulls = tuple(jax.lax.with_sharding_constraint(f, repl)
+                      for f in flats)
+    return [jnp.reshape(f[:e.logical], e.shape)
+            for f, e in zip(fulls, entries)]
+
+
+def pack_params(params, lay, mesh, axis):
+    """Canonical full params -> the ZeRO-3 at-rest layout: sharded
+    entries become flat ``(padded,)`` tiles placed ``P(axis)``,
+    unsharded ones pass through.  Zero padding makes the round trip
+    content-preserving, so packing a restored/initialized full param is
+    bit-exact."""
+    import jax.numpy as jnp
+
+    shard = _axis_sharding(mesh, axis)
+    out = {}
+    for name, v in params.items():
+        ent = lay[name]
+        if ent.sharded and tuple(getattr(v, "shape", ())) != (ent.padded,):
+            out[name] = put(flat_pad(jnp.asarray(v), ent), shard)
+        else:
+            out[name] = v
+    return out
+
+
+def unpack_param(flat, entry):
+    """One at-rest value -> canonical host numpy (trim the padding
+    lanes, restore the shape).  Requires addressability, like
+    :func:`unshard_state`."""
+    import numpy as np
+
+    arr = np.asarray(flat)
+    if entry.sharded and arr.shape == (entry.padded,):
+        return arr[:entry.logical].reshape(entry.shape)
+    return arr
+
+
+def unpack_params(params, lay):
+    """At-rest params dict -> canonical host numpy dict."""
+    return {name: unpack_param(v, lay[name]) for name, v in params.items()}
 
 
 def state_sharding(states_tree, entry, mesh, axis):
@@ -374,6 +471,27 @@ def export_states(states, lay):
     return out
 
 
+def export_params(params, lay):
+    """Checkpoint export descriptor for a ZeRO-3 at-rest params dict:
+    per parameter the raw at-rest value (flat sharded tiles stay sharded
+    — the v2 writer pieces them by addressable window) plus the
+    unpadding metadata the restore needs to trim back to the canonical
+    shape.  Restoring trims to ``logical`` and reshapes, so an N-way
+    save restores at M-way or unsharded (``zero=off``) bit-exactly."""
+    out = {}
+    for name, v in params.items():
+        ent = lay[name]
+        flat = ent.sharded and tuple(getattr(v, "shape", ())) == (
+            ent.padded,)
+        out[name] = {
+            "leaf": v,
+            "flat": bool(flat),
+            "logical": ent.logical,
+            "canonical_shape": list(ent.shape),
+        }
+    return out
+
+
 # -- accounting ------------------------------------------------------------
 
 def state_bytes_per_replica(states, ndev=None):
@@ -394,31 +512,51 @@ def state_bytes_per_replica(states, ndev=None):
     return total
 
 
+def params_bytes_per_replica(params):
+    """Parameter bytes ONE replica holds at rest, read from the live
+    arrays' shardings — full model bytes when replicated (``zero=off``
+    and stage-1), ~1/N under ZeRO-3 flat tiles.  Same accounting as
+    :func:`state_bytes_per_replica`."""
+    return state_bytes_per_replica(params)
+
+
 def update_gather_bytes(lay):
-    """Bytes of fresh parameters the all-gather moves per step (the
-    padded flat size of every sharded parameter)."""
+    """Bytes of fresh parameters the trailing all-gather moves per step
+    under the stage-1 update (the padded flat size of every sharded
+    parameter).  Zero under ZeRO-3 — there is no trailing gather; see
+    :func:`zero3_gather_bytes`."""
     return sum(e.padded * e.dtype.itemsize
                for e in lay.values() if e.sharded)
 
 
+def zero3_gather_bytes(lay):
+    """Bytes the ZeRO-3 bucketed gathers move per step: every sharded
+    parameter is gathered once for forward and re-gathered once by the
+    rematerialized backward."""
+    return 2 * update_gather_bytes(lay)
+
+
 # -- fault/bounded dispatch ------------------------------------------------
 
-def bounded_dispatch(call, kvstore=None, active=None):
+def bounded_dispatch(call, kvstore=None, active=None, what=None):
     """Run one sharded-update step under the kvstore's wall-clock bound.
 
-    The reduce-scatter and the param all-gather are collectives: one
+    The reduce-scatter and the param all-gathers are collectives: one
     wedged peer stalls every healthy replica inside the device call
-    forever.  When the ``zero_update`` fault site is armed, or the run
-    is genuinely multi-process, the step dispatch runs through
-    :func:`~mxnet_tpu.kvstore._run_bounded` with the PR 3 peer report as
-    the diagnosis — the same treatment the kvstore barrier gets.  The
-    single-process clean path stays a direct call (no watchdog thread
-    per step)."""
+    forever.  When the ``zero_update`` / ``zero_gather`` fault sites are
+    armed, or the run is genuinely multi-process, the step dispatch runs
+    through :func:`~mxnet_tpu.kvstore._run_bounded` with the PR 3 peer
+    report as the diagnosis — the same treatment the kvstore barrier
+    gets.  ``what`` names the bounded operation in the timeout error
+    (default: the stage-1 update description).  The single-process clean
+    path stays a direct call (no watchdog thread per step)."""
     from ..testing import faults
 
     if active is None:
-        active = faults.active("zero_update") or (
-            kvstore is not None and getattr(kvstore, "_is_dist", False))
+        active = (faults.active("zero_update")
+                  or faults.active("zero_gather")
+                  or (kvstore is not None
+                      and getattr(kvstore, "_is_dist", False)))
     if not active:
         return call()
     from ..kvstore import _run_bounded
@@ -432,5 +570,5 @@ def bounded_dispatch(call, kvstore=None, active=None):
 
             return peer_report(jax.process_count())
     return _run_bounded(
-        call, "ZeRO sharded update (gradient reduce-scatter + parameter "
-        "all-gather)", diagnose=diagnose)
+        call, what or "ZeRO sharded update (gradient reduce-scatter + "
+        "parameter all-gather)", diagnose=diagnose)

@@ -61,7 +61,11 @@ fused dispatch — the gradient reduce-scatter going in and the
 parameter all-gather coming out; arming it also bounds the dispatch,
 so ``delay`` past ``MXNET_KV_TIMEOUT_S`` surfaces the collective
 timeout with the kvstore's peer report attached even single-process),
-``serve_queue`` (the serving scheduler —
+``zero_gather`` (same contract for the ZeRO-3 step: around the
+bucketed parameter all-gathers — the per-bucket forward gathers and
+the backward re-gathers all run inside the one bounded dispatch, so a
+``delay`` past ``MXNET_KV_TIMEOUT_S`` reports the gather as the stuck
+collective by name), ``serve_queue`` (the serving scheduler —
 crossed at *every* request boundary) plus its phase-specific companions
 ``serve_admit`` / ``serve_decode`` / ``serve_respond`` (admission,
 per-request decode-step, and response boundaries; a fault fails that

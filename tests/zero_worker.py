@@ -19,6 +19,13 @@ plumbing differs.
   every rank of a pod) and write the reassembled canonical optimizer
   state + ``num_update`` to ``loaded_rank<r>.npz``: what any resume
   would seed from, bit-comparable against the oracle.
+* ``train3`` / ``dump3`` — the same protocol under ``zero='3'``: the
+  save carries the at-rest flat 1/N parameter tiles through
+  ``zero_params=`` (each rank writes only the windows it owns — no
+  rank ever materializes the full params), and the load reassembles
+  them back to canonical shapes.  The single-process ``train3`` also
+  dumps the canonical params oracle (``canonical3_rank0.npz``), which
+  any topology's ``dump3`` must match bit for bit.
 
 The fused step is driven directly (not through ``Module.fit``): the
 module path hands multi-process sync training to the kvstore's split
@@ -53,13 +60,13 @@ def _sym():
                                 normalization="batch")
 
 
-def _step(mesh):
+def _step(mesh, zero="on"):
     from mxnet_tpu.fused import TrainStep
 
     return TrainStep(_sym(), optimizer="adam",
                      optimizer_params={"learning_rate": 0.125,
                                        "rescale_grad": 1.0 / BATCH},
-                     mesh=mesh, batch_sharding_axis="data", zero="on")
+                     mesh=mesh, batch_sharding_axis="data", zero=zero)
 
 
 def _flatten_states(states):
@@ -109,11 +116,15 @@ def main():
     ckpt_dir = os.path.join(workdir, "ckpt")
     mgr = ckpt.CheckpointManager(ckpt_dir, prefix="z")
 
-    if mode == "train":
+    if mode in ("train", "train3"):
+        z3 = mode == "train3"
         os.environ["MXNET_ZERO_MIN_PARAM_BYTES"] = "0"
+        if z3:
+            os.environ["MXNET_ZERO_GATHER_BUCKET_MB"] = "0.0001"
         mesh = create_mesh({"data": 2})
-        step = _step(mesh)
+        step = _step(mesh, zero="3" if z3 else "on")
         assert step.zero_axis == "data", step.zero_axis
+        assert step.zero3 == z3
         shapes = {"data": (BATCH, FEAT), "softmax_label": (BATCH,)}
         params, aux, states = step.init_state(shapes)
         rs = np.random.RandomState(42)
@@ -132,20 +143,33 @@ def main():
                          if s.replica_id == 0]
                 assert owned, "rank %d owns no window of %s" % (rank,
                                                                 name)
-        mgr.save(epoch=1, nbatch=STEPS, symbol=step.symbol,
-                 arg_params={n: np.asarray(
-                     p.addressable_data(0)) for n, p in params.items()},
-                 zero_states=zero.export_states(states, lay),
-                 num_update=STEPS)
+        if z3:
+            # ZeRO-3: no rank holds the full params — each writes only
+            # its at-rest 1/N tile windows through zero_params
+            mgr.save(epoch=1, nbatch=STEPS, symbol=step.symbol,
+                     arg_params={},
+                     zero_states=zero.export_states(states, lay),
+                     zero_params=zero.export_params(params, lay),
+                     num_update=STEPS)
+        else:
+            mgr.save(epoch=1, nbatch=STEPS, symbol=step.symbol,
+                     arg_params={n: np.asarray(
+                         p.addressable_data(0))
+                         for n, p in params.items()},
+                     zero_states=zero.export_states(states, lay),
+                     num_update=STEPS)
         if not DIST:
             canon = {n: zero.unshard_state(st, lay[n])
                      for n, st in states.items()}
             np.savez(os.path.join(workdir, "canonical_rank0.npz"),
                      num_update=np.int64(STEPS), **_flatten_states(canon))
-        print("WORKER %d DONE train" % rank)
+            if z3:
+                np.savez(os.path.join(workdir, "canonical3_rank0.npz"),
+                         **zero.unpack_params(params, lay))
+        print("WORKER %d DONE %s" % (rank, mode))
         return
 
-    if mode == "dump":
+    if mode in ("dump", "dump3"):
         state = mgr.load()
         assert state.opt_states is not None, \
             "checkpoint carried no ZeRO optimizer state"
@@ -154,7 +178,13 @@ def main():
         np.savez(os.path.join(workdir, "loaded_rank%d.npz" % rank),
                  num_update=np.int64(state.num_update),
                  **_flatten_states(state.opt_states))
-        print("WORKER %d DONE dump" % rank)
+        if mode == "dump3":
+            assert state.manifest.get("zero_params"), \
+                "manifest carried no ZeRO-3 at-rest param tiles"
+            np.savez(os.path.join(workdir, "loaded3_rank%d.npz" % rank),
+                     **{n: np.asarray(a.asnumpy())
+                        for n, a in state.arg_params.items()})
+        print("WORKER %d DONE %s" % (rank, mode))
         return
 
     raise SystemExit("unknown mode %r" % mode)

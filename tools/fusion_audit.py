@@ -83,6 +83,56 @@ def _kind_summary(payload):
     return kinds
 
 
+def expect_async(payload, path):
+    """Overlap-capability probe.  On a backend that lowers collectives
+    to ``-start``/``-done`` pairs (TPU/GPU), every collective that is
+    NOT such a pair serializes the stream and is reported as a named
+    offender.  CPU XLA lowers every collective synchronously, so there
+    the probe falls back to a structural check: a ``zero_stage == 3``
+    dump with more than one gather bucket must not contain a monolithic
+    all-gather moving the whole sharded-parameter footprint at once —
+    that is the step-ending full gather the bucketed schedule exists to
+    eliminate.  Returns True on pass."""
+    colls = payload.get("collectives") or []
+    if not colls:
+        print("EXPECT-ASYNC %s: PASS (no collectives in the entry "
+              "computation)" % path)
+        return True
+    has_async = any(c["op"].endswith("-start") for c in colls)
+    offenders = []
+    if has_async:
+        note = ("async-capable backend (-start/-done pairs present); "
+                "sync collectives are offenders")
+        for c in colls:
+            if c["op"].endswith(("-start", "-done")):
+                continue
+            offenders.append("%s (%s, %s)"
+                             % (c["name"], c["op"],
+                                _fmt_bytes(c["bytes"])))
+    else:
+        note = ("backend emits synchronous collectives only (CPU-style "
+                "lowering); structural check on the gather schedule")
+        total = int(payload.get("zero_sharded_bytes") or 0)
+        buckets = int(payload.get("zero_gather_buckets") or 0)
+        if payload.get("zero_stage") == 3 and buckets > 1 and total:
+            for c in colls:
+                if _collective_kind(c["op"]) != "all-gather":
+                    continue
+                if int(c.get("bytes") or 0) >= total:
+                    offenders.append(
+                        "%s (%s, %s >= %s sharded footprint: "
+                        "monolithic full-parameter gather)"
+                        % (c["name"], c["op"], _fmt_bytes(c["bytes"]),
+                           _fmt_bytes(total)))
+    if offenders:
+        print("EXPECT-ASYNC %s: FAIL (%s)" % (path, note))
+        for o in offenders:
+            print("    offender: %s" % o)
+        return False
+    print("EXPECT-ASYNC %s: PASS (%s)" % (path, note))
+    return True
+
+
 def _shape_bytes(dtype, dims):
     n = _BYTES.get(dtype, 4)
     for d in dims.split(","):
@@ -137,13 +187,16 @@ def _fmt_bytes(n):
 
 
 def dump(out_path, model="transformer", batch=None, seq=None,
-         attn_impl=None, mesh=None, zero=None):
+         attn_impl=None, mesh=None, zero=None, check_async=False):
     """Compile one fused train step AOT and write the audit artifact.
 
     ``mesh=N`` compiles over an N-way data mesh so the gradient
     collectives exist at all; dump once with ``--zero off`` and once
     with ``--zero on`` and ``--diff`` the two to see the step's
-    all-reduce turn into a reduce-scatter + all-gather pair."""
+    all-reduce turn into a reduce-scatter + all-gather pair.  A
+    ``--zero 3`` dump against a ``--zero on`` one shows the trailing
+    full-parameter all-gather replaced by the in-step bucket
+    gathers."""
     if attn_impl:
         os.environ["MXNET_ATTN_IMPL"] = attn_impl
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -184,8 +237,23 @@ def dump(out_path, model="transformer", batch=None, seq=None,
                {k: list(v) for k, v in shapes.items()},
                "mesh": int(mesh) if mesh else None,
                "zero": step.zero_axis is not None,
+               "zero_stage": (0 if step.zero_axis is None
+                              else 3 if getattr(step, "zero3", False)
+                              else 1),
                "attn_impl": attn_impl or os.environ.get(
                    "MXNET_ATTN_IMPL", "auto")}
+    lay = getattr(step, "_zero_lay", None)
+    if lay:
+        from mxnet_tpu.parallel import overlap as _ov
+        from mxnet_tpu.parallel import zero as _z
+
+        sharded = {n: e for n, e in lay.items() if e.sharded}
+        sizes = {n: e.padded * e.dtype.itemsize
+                 for n, e in sharded.items()}
+        payload["zero_sharded_bytes"] = sum(sizes.values())
+        if payload["zero_stage"] == 3 and sharded:
+            payload["zero_gather_buckets"] = len(_ov.bucket_partition(
+                list(sharded), sizes, _z.gather_bucket_bytes()))
     try:
         mem = compiled.memory_analysis()
         payload["memory"] = {
@@ -199,6 +267,8 @@ def dump(out_path, model="transformer", batch=None, seq=None,
         json.dump(payload, f)
     print("wrote %s" % out_path)
     print_report(out_path, payload)
+    if check_async and not expect_async(payload, out_path):
+        return 1
     return 0
 
 
@@ -257,6 +327,21 @@ def diff(path_a, path_b):
             pct = " (%+.1f%%)" % (100.0 * (vb - va) / va) if va else ""
             print("  %-20s %12s -> %12s%s"
                   % (k, _fmt_bytes(va), _fmt_bytes(vb), pct))
+    za, zb = a.get("zero_stage"), b.get("zero_stage")
+    if za is not None and zb is not None and za != zb:
+        def _ag_bytes(p):
+            return sum(int(c.get("bytes") or 0)
+                       for c in p.get("collectives") or []
+                       if _collective_kind(c["op"]) == "all-gather"
+                       and not c["op"].endswith("-done"))
+
+        aga, agb = _ag_bytes(a), _ag_bytes(b)
+        note = ""
+        if zb == 3 and za in (1, True) and agb < aga:
+            note = "  <-- trailing full-parameter all-gather gone " \
+                   "(bucketed in-step gathers remain)"
+        print("  zero stage %s -> %s: all-gather traffic %s -> %s%s"
+              % (za, zb, _fmt_bytes(aga), _fmt_bytes(agb), note))
     ka, kb = _kind_summary(a), _kind_summary(b)
     kmoved = [(k, ka.get(k, {}).get("count", 0),
                kb.get(k, {}).get("count", 0),
@@ -346,26 +431,42 @@ def main(argv=None):
     ap.add_argument("--mesh", type=int,
                     help="compile the dump over an N-way data mesh "
                          "(the gradient collectives only exist then)")
-    ap.add_argument("--zero", choices=("auto", "on", "off"),
+    ap.add_argument("--zero", choices=("auto", "on", "off", "3"),
                     help="MXNET_ZERO mode for the dump; diff a "
                          "--zero off dump against a --zero on one to "
                          "see the all-reduce -> reduce-scatter + "
-                         "all-gather swap")
+                         "all-gather swap, or --zero on vs --zero 3 "
+                         "to see the trailing full all-gather go")
+    ap.add_argument("--expect-async", action="store_true",
+                    help="fail (exit 1) when the step's collectives "
+                         "are not overlap-capable: on backends that "
+                         "emit async pairs, any sync collective is a "
+                         "named offender; on sync-only backends (CPU) "
+                         "a structural check rejects a monolithic "
+                         "full-parameter all-gather under zero=3")
     ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                     help="compare two artifacts")
     args = ap.parse_args(argv)
     if args.dump:
         return dump(args.dump, model=args.model, batch=args.batch,
                     seq=args.seq, attn_impl=args.attn_impl,
-                    mesh=args.mesh, zero=args.zero)
+                    mesh=args.mesh, zero=args.zero,
+                    check_async=args.expect_async)
     if args.diff:
         return diff(*args.diff)
     if not args.paths:
         ap.error("nothing to do: pass artifacts, --dump, or --diff")
-    ok = 0
+    ok, async_fail = 0, 0
     for path in args.paths:
         ok += report_file(path)
-    return 0 if ok else 1
+        if args.expect_async:
+            try:
+                payload = _load(path)
+            except (ValueError, SystemExit):
+                continue  # raw HLO text: no structural metadata
+            if not expect_async(payload, path):
+                async_fail += 1
+    return 0 if ok and not async_fail else 1
 
 
 if __name__ == "__main__":
