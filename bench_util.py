@@ -90,6 +90,8 @@ def arm_budget(result, seconds=None):
         seconds = budget_seconds()
     if seconds <= 0:
         return None
+    # mxlint: disable=MX006 — the timer IS the teardown of last
+    # resort (it hard-exits the process); joining it would defeat it
     t = threading.Timer(seconds, _emit_and_exit,
                         (result, {"partial": True, "budget_s": seconds}))
     t.daemon = True
@@ -108,6 +110,7 @@ def arm_watchdog(result, seconds=None):
         seconds = watchdog_seconds()
     if seconds <= 0:
         return None
+    # mxlint: disable=MX006 — deliberate daemon watchdog, never joined
     t = threading.Timer(
         seconds, _emit_and_exit,
         (result, {"partial": True, "watchdog_timeout_sec": seconds}))

@@ -643,7 +643,16 @@ class CheckpointManager:
     def _join_writer(self):
         t = self._writer
         if t is not None and t is not threading.current_thread():
-            t.join()
+            timeout = get_env("MXNET_CKPT_JOIN_TIMEOUT_S", 600.0, float)
+            t.join(timeout=timeout if timeout and timeout > 0 else None)
+            if t.is_alive():
+                # keep the ref: a later flush() re-waits instead of
+                # orphaning the write and losing its error
+                raise MXNetError(
+                    "async checkpoint writer %r did not finish within "
+                    "%.0fs (MXNET_CKPT_JOIN_TIMEOUT_S) — disk or "
+                    "barrier wedge; the write is still in flight, "
+                    "flush() again to re-wait" % (t.name, timeout))
         self._writer = None
 
     def _raise_writer_error(self):

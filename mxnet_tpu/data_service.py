@@ -82,8 +82,10 @@ def seed_sample(seed, epoch, index):
     ``(seed, epoch, index)`` — not on which worker decodes the sample or
     what it decoded before."""
     m = fold_in(seed, epoch, index)
-    pyrandom.seed(m)
-    np.random.seed(m & 0xFFFFFFFF)
+    # the sanctioned fold_in seeding site: global state is re-derived
+    # from (seed, epoch, index) immediately before every sample
+    pyrandom.seed(m)  # mxlint: disable=MX003
+    np.random.seed(m & 0xFFFFFFFF)  # mxlint: disable=MX003
 
 
 class _RemoteError:

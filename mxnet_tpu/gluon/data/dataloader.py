@@ -45,6 +45,8 @@ class _DevicePrefetchingIter:
         self._device = device if device is not None else \
             jax.local_devices()[0]
         self._queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._closed = False
         self._thread = threading.Thread(
             target=self._worker, args=(iter(source),), daemon=True)
         self._thread.start()
@@ -63,15 +65,57 @@ class _DevicePrefetchingIter:
     def _worker(self, it):
         try:
             for batch in it:
-                self._queue.put(self._stage(batch))
+                if self._stop.is_set():
+                    return
+                self._put(self._stage(batch))
         except Exception as exc:  # propagate to the consumer thread
-            self._queue.put(_Raised(exc))
-        self._queue.put(_Stop)
+            self._put(_Raised(exc))
+        finally:
+            self._put(_Stop)
+
+    def _put(self, item):
+        """Bounded put that a close() can always unblock: retry until
+        the queue has room or the stop flag is raised (close() drains,
+        so a worker wedged on a full queue gets out either way)."""
+        while True:
+            try:
+                self._queue.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                if self._stop.is_set():
+                    return
+
+    def close(self, timeout=5):
+        """Stop the staging worker deterministically (the PR 2/9
+        teardown contract): raise the stop flag, drain the queue so a
+        blocked put exits, and join with ``timeout``."""
+        self._stop.set()
+        self._closed = True
+        t = self._thread
+        while t is not None and t.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
+            timeout -= 0.05
+            if timeout <= 0:
+                break
+        self._thread = None
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.2)
+        except Exception:  # mxlint: disable=MX008 — interpreter teardown
+            pass
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._closed:
+            raise StopIteration
         item = self._queue.get()
         if item is _Stop:
             raise StopIteration
@@ -83,15 +127,16 @@ class _DevicePrefetchingIter:
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
-                 batchify_fn=None, num_workers=0, prefetch=0, device=None):
+                 batchify_fn=None, num_workers=0, prefetch=0, device=None,
+                 seed=0):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError(
                     "batch_size must be specified unless batch_sampler is")
             if sampler is None:
-                sampler = RandomSampler(len(dataset)) if shuffle else \
-                    SequentialSampler(len(dataset))
+                sampler = RandomSampler(len(dataset), seed=seed) \
+                    if shuffle else SequentialSampler(len(dataset))
             elif shuffle:
                 raise ValueError(
                     "shuffle must not be specified if sampler is")

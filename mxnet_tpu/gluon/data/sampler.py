@@ -26,11 +26,15 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Shuffled indices from a per-instance seeded stream: the order is
+    a pure function of (seed, epoch), never of global-RNG call order."""
+
+    def __init__(self, length, seed=0):
         self._length = length
+        self._rng = np.random.RandomState(seed)
 
     def __iter__(self):
-        return iter(np.random.permutation(self._length).tolist())
+        return iter(self._rng.permutation(self._length).tolist())
 
     def __len__(self):
         return self._length

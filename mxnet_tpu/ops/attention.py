@@ -428,6 +428,8 @@ def dot_product_attention(q, k, v, causal=True, scale=None, impl=None,
             scale = 1.0 / (q.shape[-1] ** 0.5)
         try:
             return _pallas_attention(q, k, v, causal, scale)
+        except MXNetError:  # typed contract violation, not a kernel gap
+            raise
         except Exception:  # unsupported shape/kernel -> portable path
             pass
     return flash_attention(q, k, v, causal=causal, scale=scale,

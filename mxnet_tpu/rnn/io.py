@@ -1,8 +1,6 @@
 """Bucketed sequence iterators (reference ``python/mxnet/rnn/io.py``)."""
 from __future__ import annotations
 
-import random
-
 import numpy as np
 
 from ..base import MXNetError
@@ -48,8 +46,11 @@ class BucketSentenceIter(DataIter):
     def __init__(self, sentences, batch_size, buckets=None,
                  invalid_label=-1, data_name="data",
                  label_name="softmax_label", dtype="float32",
-                 layout="NT"):
+                 layout="NT", seed=0):
         super().__init__(batch_size)
+        # per-instance stream: shuffle order is a pure function of
+        # (seed, reset count), independent of global-RNG call order
+        self._rng = np.random.RandomState(seed)
         if not buckets:
             counts = np.bincount([len(s) for s in sentences])
             buckets = [i for i, n in enumerate(counts)
@@ -102,9 +103,9 @@ class BucketSentenceIter(DataIter):
 
     def reset(self):
         self.curr_idx = 0
-        random.shuffle(self.idx)
+        self._rng.shuffle(self.idx)
         for buck in self.data:
-            np.random.shuffle(buck)
+            self._rng.shuffle(buck)
         # label = data shifted left by one (next-token prediction)
         self.nddata = []
         self.ndlabel = []

@@ -92,12 +92,44 @@ import time
 from ..base import MXNetError
 
 __all__ = ["FaultInjected", "WorkerKilled", "inject", "reset", "active",
-           "rearm_after_fork"]
+           "rearm_after_fork", "SITES", "sites"]
 
 ENV_VAR = "MXNET_FAULT_INJECT"
 
 _ACTIONS = ("raise", "kill", "delay", "hang", "nan", "inf",
             "bitflip", "truncate")
+
+# The fault-site catalog.  Every *production* ``inject(site)`` literal
+# must name an entry here, and every entry must be exercised by at
+# least one test — mxlint MX005 enforces both statically (tests may
+# still inject ad-hoc sites when testing this module itself).  The
+# prose above stays the narrative; this dict is the contract.
+SITES = {
+    "prefetch": "io.py PrefetchingIter worker loop",
+    "device_prefetch": "io.py DevicePrefetchIter staging worker loop",
+    "checkpoint_io": "between checkpoint temp-file write and the "
+                     "atomic rename",
+    "shard_write": "inside the v2 shard writer, before publish",
+    "checkpoint_corrupt": "after a shard publishes (path= for "
+                          "bitflip/truncate disk rot)",
+    "collective": "kvstore DCN barrier / cross-replica sum",
+    "numerics": "Module fused step — poison one batch element",
+    "step": "top of every fit batch (hang trips the step watchdog)",
+    "zero_update": "around the ZeRO-sharded fused dispatch",
+    "zero_gather": "around the ZeRO-3 bucketed parameter all-gathers",
+    "serve_queue": "serving scheduler, every request boundary",
+    "serve_admit": "serving scheduler admission boundary",
+    "serve_decode": "serving scheduler per-request decode step",
+    "serve_respond": "serving scheduler response boundary",
+    "data_decode": "inside each data-service decode task (worker "
+                   "process, or inline with num_workers=0)",
+    "data_service": "data-service consumer next()",
+}
+
+
+def sites():
+    """The registered site catalog (name -> where it fires)."""
+    return dict(SITES)
 
 
 class FaultInjected(MXNetError):

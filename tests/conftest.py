@@ -44,6 +44,10 @@ def pytest_configure(config):
         "chaos: fault-injection matrix over the MXNET_FAULT_INJECT "
         "sites (runs in tier-1; select just the matrix with "
         "pytest -m chaos)")
+    config.addinivalue_line(
+        "markers",
+        "mxlint: static-analysis self-tests and the lint-clean tree "
+        "gate (tools/mxlint, docs/static_analysis.md)")
 
 
 @pytest.fixture(autouse=True)

@@ -343,6 +343,25 @@ def test_async_depth_one_and_error_surfacing(tmp_path, monkeypatch):
     assert 3 not in mgr.epochs() and 5 in mgr.epochs()
 
 
+def test_async_join_timeout_raises_not_hangs(tmp_path, monkeypatch):
+    """A wedged background writer must surface as a diagnosable error
+    at flush(), not hang it forever (the PR 2 bounded-wait contract —
+    mxlint MX006 regression)."""
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m",
+                                 async_writes=True)
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "shard_write:delay:seconds=5")
+    monkeypatch.setenv("MXNET_CKPT_JOIN_TIMEOUT_S", "0.2")
+    faults.reset()
+    mgr.save(symbol=_mlp(), arg_params=_args(), aux_params={}, epoch=1)
+    with pytest.raises(MXNetError, match="MXNET_CKPT_JOIN_TIMEOUT_S"):
+        mgr.flush()
+    # the write stays in flight: with the bound lifted, flush re-waits
+    # and the epoch lands
+    monkeypatch.setenv("MXNET_CKPT_JOIN_TIMEOUT_S", "30")
+    mgr.flush()
+    assert mgr.epochs() == [1]
+
+
 def test_async_flush_raises_pending_error(tmp_path, monkeypatch):
     mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m",
                                  async_writes=True)

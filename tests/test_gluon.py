@@ -206,6 +206,36 @@ def test_dataset_dataloader():
     assert len(list(loader2)) == 3
 
 
+def test_dataloader_prefetch_close_joins_worker():
+    """Abandoning a prefetching DataLoader mid-epoch must not leak its
+    staging thread (the PR 2/9 teardown contract — mxlint MX006
+    regression): close() stops and joins the worker with a timeout."""
+    X = np.arange(40).reshape(10, 4).astype("float32")
+    y = np.arange(10).astype("float32")
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, y),
+                                   batch_size=2, prefetch=2)
+    it = iter(loader)
+    next(it)  # worker running, queue filling
+    thread = it._thread
+    assert thread.is_alive()
+    it.close(timeout=5)
+    assert not thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_dataloader_prefetch_full_epoch_after_close_of_other_iter():
+    """close() on one epoch's iterator leaves the loader reusable."""
+    X = np.arange(40).reshape(10, 4).astype("float32")
+    y = np.arange(10).astype("float32")
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, y),
+                                   batch_size=2, prefetch=2)
+    first = iter(loader)
+    next(first)
+    first.close()
+    assert len(list(loader)) == 5
+
+
 def test_split_and_load():
     arr = nd.array(np.arange(12).reshape(6, 2).astype("float32"))
     parts = gluon.utils.split_data(arr, 3)
@@ -331,3 +361,16 @@ def test_symbol_block_from_checkpoint(tmp_path):
     # non-Variable inputs are rejected with a clear error
     with pytest.raises(mx.MXNetError, match="Variables"):
         gluon.SymbolBlock(feat, head.get_internals()["fc1_output"])
+
+
+def test_random_sampler_replayable_across_instances():
+    from mxnet_tpu.gluon.data import RandomSampler
+
+    # same seed => same epoch orders; global np.random traffic between
+    # draws must not perturb the stream
+    a, b = RandomSampler(32, seed=5), RandomSampler(32, seed=5)
+    first = list(a)
+    np.random.seed(0)
+    assert first == list(b)
+    assert sorted(first) == list(range(32))
+    assert list(a) != first  # epochs reshuffle

@@ -1,0 +1,611 @@
+"""mxlint self-tests (docs/static_analysis.md).
+
+Every checker gets a positive, a negative, and a suppressed fixture;
+the CLI contract tests pin the exit codes, the baseline lifecycle
+(grandfather -> shrink -> --prune-baseline), and the --json schema that
+external tooling parses.
+"""
+import io
+import json
+import os
+import sys
+import textwrap
+from contextlib import redirect_stdout
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.mxlint import engine  # noqa: E402
+from tools.mxlint.__main__ import main as mxlint_main  # noqa: E402
+
+pytestmark = pytest.mark.mxlint
+
+
+# ---------------------------------------------------------------------------
+# fixture scaffolding: a minimal fake repo root
+
+_DOC_HEADER = "| Variable | Default | Effect |\n|---|---|---|\n"
+_FAULTS_SRC = 'SITES = {%s}\n'
+
+
+def fake_root(tmp_path, files=None, doc_rows="", sites="",
+              test_src="pass\n"):
+    """A throwaway repo root: docs/env_vars.md + testing/faults.py +
+    tests/ so the project checkers (MX004/MX005) have their registries,
+    plus the given ``mxnet_tpu/``-relative source files."""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "env_vars.md").write_text(
+        _DOC_HEADER + doc_rows, encoding="utf-8")
+    (tmp_path / "mxnet_tpu" / "testing").mkdir(parents=True)
+    (tmp_path / "mxnet_tpu" / "testing" / "faults.py").write_text(
+        _FAULTS_SRC % sites, encoding="utf-8")
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_stub.py").write_text(
+        test_src, encoding="utf-8")
+    for rel, src in (files or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return tmp_path
+
+
+def run(root, code, files=None, **kw):
+    """Scan a fake root with one checker selected; return findings."""
+    root = fake_root(root, files, **kw)
+    findings, parse_errors = engine.run_paths(
+        [str(root / "mxnet_tpu")], root=str(root), select={code})
+    assert not parse_errors, [f.render() for f in parse_errors]
+    return findings
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# MX001 — tracer host sync
+
+_MX001_POS = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return np.asarray(x).sum()
+"""
+
+_MX001_NEG = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        n = float(x.shape[0])       # static: shapes are trace constants
+        return x * n
+
+    def host_side(x):
+        return np.asarray(x)        # not a traced function
+"""
+
+_MX001_SUPPRESSED = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x.sum())  # mxlint: disable=MX001
+"""
+
+
+def test_mx001_positive(tmp_path):
+    fs = run(tmp_path, "MX001", {"mxnet_tpu/mod.py": _MX001_POS})
+    assert codes(fs) == ["MX001"]
+    assert "asarray" in fs[0].message
+
+
+def test_mx001_negative(tmp_path):
+    assert run(tmp_path, "MX001",
+               {"mxnet_tpu/mod.py": _MX001_NEG}) == []
+
+
+def test_mx001_suppressed(tmp_path):
+    assert run(tmp_path, "MX001",
+               {"mxnet_tpu/mod.py": _MX001_SUPPRESSED}) == []
+
+
+def test_mx001_item_method_and_nested_def(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def outer(x):
+            def inner(y):
+                return y.item()
+            return inner(x)
+    """
+    fs = run(tmp_path, "MX001", {"mxnet_tpu/mod.py": src})
+    # blamed on the nested def (itself traced), exactly once
+    assert len(fs) == 1 and "inner" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# MX002 — collective placement
+
+_MX002_POS = """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def f(x):
+        if x.sum() > 0:
+            return lax.psum(x, "i")
+        return x
+"""
+
+_MX002_NEG = """
+    import jax
+    from jax import lax
+
+    AXIS = "i"
+
+    @jax.jit
+    def f(x):
+        if AXIS:                        # config-static branch
+            return lax.psum(x, AXIS)
+        return lax.pmean(x, AXIS)       # unconditional
+"""
+
+_MX002_SUPPRESSED = """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def f(x):
+        if x.min() > 0:
+            return lax.psum(x, "i")  # mxlint: disable=MX002
+        return x
+"""
+
+
+def test_mx002_positive(tmp_path):
+    fs = run(tmp_path, "MX002", {"mxnet_tpu/mod.py": _MX002_POS})
+    assert codes(fs) == ["MX002"]
+    assert "deadlock" in fs[0].message
+
+
+def test_mx002_negative(tmp_path):
+    assert run(tmp_path, "MX002",
+               {"mxnet_tpu/mod.py": _MX002_NEG}) == []
+
+
+def test_mx002_suppressed(tmp_path):
+    assert run(tmp_path, "MX002",
+               {"mxnet_tpu/mod.py": _MX002_SUPPRESSED}) == []
+
+
+# ---------------------------------------------------------------------------
+# MX003 — RNG discipline
+
+_MX003_POS = """
+    import random
+    import time
+
+    import numpy as np
+
+    def draw():
+        return np.random.uniform()
+
+    def entropy_seeded():
+        return random.Random(time.time())
+"""
+
+_MX003_NEG = """
+    import jax
+    import numpy as np
+
+    def draw(key):
+        rng = np.random.RandomState(0)
+        a = rng.uniform()
+        b = jax.random.uniform(key)     # explicitly keyed: sanctioned
+        return a, b
+"""
+
+_MX003_SUPPRESSED = """
+    import numpy as np
+
+    def seed_sample(m):
+        np.random.seed(m)  # mxlint: disable=MX003
+"""
+
+
+def test_mx003_positive(tmp_path):
+    fs = run(tmp_path, "MX003", {"mxnet_tpu/mod.py": _MX003_POS})
+    assert codes(fs) == ["MX003", "MX003"]
+    msgs = " / ".join(f.message for f in fs)
+    assert "numpy.random.uniform" in msgs and "entropy" in msgs
+
+
+def test_mx003_negative(tmp_path):
+    assert run(tmp_path, "MX003",
+               {"mxnet_tpu/mod.py": _MX003_NEG}) == []
+
+
+def test_mx003_suppressed(tmp_path):
+    assert run(tmp_path, "MX003",
+               {"mxnet_tpu/mod.py": _MX003_SUPPRESSED}) == []
+
+
+# ---------------------------------------------------------------------------
+# MX004 — env-var registry (project checker)
+
+_MX004_SRC = """
+    import os
+
+    def knob():
+        return os.environ.get("MXNET_UNDOCUMENTED_KNOB", "0")
+"""
+
+
+def test_mx004_both_directions(tmp_path):
+    fs = run(tmp_path, "MX004", {"mxnet_tpu/mod.py": _MX004_SRC},
+             doc_rows="| `MXNET_STALE_ROW` | 1 | removed long ago |\n")
+    assert sorted(f.symbol for f in fs) == \
+        ["MXNET_STALE_ROW", "MXNET_UNDOCUMENTED_KNOB"]
+    stale = [f for f in fs if f.symbol == "MXNET_STALE_ROW"][0]
+    assert stale.path == "docs/env_vars.md"
+
+
+def test_mx004_negative_with_canonicalization(tmp_path):
+    src = """
+        import os
+
+        from mxnet_tpu.base import get_env
+
+        def knobs():
+            a = get_env("DOCED_THING", 1, int)       # -> MXNET_DOCED_THING
+            b = os.environ.get("MXTPU_ALIASED")      # -> MXNET_ALIASED
+            return a, b
+    """
+    fs = run(tmp_path, "MX004", {"mxnet_tpu/mod.py": src},
+             doc_rows="| `MXNET_DOCED_THING` | 1 | documented |\n"
+                      "| `MXNET_ALIASED` | - | documented |\n")
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# MX005 — fault-site registry (project checker)
+
+_MX005_SRC = """
+    from mxnet_tpu.testing import faults
+
+    def work():
+        faults.inject("covered")
+        faults.inject("rogue_site")
+"""
+
+
+def test_mx005_unregistered_and_untested(tmp_path):
+    fs = run(tmp_path, "MX005", {"mxnet_tpu/mod.py": _MX005_SRC},
+             sites='"covered": "doc", "never_armed": "doc"',
+             test_src='ENV = "covered:raise"\n')
+    assert sorted(f.symbol for f in fs) == \
+        ["unregistered:rogue_site", "untested:never_armed"]
+
+
+def test_mx005_negative(tmp_path):
+    src = """
+        from mxnet_tpu.testing import faults
+
+        def work():
+            faults.inject("covered")
+    """
+    fs = run(tmp_path, "MX005", {"mxnet_tpu/mod.py": src},
+             sites='"covered": "doc"',
+             test_src='ENV = "covered:raise"\n')
+    assert fs == []
+
+
+def test_mx005_duplicate_site(tmp_path):
+    fs = run(tmp_path, "MX005", {},
+             sites='"covered": "a", "covered": "b"',
+             test_src='ENV = "covered"\n')
+    assert [f.symbol for f in fs] == ["dup:covered"]
+
+
+# ---------------------------------------------------------------------------
+# MX006 — unjoined thread/process teardown
+
+_MX006_POS = """
+    import threading
+
+    class Leaky:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+"""
+
+_MX006_NEG = """
+    import threading
+
+    class Clean:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def close(self):
+            self._t.join(timeout=5)
+
+    def scoped():
+        t = threading.Thread(target=print)
+        t.start()
+        t.join(timeout=5)
+"""
+
+_MX006_SUPPRESSED = """
+    import threading
+
+    class Watchdog:
+        def arm(self):
+            # mxlint: disable=MX006 — deliberate daemon, never joined
+            self._t = threading.Timer(60, self._fire)
+            self._t.start()
+"""
+
+
+def test_mx006_positive(tmp_path):
+    fs = run(tmp_path, "MX006", {"mxnet_tpu/mod.py": _MX006_POS})
+    assert codes(fs) == ["MX006"] and fs[0].symbol == "Leaky"
+
+
+def test_mx006_negative(tmp_path):
+    assert run(tmp_path, "MX006",
+               {"mxnet_tpu/mod.py": _MX006_NEG}) == []
+
+
+def test_mx006_suppressed_on_comment_line(tmp_path):
+    assert run(tmp_path, "MX006",
+               {"mxnet_tpu/mod.py": _MX006_SUPPRESSED}) == []
+
+
+def test_mx006_local_thread_never_joined(tmp_path):
+    src = """
+        import threading
+
+        def fire_and_forget():
+            t = threading.Thread(target=print)
+            t.start()
+    """
+    fs = run(tmp_path, "MX006", {"mxnet_tpu/mod.py": src})
+    assert len(fs) == 1 and "never joined" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# MX007 — donation reuse
+
+_MX007_POS = """
+    import jax
+
+    step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+    def bad(state, batch):
+        new = step(state, batch)
+        return state.sum() + new.sum()
+"""
+
+_MX007_NEG = """
+    import jax
+
+    step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+    def rebind(state, batch):
+        state = step(state, batch)      # the donation idiom
+        return state.sum()
+
+    def undonated(state, batch):
+        new = step(batch, state)        # position 1 is not donated
+        return state.sum() + new.sum()
+"""
+
+_MX007_SUPPRESSED = """
+    import jax
+
+    step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+    def checked(state, batch):
+        new = step(state, batch)
+        return state.is_deleted()  # mxlint: disable=MX007
+"""
+
+
+def test_mx007_positive(tmp_path):
+    fs = run(tmp_path, "MX007", {"mxnet_tpu/mod.py": _MX007_POS})
+    assert codes(fs) == ["MX007"]
+    assert "'state'" in fs[0].message and "donated" in fs[0].message
+
+
+def test_mx007_negative_rebind_idiom(tmp_path):
+    assert run(tmp_path, "MX007",
+               {"mxnet_tpu/mod.py": _MX007_NEG}) == []
+
+
+def test_mx007_suppressed(tmp_path):
+    assert run(tmp_path, "MX007",
+               {"mxnet_tpu/mod.py": _MX007_SUPPRESSED}) == []
+
+
+def test_mx007_aot_chain(tmp_path):
+    src = """
+        import jax
+
+        step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+
+        def aot(state, batch):
+            stepc = step.lower(state, batch).compile()
+            state = stepc(state, batch)
+            out = stepc(state, batch)
+            return state.sum()          # donated to the second call
+    """
+    fs = run(tmp_path, "MX007", {"mxnet_tpu/mod.py": src})
+    assert codes(fs) == ["MX007"]
+
+
+# ---------------------------------------------------------------------------
+# MX008 — swallowed MXNetError
+
+_MX008_POS = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+"""
+
+_MX008_NEG = """
+    from mxnet_tpu.base import MXNetError
+
+    def f():
+        try:
+            g()
+        except MXNetError:
+            raise
+        except Exception:
+            pass                # typed path re-raised above: fine
+
+    def g2():
+        try:
+            g()
+        except Exception:
+            raise               # broad but re-raises
+"""
+
+_MX008_SUPPRESSED = """
+    def f():
+        try:
+            g()
+        except Exception:  # mxlint: disable=MX008 — interpreter teardown
+            pass
+"""
+
+
+def test_mx008_positive(tmp_path):
+    fs = run(tmp_path, "MX008", {"mxnet_tpu/mod.py": _MX008_POS})
+    assert codes(fs) == ["MX008"]
+
+
+def test_mx008_negative(tmp_path):
+    assert run(tmp_path, "MX008",
+               {"mxnet_tpu/mod.py": _MX008_NEG}) == []
+
+
+def test_mx008_suppressed(tmp_path):
+    assert run(tmp_path, "MX008",
+               {"mxnet_tpu/mod.py": _MX008_SUPPRESSED}) == []
+
+
+# ---------------------------------------------------------------------------
+# engine contracts: suppression scope, parse errors, baseline lifecycle
+
+def test_disable_file_pragma(tmp_path):
+    src = """
+        # mxlint: disable-file=MX003
+        import numpy as np
+
+        def a():
+            return np.random.uniform()
+
+        def b():
+            return np.random.normal()
+    """
+    assert run(tmp_path, "MX003", {"mxnet_tpu/mod.py": src}) == []
+
+
+def test_parse_error_is_mx000(tmp_path):
+    root = fake_root(tmp_path, {"mxnet_tpu/broken.py": "def f(:\n"})
+    findings, parse_errors = engine.run_paths(
+        [str(root / "mxnet_tpu")], root=str(root))
+    assert [f.code for f in parse_errors] == ["MX000"]
+
+
+def _cli(args):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = mxlint_main(args)
+    return rc, buf.getvalue()
+
+
+def _cli_root(root):
+    """A fake root exercised through the real CLI."""
+    return fake_root(root, {"mxnet_tpu/mod.py": _MX003_POS})
+
+
+def test_cli_baseline_lifecycle(tmp_path):
+    root = _cli_root(tmp_path)
+    bl = str(root / "baseline.json")
+    base = [str(root / "mxnet_tpu"), "--root", str(root),
+            "--baseline", bl, "--select", "MX003"]
+
+    rc, out = _cli(base)                      # findings, no baseline yet
+    assert rc == 1 and "MX003" in out
+
+    rc, out = _cli(base + ["--write-baseline"])
+    assert rc == 0 and os.path.exists(bl)
+
+    rc, out = _cli(base)                      # grandfathered
+    assert rc == 0 and "2 baselined" in out
+
+    rc, out = _cli(base + ["--no-baseline"])  # debt still visible
+    assert rc == 1
+
+    # pay the debt; the baseline entries go stale
+    (root / "mxnet_tpu" / "mod.py").write_text("x = 1\n",
+                                               encoding="utf-8")
+    rc, out = _cli(base)                      # stale is advisory...
+    assert rc == 0 and "STALE" in out
+    rc, out = _cli(base + ["--prune-baseline"])
+    assert rc == 2                            # ...until pruning is asked
+
+    rc, out = _cli(base + ["--write-baseline"])  # rewrite empties it
+    rc, out = _cli(base + ["--prune-baseline"])
+    assert rc == 0
+
+
+def test_cli_usage_errors(tmp_path):
+    root = _cli_root(tmp_path)
+    assert mxlint_main(["--select", "MX999", "--root", str(root)]) == 3
+    assert mxlint_main([str(root / "nope.py"), "--root",
+                        str(root)]) == 3
+
+
+def test_cli_list_checkers():
+    rc, out = _cli(["--list-checkers"])
+    assert rc == 0
+    for code in ("MX001", "MX002", "MX003", "MX004",
+                 "MX005", "MX006", "MX007", "MX008"):
+        assert code in out
+
+
+# ---------------------------------------------------------------------------
+# --json: the stable external schema
+
+def test_json_schema_stable(tmp_path):
+    root = _cli_root(tmp_path)
+    rc, out = _cli([str(root / "mxnet_tpu"), "--root", str(root),
+                    "--baseline", str(root / "baseline.json"),
+                    "--select", "MX003", "--json"])
+    assert rc == 1
+    payload = json.loads(out)
+    assert sorted(payload) == ["counts", "findings", "kind",
+                               "parse_errors", "schema_version",
+                               "stale_baseline"]
+    assert payload["kind"] == "mxnet_tpu-mxlint"
+    assert payload["schema_version"] == engine.JSON_SCHEMA_VERSION == 1
+    assert sorted(payload["counts"]) == ["baselined", "findings",
+                                         "parse_errors",
+                                         "stale_baseline"]
+    assert payload["counts"]["findings"] == 2
+    assert payload["counts"]["stale_baseline"] == 0
+    for f in payload["findings"]:
+        assert sorted(f) == ["baselined", "code", "col", "hint", "line",
+                             "message", "path", "symbol"]
+        assert f["path"] == "mxnet_tpu/mod.py" and not f["baselined"]

@@ -1,0 +1,46 @@
+"""Tier-1 gate: the tree itself lints clean.
+
+``python -m tools.mxlint`` over the canonical code set (mxnet_tpu/,
+tools/, bench*.py, __graft_entry__.py) must report zero non-baselined
+findings — new violations of the MX001–MX008 contracts fail the suite
+with the offending ``file:line: CODE message`` lines and the fix hint,
+bench_util-style.  Grandfathered debt lives in
+tools/mxlint/baseline.json and may only shrink (the second test).
+"""
+import io
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.mxlint.__main__ import main as mxlint_main  # noqa: E402
+
+pytestmark = pytest.mark.mxlint
+
+
+def _run(extra=()):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = mxlint_main(["--root", ROOT] + list(extra))
+    return rc, buf.getvalue()
+
+
+def test_tree_lints_clean():
+    rc, out = _run()
+    assert rc == 0, (
+        "mxlint found new findings — fix them, suppress a deliberate "
+        "one with `# mxlint: disable=MXnnn — reason`, or (for "
+        "pre-existing debt only) regenerate the baseline with "
+        "`python -m tools.mxlint --write-baseline`:\n%s" % out)
+
+
+def test_baseline_has_no_stale_entries():
+    rc, out = _run(["--prune-baseline"])
+    assert rc == 0, (
+        "stale baseline entries — that debt was paid, so shrink the "
+        "baseline (delete the listed keys from tools/mxlint/"
+        "baseline.json or rerun --write-baseline):\n%s" % out)

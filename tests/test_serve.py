@@ -295,6 +295,23 @@ def test_chaos_kill_at_respond_boundary(session, monkeypatch):
 
 
 @pytest.mark.chaos
+def test_chaos_queue_fault_first_boundary(session, monkeypatch):
+    """``serve_queue`` is crossed at EVERY request boundary (before the
+    phase-specific site), so its first firing lands on the first
+    admission crossing: that request fails, the rest complete, and the
+    slot pool drains back to full."""
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "serve_queue:raise")
+    faults.reset()
+    reqs = _trace(3, seed=19, max_new=4)
+    done, _ = serve.Scheduler(session, policy="continuous").run(reqs)
+    failed = [r for r in done if r.failed]
+    assert len(failed) == 1
+    assert "FaultInjected" in failed[0].error
+    assert len([r for r in done if not r.failed]) == 2
+    assert session.cache.free_slots == session.config.slots
+
+
+@pytest.mark.chaos
 def test_chaos_admit_delay_completes(session, monkeypatch):
     monkeypatch.setenv("MXNET_FAULT_INJECT",
                        "serve_admit:delay:seconds=0.02")

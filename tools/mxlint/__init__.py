@@ -1,0 +1,38 @@
+"""mxlint — project-invariant static analysis for tpu-mx.
+
+Ten PRs of conventions (the fold_in RNG discipline, the compile-once
+contract, bounded collectives, join-with-timeout teardown, the
+``MXNET_*`` env catalog) live in reviewers' memories; mxlint turns them
+into machine-checked invariants.  See ``docs/static_analysis.md``.
+
+Usage::
+
+    python -m tools.mxlint [paths] [--select MX001,..] [--ignore ..]
+                           [--baseline FILE] [--write-baseline]
+                           [--prune-baseline] [--json]
+
+Checkers (each documented in docs/static_analysis.md):
+
+========  ==============================================================
+MX001     host sync (float()/.item()/np.asarray/device_get) on a traced
+          value inside a jit/shard_map/scan-visible function
+MX002     collective (psum/all_gather/psum_scatter/...) under
+          value-dependent Python control flow — the multi-host deadlock
+MX003     raw np.random.*/random.* / time-seeded RNG outside the
+          sanctioned fold_in sites
+MX004     every MXNET_* env read documented in docs/env_vars.md and
+          vice-versa
+MX005     every faults.inject(site) name registered in
+          testing/faults.py SITES and exercised by a test
+MX006     a class that starts a Thread/Process must tear it down via a
+          close()/_halt()-style method that joins with a timeout
+MX007     buffer reused after being passed to a donate_argnums
+          executable
+MX008     bare except / except Exception that can swallow MXNetError
+          without re-raising
+========  ==============================================================
+"""
+from .engine import (  # noqa: F401
+    Finding, Checker, ProjectChecker, register, all_checkers,
+    run_paths, load_baseline, write_baseline, DEFAULT_BASELINE,
+)

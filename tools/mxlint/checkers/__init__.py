@@ -1,0 +1,7 @@
+"""Importing this package registers every mxlint checker."""
+from . import tracing      # noqa: F401  MX001, MX002
+from . import rng          # noqa: F401  MX003
+from . import registries   # noqa: F401  MX004, MX005
+from . import teardown     # noqa: F401  MX006
+from . import donation     # noqa: F401  MX007
+from . import excepts      # noqa: F401  MX008

@@ -1,0 +1,163 @@
+"""MX007 — donation reuse.
+
+A buffer passed at a ``donate_argnums`` position of a jitted (or AOT
+``.lower().compile()``d) executable is dead the moment the call
+dispatches — XLA may alias its pages for the output.  Reading the
+Python name afterwards returns deleted-array errors on TPU and silent
+garbage in some donation modes.  The checker tracks names assigned
+from ``jax.jit(..., donate_argnums=...)`` (and their ``self.attr``
+form plus AOT derivatives) within a module, then flags loads of a
+donated argument after the consuming call without an intervening
+rebind.
+"""
+import ast
+
+from .. import astutil
+from ..engine import Checker, register
+
+_JITS = ("jax.jit", "jit", "pjit")
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _donated_positions(call, aliases):
+    """The literal donate_argnums positions of a jit call, or None."""
+    if not astutil.matches(astutil.call_name(call, aliases), _JITS):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, int):
+                    out.append(e.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+    return None
+
+
+def _unwrap_aot(node):
+    """``X.lower(...).compile(...)`` -> X, else the node itself."""
+    cur = node
+    for attr in ("compile", "lower"):
+        if isinstance(cur, ast.Call) and \
+                isinstance(cur.func, ast.Attribute) and \
+                cur.func.attr == attr:
+            cur = cur.func.value
+        else:
+            return node
+    return cur
+
+
+@register
+class DonationReuse(Checker):
+    """Use of a buffer after it was passed at a donate_argnums position
+    — the executable may already have aliased its memory."""
+
+    code = "MX007"
+    name = "donation-reuse"
+    hint = ("rebind the name to the executable's output (the donation "
+            "idiom is x = f(x)), copy before the call, or drop "
+            "donate_argnums for that argument")
+
+    def check(self, ctx):
+        donors = self._collect_donors(ctx)
+        if not donors:
+            return []
+        findings = []
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(fn, donors, ctx))
+        return findings
+
+    def _collect_donors(self, ctx):
+        """name/attr -> donated positions, for assignments of donating
+        jits (including AOT ``.lower().compile()`` chains over an
+        already-known donor)."""
+        donors = {}
+        for _ in range(2):  # second pass resolves AOT-of-donor chains
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) or \
+                        len(node.targets) != 1:
+                    continue
+                value = _unwrap_aot(node.value)
+                pos = None
+                if isinstance(value, ast.Call):
+                    pos = _donated_positions(value, ctx.aliases)
+                if pos is None and value is not node.value:
+                    # AOT chain over a name that is itself a donor
+                    key = self._target_key(value)
+                    pos = donors.get(key)
+                if pos is None:
+                    continue
+                key = self._target_key(node.targets[0])
+                if key:
+                    donors[key] = pos
+        return donors
+
+    def _target_key(self, node):
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return "self." + node.attr
+        return None
+
+    def _check_fn(self, fn, donors, ctx):
+        """Per-*statement* event processing — loads, then donations,
+        then stores.  Within ``new = step(state, x)`` the argument load
+        of ``state`` precedes the donation, and in the canonical rebind
+        ``state = step(state, x)`` the store lands after it, so neither
+        self-flags; only a load in a *later* statement does."""
+        findings = []
+        by_stmt = {}  # stmt -> {"load"/"donate"/"store": [(name, node)]}
+        for node in ast.walk(fn):
+            owner = astutil.enclosing(node, ctx.parents, _FUNCS)
+            if owner is not fn:
+                continue
+            stmt = astutil.enclosing(node, ctx.parents, (ast.stmt,))
+            if stmt is None:
+                continue
+            ev = by_stmt.setdefault(
+                stmt, {"load": [], "donate": [], "store": []})
+            if isinstance(node, ast.Call):
+                key = self._target_key(node.func)
+                pos = donors.get(key)
+                if pos:
+                    for i in pos:
+                        if i < len(node.args) and \
+                                isinstance(node.args[i], ast.Name):
+                            ev["donate"].append(
+                                (node.args[i].id, node))
+            elif isinstance(node, ast.Name):
+                kind = "load" if isinstance(node.ctx, ast.Load) \
+                    else "store"
+                ev[kind].append((node.id, node))
+        dead = {}  # name -> donating call node
+        for stmt in sorted(by_stmt,
+                           key=lambda s: (s.lineno, s.col_offset)):
+            ev = by_stmt[stmt]
+            for name, node in ev["load"]:
+                if name not in dead:
+                    continue
+                donor = dead.pop(name)  # report once per donation
+                qn = astutil.qualname(fn, ctx.parents)
+                findings.append(ctx.finding(
+                    node, self.code,
+                    "%r is read after being donated to the executable "
+                    "called at line %d — the buffer may already be "
+                    "aliased" % (name, donor.lineno),
+                    hint=self.hint,
+                    symbol="%s:%s" % (qn, name)))
+            for name, node in ev["donate"]:
+                dead[name] = node
+            for name, node in ev["store"]:
+                dead.pop(name, None)
+        return findings

@@ -1,0 +1,71 @@
+"""MX008 — bare except swallows MXNetError.
+
+Every typed failure this codebase worked to surface — MXNetError and
+its subclasses (TrainingPreempted, CorruptCheckpoint, RecompileStorm,
+StepHung...) — dies silently inside a ``except:`` / ``except
+Exception:`` handler that never re-raises.  Catch the broad type for a
+*fallback*, but let the project's typed errors through first
+(``except MXNetError: raise``) or re-raise on exit.
+"""
+import ast
+
+from .. import astutil
+from ..engine import Checker, register
+
+_BROAD = ("Exception", "BaseException", "builtins.Exception",
+          "builtins.BaseException")
+# the project's typed-error family: an earlier handler naming one of
+# these (or re-raising) is the sanctioned pattern
+_TYPED = ("MXNetError", "TrainingPreempted", "TrainingDiverged",
+          "StepHung", "RecompileStorm", "CorruptCheckpoint")
+
+
+def _names_in_type(node, aliases):
+    if node is None:
+        return [None]
+    if isinstance(node, ast.Tuple):
+        return [astutil.dotted(e, aliases) for e in node.elts]
+    return [astutil.dotted(node, aliases)]
+
+
+@register
+class BareExceptSwallows(Checker):
+    """A bare ``except:`` / ``except Exception:`` with no re-raise and
+    no preceding MXNetError handler — the typed errors PRs 2-9 raise
+    (preemption, corrupt checkpoint, step hang...) vanish here."""
+
+    code = "MX008"
+    name = "bare-except-swallows-mxneterror"
+    hint = ("insert `except MXNetError: raise` before the broad "
+            "handler, re-raise inside it, or narrow the caught type; "
+            "a deliberate best-effort fallback carries "
+            "# mxlint: disable=MX008")
+
+    def check(self, ctx):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            typed_seen = False
+            for handler in node.handlers:
+                names = _names_in_type(handler.type, ctx.aliases)
+                if any(n and astutil.matches(n, _TYPED)
+                       for n in names):
+                    typed_seen = True
+                    continue
+                broad = any(n is None or astutil.matches(n, _BROAD)
+                            for n in names)
+                if not broad or typed_seen:
+                    continue
+                if any(isinstance(s, ast.Raise)
+                       for s in ast.walk(handler)):
+                    continue
+                qn = astutil.qualname(handler, ctx.parents)
+                what = "bare except:" if handler.type is None else \
+                    "except %s:" % "/".join(str(n) for n in names)
+                findings.append(ctx.finding(
+                    handler, self.code,
+                    "%s in %s swallows MXNetError (and every typed "
+                    "subclass) without re-raising" % (what, qn),
+                    hint=self.hint, symbol=qn))
+        return findings

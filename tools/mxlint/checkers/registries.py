@@ -1,0 +1,281 @@
+"""MX004 (env-var registry) and MX005 (fault-site registry).
+
+Both are *project* checkers: they compare the whole canonical code set
+(mxnet_tpu/, tools/, bench*.py, __graft_entry__.py) against a
+committed registry file, regardless of which paths the CLI was pointed
+at — a subset scan must not report half a registry as drift.
+
+MX004: every ``MXNET_*`` name the code actually accesses (``get_env``,
+``os.environ``/``os.getenv`` in any form; ``MXTPU_`` aliases
+canonicalize to ``MXNET_``) must have a row in ``docs/env_vars.md``,
+and every documented row must still be accessed somewhere.
+
+MX005: every literal ``faults.inject("site")`` must name an entry of
+``mxnet_tpu/testing/faults.py::SITES``, SITES keys must be unique, and
+every registered site must be exercised by at least one test under
+``tests/``.
+"""
+import ast
+import os
+import re
+
+from .. import astutil
+from ..engine import Finding, ProjectChecker, register
+
+# ---------------------------------------------------------------------------
+# MX004
+
+_ENV_DOC = "docs/env_vars.md"
+_ENV_PREFIXES = ("MXNET_", "MXTPU_")
+_GET_ENV = ("get_env", "base.get_env", "mxnet_tpu.base.get_env")
+_OS_GET = ("os.environ.get", "environ.get", "os.getenv", "getenv",
+           "os.environ.setdefault", "environ.setdefault",
+           "os.environ.pop", "environ.pop")
+_ENVIRON = ("os.environ", "environ")
+# first-cell token of a markdown table row
+_DOC_ROW_RE = re.compile(r"^\s*\|([^|]*)\|")
+_VAR_RE = re.compile(r"MXNET_[A-Z0-9_]+[A-Z0-9]")
+
+
+def _canon(name):
+    """MXTPU_X and bare X canonicalize to MXNET_X (get_env parity)."""
+    if name.startswith("MXTPU_"):
+        return "MXNET_" + name[len("MXTPU_"):]
+    if not name.startswith("MXNET_"):
+        return "MXNET_" + name
+    return name
+
+
+def _literal_str(node):
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
+def _str_consts(tree):
+    """Name -> [string literals it may hold], from simple assignments
+    (``ENV_VAR = "MXNET_FAULT_INJECT"``) and for-loops over literal
+    tuples (``for key in ("MXTPU_X", "MXNET_X"):``) — the two ways
+    this codebase names an env key indirectly."""
+    consts = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            lit = _literal_str(node.value)
+            if lit is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        consts.setdefault(t.id, []).append(lit)
+        elif isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, (ast.Tuple, ast.List)):
+            lits = [_literal_str(e) for e in node.iter.elts]
+            if lits and all(l is not None for l in lits):
+                consts.setdefault(node.target.id, []).extend(lits)
+    return consts
+
+
+def _key_strings(node, consts):
+    """Possible string values of an env-key expression."""
+    lit = _literal_str(node)
+    if lit is not None:
+        return [lit]
+    if isinstance(node, ast.Name):
+        return consts.get(node.id, [])
+    return []
+
+
+def _env_reads(ctx):
+    """[(canonical_name, node)] for every env access in one file."""
+    out = []
+    consts = _str_consts(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        names, via_get_env = [], False
+        if isinstance(node, ast.Call):
+            callee = astutil.call_name(node, ctx.aliases)
+            if astutil.matches(callee, _GET_ENV) and node.args:
+                names = _key_strings(node.args[0], consts)
+                via_get_env = True
+            elif astutil.matches(callee, _OS_GET) and node.args:
+                names = _key_strings(node.args[0], consts)
+        elif isinstance(node, ast.Subscript):
+            base = astutil.dotted(node.value, ctx.aliases)
+            if astutil.matches(base, _ENVIRON):
+                names = _key_strings(node.slice, consts)
+        elif isinstance(node, ast.Compare):
+            if len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                base = astutil.dotted(node.comparators[0], ctx.aliases)
+                if astutil.matches(base, _ENVIRON):
+                    names = _key_strings(node.left, consts)
+        for name in names:
+            if via_get_env:
+                # get_env prepends the prefix itself; unprefixed
+                # literals are env knobs too
+                name = _canon(name)
+            if name.startswith(_ENV_PREFIXES):
+                out.append((_canon(name), node))
+    return out
+
+
+@register
+class EnvRegistry(ProjectChecker):
+    """Every MXNET_* env var the code reads must have a row in
+    docs/env_vars.md, and every documented row must still be read —
+    the catalog is the contract, drift makes it folklore."""
+
+    code = "MX004"
+    name = "env-var-registry"
+    hint = ("add a `| `MXNET_X` | default | effect |` row to "
+            "docs/env_vars.md (or delete the stale row / the dead "
+            "read)")
+
+    def check_project(self, project):
+        findings = []
+        read_at = {}  # canonical name -> first (relpath, node)
+        for ctx in project.library_files():
+            for name, node in _env_reads(ctx):
+                read_at.setdefault(name, (ctx.relpath, node))
+
+        doc = project.read(_ENV_DOC)
+        if doc is None:
+            return [Finding(_ENV_DOC, 1, 1, self.code,
+                            "docs/env_vars.md not found — the env-var "
+                            "catalog is gone", hint=self.hint,
+                            symbol="missing-doc")]
+        documented = {}  # canonical name -> first doc line
+        for i, line in enumerate(doc.splitlines(), 1):
+            m = _DOC_ROW_RE.match(line)
+            if not m:
+                continue
+            for var in _VAR_RE.findall(m.group(1)):
+                documented.setdefault(_canon(var), i)
+
+        for name in sorted(set(read_at) - set(documented)):
+            rel, node = read_at[name]
+            findings.append(Finding(
+                rel, node.lineno, node.col_offset + 1, self.code,
+                "env var %s is read here but has no row in "
+                "docs/env_vars.md" % name,
+                hint=self.hint, symbol=name))
+        for name in sorted(set(documented) - set(read_at)):
+            findings.append(Finding(
+                _ENV_DOC, documented[name], 1, self.code,
+                "documented env var %s is never read under mxnet_tpu/"
+                "tools/bench*.py — stale row (or the reader was "
+                "removed without the doc)" % name,
+                hint=self.hint, symbol=name))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# MX005
+
+_FAULTS_MOD = "mxnet_tpu/testing/faults.py"
+_INJECT = ("faults.inject", "inject", "testing.faults.inject",
+           "mxnet_tpu.testing.faults.inject")
+_ACTIVE = ("faults.active", "active")
+
+
+@register
+class FaultSiteRegistry(ProjectChecker):
+    """Every faults.inject(site) literal must be registered in
+    testing/faults.py SITES, names must be unique, and each registered
+    site needs at least one test exercising it — an unexercised fault
+    hook is dead chaos coverage."""
+
+    code = "MX005"
+    name = "fault-site-registry"
+    hint = ("register the site in mxnet_tpu/testing/faults.py SITES "
+            "with a description, and arm it from a chaos test "
+            "(MXNET_FAULT_INJECT=<site>:<action>)")
+
+    def check_project(self, project):
+        findings = []
+        sites, dupes, sites_node = self._registry(project)
+        if sites is None:
+            return [Finding(_FAULTS_MOD, 1, 1, self.code,
+                            "no SITES registry dict found in "
+                            "testing/faults.py", hint=self.hint,
+                            symbol="missing-registry")]
+        for name, line in dupes:
+            findings.append(Finding(
+                _FAULTS_MOD, line, 1, self.code,
+                "fault site %r registered twice in SITES" % name,
+                hint="keep one entry per site", symbol="dup:" + name))
+
+        used = {}  # site -> first (relpath, node)
+        for ctx in project.library_files():
+            if ctx.relpath == _FAULTS_MOD:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                callee = astutil.call_name(node, ctx.aliases)
+                if not astutil.matches(callee, _INJECT) and \
+                        not astutil.matches(callee, _ACTIVE):
+                    continue
+                lit = _literal_str(node.args[0])
+                if lit is None:
+                    continue  # dynamic site: judged at its callers
+                used.setdefault(lit, (ctx.relpath, node))
+                if lit not in sites:
+                    findings.append(Finding(
+                        ctx.relpath, node.lineno, node.col_offset + 1,
+                        self.code,
+                        "fault site %r is injected here but not "
+                        "registered in testing/faults.py SITES" % lit,
+                        hint=self.hint, symbol="unregistered:" + lit))
+
+        test_blob = self._tests_text(project)
+        for name in sorted(sites):
+            if test_blob is not None and \
+                    not re.search(r"\b%s\b" % re.escape(name),
+                                  test_blob):
+                findings.append(Finding(
+                    _FAULTS_MOD, sites[name], 1, self.code,
+                    "registered fault site %r is not referenced by any "
+                    "test under tests/ — no chaos coverage" % name,
+                    hint=self.hint, symbol="untested:" + name))
+        return findings
+
+    def _registry(self, project):
+        src = project.read(_FAULTS_MOD)
+        if src is None:
+            return None, [], None
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            return None, [], None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    any(isinstance(t, ast.Name) and t.id == "SITES"
+                        for t in node.targets) and \
+                    isinstance(node.value, ast.Dict):
+                sites, dupes = {}, []
+                for k in node.value.keys:
+                    lit = _literal_str(k)
+                    if lit is None:
+                        continue
+                    if lit in sites:
+                        dupes.append((lit, k.lineno))
+                    else:
+                        sites[lit] = k.lineno
+                return sites, dupes, node
+        return None, [], None
+
+    def _tests_text(self, project):
+        tests_dir = os.path.join(project.root, "tests")
+        if not os.path.isdir(tests_dir):
+            return None
+        chunks = []
+        for dirpath, dirnames, filenames in os.walk(tests_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    try:
+                        with open(os.path.join(dirpath, fn), "r",
+                                  encoding="utf-8",
+                                  errors="replace") as f:
+                            chunks.append(f.read())
+                    except OSError:
+                        pass
+        return "\n".join(chunks)

@@ -1,0 +1,94 @@
+"""MX003 — RNG discipline (the PR 9 fold_in contract).
+
+Library randomness must come from an explicitly-seeded generator (a
+``np.random.RandomState(seed)`` / ``Generator(Philox(key=...))``
+instance, ``mxnet_tpu.random.next_key()``) so runs replay bit-exactly
+across worker counts and resumes.  Global-state draws
+(``np.random.uniform``, ``random.random``, unseeded/time-seeded
+constructors) silently couple results to call order and wall clock.
+"""
+import ast
+
+from .. import astutil
+from ..engine import Checker, register
+
+# module-level stateful draws on the *global* numpy RNG
+_NP_GLOBAL = tuple(
+    "numpy.random." + f for f in (
+        "seed", "random", "rand", "randn", "randint", "random_sample",
+        "ranf", "sample", "uniform", "normal", "standard_normal",
+        "permutation", "shuffle", "choice", "beta", "binomial",
+        "multinomial", "poisson", "exponential", "gamma", "bytes",
+        "get_state", "set_state", "laplace", "lognormal", "vonmises",
+    ))
+# stdlib `random` module-level draws (the hidden global Random())
+_PY_GLOBAL = tuple(
+    "random." + f for f in (
+        "seed", "random", "randint", "randrange", "uniform", "shuffle",
+        "choice", "choices", "sample", "gauss", "normalvariate",
+        "betavariate", "expovariate", "getrandbits", "triangular",
+    ))
+# constructors that are fine seeded, wrong unseeded (OS/time entropy)
+_CONSTRUCTORS = ("numpy.random.RandomState", "numpy.random.default_rng",
+                 "random.Random", "numpy.random.Philox",
+                 "numpy.random.PCG64", "numpy.random.SeedSequence")
+_TIME_SOURCES = ("time.time", "time.time_ns", "time.monotonic",
+                 "time.perf_counter")
+# explicitly-keyed RNG namespaces — `jax.random.uniform(key, ...)` and
+# mxnet_tpu.random both thread keys and are exactly what MX003 wants
+_KEYED_PREFIXES = ("jax.", "mxnet_tpu.random.")
+
+
+@register
+class RngDiscipline(Checker):
+    """Raw np.random.* / random.* / time-seeded RNG in library code —
+    outside the sanctioned fold_in sites this breaks the replayability
+    contract (per-sample streams must be pure functions of
+    (seed, epoch, index))."""
+
+    code = "MX003"
+    name = "rng-discipline"
+    hint = ("draw from an explicitly-seeded generator (np.random."
+            "RandomState(seed) / Generator(Philox(key=fold_in(...))), "
+            "mxnet_tpu.random.next_key()); a sanctioned fold_in seeding "
+            "site carries # mxlint: disable=MX003")
+
+    def check(self, ctx):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = astutil.call_name(node, ctx.aliases)
+            if name is None or \
+                    name.startswith(_KEYED_PREFIXES):
+                continue
+            what = None
+            if astutil.matches(name, _NP_GLOBAL) or \
+                    astutil.matches(name, _PY_GLOBAL):
+                what = "global-state RNG draw %s()" % name
+            elif astutil.matches(name, _CONSTRUCTORS):
+                if self._entropy_seeded(node, ctx):
+                    what = ("%s() seeded from OS/time entropy — "
+                            "not replayable" % name)
+            if what is None:
+                continue
+            qn = astutil.qualname(node, ctx.parents)
+            findings.append(ctx.finding(
+                node, self.code,
+                "%s in %s" % (what, qn),
+                hint=self.hint,
+                symbol="%s:%s" % (qn, name)))
+        return findings
+
+    def _entropy_seeded(self, call, ctx):
+        """Unseeded constructor, or one seeded from a time source."""
+        args = list(call.args) + [k.value for k in call.keywords]
+        if not args:
+            return True
+        for a in args:
+            for sub in ast.walk(a):
+                if isinstance(sub, ast.Call) and astutil.matches(
+                        astutil.call_name(sub, ctx.aliases),
+                        _TIME_SOURCES):
+                    return True
+        return False

@@ -1,0 +1,161 @@
+"""MX006 — the PR 2/9 teardown contract.
+
+Anything that starts a ``Thread``/``Process``/``Timer`` must leave a
+deterministic way out: a class owning one (``self._thread = ...``)
+must define a ``close()``/``_halt()``-style method whose teardown path
+``join``s with a timeout (a join without a timeout is a hang waiting
+for a wedged worker); a function-local thread must be joined with a
+timeout in the same function.
+"""
+import ast
+
+from .. import astutil
+from ..engine import Checker, register
+
+_THREADLIKE = ("threading.Thread", "Thread", "threading.Timer", "Timer",
+               "multiprocessing.Process", "Process")
+_TEARDOWN_NAMES = {"close", "_close", "_halt", "halt", "stop", "_stop",
+                   "shutdown", "_shutdown", "join", "_join", "__exit__",
+                   "terminate", "_terminate", "teardown", "_teardown",
+                   "_drain", "flush", "release"}
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_threadlike(call, ctx):
+    return astutil.matches(astutil.call_name(call, ctx.aliases),
+                           _THREADLIKE)
+
+
+def _join_with_timeout(node):
+    """A ``x.join(...)`` call carrying a timeout (positional or
+    keyword), or a ``.cancel()`` (Timers)."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call) or \
+                not isinstance(sub.func, ast.Attribute):
+            continue
+        if sub.func.attr == "cancel":
+            return True
+        if sub.func.attr == "join" and (
+                sub.args or any(k.arg == "timeout"
+                                for k in sub.keywords)):
+            return True
+    return False
+
+
+@register
+class UnjoinedThread(Checker):
+    """A class that starts a Thread/Process without a close()/_halt()
+    teardown that joins-with-timeout (or a local thread never joined) —
+    leaked workers wedge interpreter exit and starve the next test."""
+
+    code = "MX006"
+    name = "unjoined-thread"
+    hint = ("add a close()/_halt() that sets the stop flag and "
+            "thread.join(timeout=...) (see io._ThreadedPrefetch"
+            "Teardown); a deliberate daemon watchdog carries "
+            "# mxlint: disable=MX006")
+
+    def check(self, ctx):
+        findings = []
+        classes = {n.name: n for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.ClassDef)}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not _is_threadlike(node, ctx):
+                continue
+            cls = astutil.enclosing(node, ctx.parents, (ast.ClassDef,))
+            fn = astutil.enclosing(node, ctx.parents, _FUNCS)
+            if cls is not None and self._stored_on_self(node, ctx):
+                if not self._class_tears_down(cls, classes):
+                    findings.append(ctx.finding(
+                        node, self.code,
+                        "class %r starts a %s but defines no "
+                        "close()/_halt()-style teardown that joins "
+                        "with a timeout"
+                        % (cls.name,
+                           astutil.call_name(node, ctx.aliases)),
+                        hint=self.hint, symbol=cls.name))
+            elif fn is not None:
+                if not _join_with_timeout(fn):
+                    qn = astutil.qualname(fn, ctx.parents)
+                    findings.append(ctx.finding(
+                        node, self.code,
+                        "%s started in %r is never joined with a "
+                        "timeout in that function"
+                        % (astutil.call_name(node, ctx.aliases), qn),
+                        hint=self.hint, symbol=qn))
+        return findings
+
+    def _stored_on_self(self, call, ctx):
+        """The created thread lands on an instance attribute (directly,
+        via an intermediate local that is later stored, or appended to
+        a self-owned list)."""
+        stmt = astutil.enclosing(
+            call, ctx.parents,
+            (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr))
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            names = []
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    return True
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+            if names:
+                fn = astutil.enclosing(call, ctx.parents, _FUNCS)
+                if fn is not None:
+                    for sub in ast.walk(fn):
+                        if isinstance(sub, ast.Assign):
+                            for t in sub.targets:
+                                if isinstance(t, ast.Attribute) and \
+                                        isinstance(sub.value, ast.Name) \
+                                        and sub.value.id in names:
+                                    return True
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call) and \
+                isinstance(stmt.value.func, ast.Attribute) and \
+                stmt.value.func.attr == "append":
+            holder = stmt.value.func.value
+            for sub in ast.walk(holder):
+                if isinstance(sub, ast.Name) and sub.id == "self":
+                    return True
+        return False
+
+    def _class_tears_down(self, cls, classes, _seen=None):
+        """``cls`` (or a same-module base) defines a teardown-named
+        method that joins with a timeout — directly or via a one-hop
+        self-method call."""
+        _seen = _seen or set()
+        if cls.name in _seen:
+            return False
+        _seen.add(cls.name)
+        methods = {m.name: m for m in cls.body if isinstance(m, _FUNCS)}
+        # BFS from the teardown-named entry points through self-method
+        # delegation (flush -> _raise_writer_error -> _join_writer)
+        queue = [m for name, m in methods.items()
+                 if name in _TEARDOWN_NAMES]
+        visited = set()
+        while queue:
+            m = queue.pop()
+            if m.name in visited:
+                continue
+            visited.add(m.name)
+            if _join_with_timeout(m):
+                return True
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id == "self" and \
+                        sub.func.attr in methods:
+                    queue.append(methods[sub.func.attr])
+        for base in cls.bases:
+            name = base.id if isinstance(base, ast.Name) else \
+                (base.attr if isinstance(base, ast.Attribute) else None)
+            if name in classes and self._class_tears_down(
+                    classes[name], classes, _seen):
+                return True
+        return False

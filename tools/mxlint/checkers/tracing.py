@@ -1,0 +1,155 @@
+"""MX001 (tracer host sync) and MX002 (collective placement).
+
+Both walk the functions :func:`astutil.traced_functions` proves run
+under a jax trace, with a parameter-derived taint pass marking the
+values that are actually tracers there.  Trace-time Python on *static*
+config (env flags, shapes, ``is None`` checks on closures) stays
+silent — only operations on tainted values fire.
+"""
+import ast
+
+from .. import astutil
+from ..engine import Checker, register
+
+# callables that force a device->host sync when handed a tracer
+_SYNC_CALLS = ("numpy.asarray", "numpy.array", "np.asarray", "np.array",
+               "jax.device_get", "device_get", "onp.asarray",
+               "onp.array")
+_SYNC_BUILTINS = ("float", "int", "bool", "complex")
+_SYNC_METHODS = {"item", "tolist", "__float__", "__int__"}
+
+_COLLECTIVES = ("lax.psum", "psum", "lax.pmean", "pmean",
+                "lax.all_gather", "all_gather", "lax.psum_scatter",
+                "psum_scatter", "lax.all_to_all", "all_to_all",
+                "lax.ppermute", "ppermute", "lax.pmax", "pmax",
+                "lax.pmin", "pmin", "lax.pshuffle")
+
+
+@register
+class TracerHostSync(Checker):
+    """float()/.item()/np.asarray()/device_get on a traced value inside
+    a jit/shard_map/scan-visible function — a silent per-step host sync
+    (or a ConcretizationTypeError at best)."""
+
+    code = "MX001"
+    name = "tracer-host-sync"
+    hint = ("keep the value on device (jnp ops / lax.cond), or move the "
+            "host read outside the traced function; a trace-time "
+            "constant read is fine — suppress with "
+            "# mxlint: disable=MX001")
+
+    def check(self, ctx):
+        findings = []
+        traced = astutil.traced_functions(ctx.tree, ctx.aliases,
+                                          ctx.parents)
+        for fn in traced:
+            tainted = astutil.tainted_names(fn, ctx.aliases)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    # don't blame the enclosing fn for a *nested* def's
+                    # body — that def is itself in `traced`
+                    owner = astutil.enclosing(
+                        node, ctx.parents,
+                        (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda))
+                    if owner is not fn:
+                        continue
+                    hit = self._sync_kind(node, ctx, tainted)
+                    if hit:
+                        qn = astutil.qualname(fn, ctx.parents)
+                        findings.append(ctx.finding(
+                            node, self.code,
+                            "%s on a traced value inside traced "
+                            "function %r forces a device sync"
+                            % (hit, qn),
+                            hint=self.hint,
+                            symbol="%s:%s" % (qn, hit)))
+        return findings
+
+    def _sync_kind(self, call, ctx, tainted):
+        name = astutil.call_name(call, ctx.aliases)
+        args = list(call.args) + [k.value for k in call.keywords]
+        if astutil.matches(name, _SYNC_BUILTINS) and args:
+            if any(astutil.contains_taint(a, tainted, ctx.aliases)
+                   for a in args):
+                return "%s()" % name
+            return None
+        if astutil.matches(name, _SYNC_CALLS):
+            if any(astutil.contains_taint(a, tainted, ctx.aliases)
+                   for a in args):
+                return name + "()"
+            return None
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _SYNC_METHODS:
+            if astutil.contains_taint(call.func.value, tainted,
+                                      ctx.aliases):
+                return ".%s()" % call.func.attr
+        return None
+
+
+@register
+class CollectivePlacement(Checker):
+    """psum/all_gather/... under value-dependent Python control flow
+    inside a traced function: each host traces its own branch, the
+    collective rosters diverge, and the job deadlocks — the shape the
+    PR 2/3 watchdogs only catch at runtime."""
+
+    code = "MX002"
+    name = "collective-placement"
+    hint = ("hoist the collective out of the branch, or make the branch "
+            "on-device (lax.cond keeps the collective in both traces); "
+            "config-static branches can be suppressed with "
+            "# mxlint: disable=MX002")
+
+    def check(self, ctx):
+        findings = []
+        traced = astutil.traced_functions(ctx.tree, ctx.aliases,
+                                          ctx.parents)
+        for fn in traced:
+            tainted = astutil.tainted_names(fn, ctx.aliases)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = astutil.call_name(node, ctx.aliases)
+                    if not astutil.matches(name, _COLLECTIVES):
+                        continue
+                    branch = self._value_dependent_branch(
+                        node, fn, ctx, tainted)
+                    if branch is None:
+                        continue
+                    qn = astutil.qualname(fn, ctx.parents)
+                    findings.append(ctx.finding(
+                        node, self.code,
+                        "collective %s at a value-dependent %s "
+                        "(line %d) inside traced function %r — hosts "
+                        "whose values differ trace different "
+                        "collective rosters and deadlock"
+                        % (name, branch.__class__.__name__.lower(),
+                           branch.lineno, qn),
+                        hint=self.hint,
+                        symbol="%s:%s" % (qn, name)))
+        return findings
+
+    def _value_dependent_branch(self, call, fn, ctx, tainted):
+        """Innermost enclosing if/while/for (within ``fn``) whose
+        test/iterable depends on a traced value, else None."""
+        cur = ctx.parents.get(call)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.If, ast.While)):
+                if astutil.contains_taint(cur.test, tainted,
+                                          ctx.aliases):
+                    return cur
+            elif isinstance(cur, ast.For):
+                if astutil.contains_taint(cur.iter, tainted,
+                                          ctx.aliases):
+                    return cur
+            elif isinstance(cur, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                return None  # nested def: judged on its own
+            cur = ctx.parents.get(cur)
+        return None
