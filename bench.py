@@ -348,6 +348,13 @@ def main():
             result["serving_tokens_per_sec"] = sv["tokens_per_sec"]
             result["serving_ttft_p99_s"] = sv["continuous_ttft_p99_s"]
             result["serving_bitexact"] = sv["bitexact"]
+            # speculative-decoding A/B row (spec-on vs spec-off on the
+            # low-concurrency rig; bench_serve.py has the full record)
+            result["serving_spec_speedup"] = sv["spec_speedup"]
+            result["serving_spec_bitexact"] = sv["bitexact_spec"]
+            result["serving_spec_acceptance_rate"] = sv["acceptance_rate"]
+            result["serving_spec_tokens_per_verify_step"] = \
+                sv["tokens_per_verify_step"]
         except Exception as exc:  # keep the primary metric robust
             result["serving_error"] = str(exc)[:200]
         _emit_partial()

@@ -68,10 +68,10 @@ def real_batch(n, rs):
 
 def main(args):
     rs = np.random.RandomState(0)
-    # parameter initializers draw from the process-global rng; seed it
-    # so the adversarial dynamics (seed-sensitive by nature) reproduce
-    mx.random.seed(0)
-    np.random.seed(0)
+    # parameter initializers are pure functions of (mx.random seed,
+    # parameter name); pin the seed so the adversarial dynamics
+    # (seed-sensitive by nature) reproduce
+    mx.random.seed(3)
     batch, z_dim = args.batch_size, 16
     ctx = mx.tpu(0)
 

@@ -21,6 +21,18 @@ __all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Constant",
 _registry = _Registry("initializer")
 
 
+def _param_rng(desc):
+    """Numpy stream for one parameter: a pure function of
+    (``mx.random`` seed, parameter name), so init values replay
+    bit-exactly regardless of init order or process count — the
+    fold_in contract (docs/static_analysis.md, MX003)."""
+    import zlib
+
+    h = zlib.crc32(str(desc).encode("utf-8"))
+    return np.random.RandomState(
+        (_random.current_seed() * 1000003 + h) % (2 ** 31))
+
+
 def register(klass):
     _registry.register(klass.__name__.lower(), klass)
     return klass
@@ -117,11 +129,9 @@ class Initializer:
     def _init_beta(self, _, arr):
         arr[:] = 0.0
 
-    def _init_rnn_parameters(self, _, arr):
-        import numpy as _np
-
-        arr[:] = _np.random.uniform(-0.07, 0.07,
-                                    arr.shape).astype("float32")
+    def _init_rnn_parameters(self, desc, arr):
+        arr[:] = _param_rng(desc).uniform(-0.07, 0.07,
+                                          arr.shape).astype("float32")
 
     def _init_bilinear(self, _, arr):
         weight = np.zeros(arr.size, dtype="float32")
@@ -148,8 +158,9 @@ class Uniform(Initializer):
         super().__init__(scale=scale)
         self.scale = scale
 
-    def _init_weight(self, _, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+    def _init_weight(self, desc, arr):
+        arr[:] = _param_rng(desc).uniform(-self.scale, self.scale,
+                                          arr.shape)
 
 
 @register
@@ -158,8 +169,8 @@ class Normal(Initializer):
         super().__init__(sigma=sigma)
         self.sigma = sigma
 
-    def _init_weight(self, _, arr):
-        arr[:] = np.random.normal(0, self.sigma, arr.shape)
+    def _init_weight(self, desc, arr):
+        arr[:] = _param_rng(desc).normal(0, self.sigma, arr.shape)
 
 
 @register
@@ -218,10 +229,11 @@ class Xavier(Initializer):
         else:
             raise MXNetError("Incorrect factor type")
         scale = math.sqrt(self.magnitude / factor)
+        rng = _param_rng(desc)
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, arr.shape)
+            arr[:] = rng.uniform(-scale, scale, arr.shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = np.random.normal(0, scale, arr.shape)
+            arr[:] = rng.normal(0, scale, arr.shape)
         else:
             raise MXNetError("Unknown random type")
 
@@ -243,13 +255,14 @@ class Orthogonal(Initializer):
         self.scale = scale
         self.rand_type = rand_type
 
-    def _init_weight(self, _, arr):
+    def _init_weight(self, desc, arr):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
+        rng = _param_rng(desc)
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = rng.normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = self.scale * q.reshape(arr.shape)

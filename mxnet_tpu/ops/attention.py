@@ -346,16 +346,20 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
     :func:`flash_attention`, built from the same :func:`attend_block`
     online-softmax primitive so the two paths cannot drift numerically.
 
-    q: (S, H, 1, D) — one query per batch slot; k_ctx/v_ctx:
+    q: (S, H, Q, D) — ``Q`` queries per batch slot (1 for the decode
+    step, ``K+1`` for the speculative verify step); k_ctx/v_ctx:
     (S, H, Tcap, D) — the slot's gathered KV pages, where ``Tcap`` is the
-    fixed page capacity and rows at positions >= ``lengths[s]`` are
+    fixed page capacity and rows at positions >= the valid length are
     stale/garbage; lengths: (S,) int — valid context length per slot
     (INCLUDING the current token, whose KV the caller appends before
-    attending).  ``Tcap`` must be a multiple of ``block`` (the page
-    size, for the paged cache).  Fully-masked blocks are exact no-ops in
-    the online merge (correction 1, p 0), so visiting all ``Tcap/block``
-    blocks with the validity mask reproduces the reference forward's
-    merge sequence bit-for-bit when ``mi=True``.
+    attending) — or (S, Q) int for a per-query-row valid length (the
+    verify step: row ``j`` at absolute position ``L + j`` sees exactly
+    ``L + j + 1`` keys, which is the causal mask expressed as raggedness).
+    ``Tcap`` must be a multiple of ``block`` (the page size, for the
+    paged cache).  Fully-masked blocks are exact no-ops in the online
+    merge (correction 1, p 0), so visiting all ``Tcap/block`` blocks
+    with the validity mask reproduces the reference forward's merge
+    sequence bit-for-bit when ``mi=True``.
     """
     d = q.shape[-1]
     t_cap = k_ctx.shape[-2]
@@ -376,8 +380,16 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
     acc0 = jnp.zeros(q.shape[:-1] + (v_ctx.shape[-1],), jnp.float32)
     m0 = jnp.full(q.shape[:-1] + (1,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
-    # (S, 1, 1, 1) so the mask broadcasts against (S, H, 1, block)
-    valid_len = lengths.reshape(lengths.shape + (1,) * (q.ndim - 1))
+    if lengths.ndim == 2:
+        if lengths.shape != (q.shape[0], q.shape[-2]):
+            raise MXNetError(
+                "decode_attention: per-row lengths %r do not match query "
+                "rows %r" % (lengths.shape, (q.shape[0], q.shape[-2])))
+        # (S, 1, Q, 1) so each query row carries its own validity horizon
+        valid_len = lengths[:, None, :, None]
+    else:
+        # (S, 1, 1, 1) so the mask broadcasts against (S, H, Q, block)
+        valid_len = lengths.reshape(lengths.shape + (1,) * (q.ndim - 1))
 
     def body(carry, xs):
         acc, m, l = carry

@@ -41,13 +41,16 @@ class PagedKVCache:
     """Fixed-pool paged KV cache for ``slots`` concurrent requests."""
 
     def __init__(self, num_layers, num_heads, head_dim, page_size,
-                 num_pages, slots, max_pages_per_slot, dtype=None):
+                 num_pages, slots, max_pages_per_slot, dtype=None,
+                 table_pad=0):
         import jax.numpy as jnp
         import numpy as np
 
         if min(num_layers, num_heads, head_dim, page_size, num_pages,
                slots, max_pages_per_slot) < 1:
             raise MXNetError("PagedKVCache: all dimensions must be >= 1")
+        if table_pad < 0:
+            raise MXNetError("PagedKVCache: table_pad must be >= 0")
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
@@ -55,6 +58,11 @@ class PagedKVCache:
         self.num_pages = int(num_pages)
         self.slots = int(slots)
         self.max_pages_per_slot = int(max_pages_per_slot)
+        # extra always-trash table columns past the reservable range, so
+        # executables that clip a past-the-reservation write position
+        # (the speculative verify's overflow rows) land on the trash
+        # page instead of aliasing the slot's last real page
+        self.table_pad = int(table_pad)
         self.trash_page = self.num_pages  # reserved last pool row
         dtype = dtype or jnp.float32
         pool_shape = (self.num_layers, self.num_pages + 1, self.page_size,
@@ -63,11 +71,16 @@ class PagedKVCache:
         self.v_pool = jnp.zeros(pool_shape, dtype)
         self._free_pages = list(range(self.num_pages - 1, -1, -1))
         self._free_slots = list(range(self.slots - 1, -1, -1))
-        self._tables = np.full((self.slots, self.max_pages_per_slot),
+        self._tables = np.full((self.slots, self.table_width),
                                self.trash_page, np.int32)
         self._pages_of = {}  # slot -> [page, ...]
         self.lengths = np.zeros((self.slots,), np.int32)
         self._tables_dev = None  # upload cache, invalidated on mutation
+
+    @property
+    def table_width(self):
+        """Page-table columns: reservable pages + the all-trash pad."""
+        return self.max_pages_per_slot + self.table_pad
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -126,6 +139,29 @@ class PagedKVCache:
         self._tables[slot, :] = self.trash_page
         self.lengths[slot] = 0
         self._tables_dev = None
+
+    def truncate(self, slot, n_tokens):
+        """Roll back the slot's last ``n_tokens`` KV rows (speculative-
+        decode rejection).  Host-side O(1): only ``lengths`` shrinks —
+        the slot's page reservation is untouched (pages were reserved
+        worst-case at admission, so there is nothing to return to the
+        free pool) and the vacated rows are invalidated deterministically
+        by the length mask every executable applies: positions >= the
+        new length are never read, and the next append overwrites them.
+        The device page-table upload cache is deliberately NOT touched
+        (the invalidate-only-on-alloc/release contract holds): tables do
+        not change here, and lengths re-upload every step anyway."""
+        if slot not in self._pages_of:
+            raise MXNetError("truncate of unallocated slot %r" % (slot,))
+        n = int(n_tokens)
+        if n < 0:
+            raise MXNetError("truncate(%r, %d): negative rollback"
+                             % (slot, n))
+        if n > int(self.lengths[slot]):
+            raise MXNetError(
+                "truncate(%r, %d): slot only holds %d tokens"
+                % (slot, n, int(self.lengths[slot])))
+        self.lengths[slot] -= n
 
     def active_slots(self):
         return sorted(self._pages_of)

@@ -41,8 +41,8 @@ from ..base import MXNetError, get_env
 from ..ops.attention import decode_attention, flash_attention
 
 __all__ = ["ModelConfig", "exact_mode", "init_params", "config_from_params",
-           "full_forward", "prefill_forward", "decode_step",
-           "reference_last_logits"]
+           "full_forward", "prefill_forward", "decode_step", "verify_step",
+           "draft_propose", "reference_last_logits"]
 
 
 def exact_mode():
@@ -303,8 +303,10 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # append this token's KV at (page, offset); inactive slots write
         # the trash page (their table rows are all-trash)
-        k_pool = k_pool.at[i, page, offset].set(k.reshape(s, h, d))
-        v_pool = v_pool.at[i, page, offset].set(v.reshape(s, h, d))
+        k_pool = k_pool.at[i, page, offset].set(
+            k.reshape(s, h, d).astype(k_pool.dtype))
+        v_pool = v_pool.at[i, page, offset].set(
+            v.reshape(s, h, d).astype(v_pool.dtype))
         # gather the slot's full page set: (S, P, page, H, D) ->
         # (S, H, P*page, D)
         ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
@@ -323,6 +325,123 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
         + params["lm_head_bias"]
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return next_tokens, logits, k_pool, v_pool
+
+
+def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
+                page_size, exact=None):
+    """Speculative-decoding verify: advance every slot ``W = K + 1``
+    teacher-forced positions in ONE fixed-shape step.
+
+    tokens: (S, W) int32 — per slot, the last committed token followed
+    by the draft's K proposals; lengths: (S,) int32 — committed KV rows
+    per slot (position of tokens[:, 0]); tables: (S, max_pages) int32.
+    Writes all W rows' KV at positions ``lengths .. lengths + W - 1``
+    and attends row ``j`` over exactly ``lengths + j + 1`` keys (the
+    causal horizon expressed as a per-row validity length), then
+    returns (greedy (S, W), logits (S, W, V), k_pool, v_pool).
+
+    Bit-exactness contract: with ``exact=True`` every op here is the
+    M-invariant form of the matching :func:`decode_step` op, and the
+    attention merge visits the same page blocks with the same masks —
+    so row ``j`` of one verify step is bit-identical to the ``j``-th of
+    W serial ``decode_step`` calls fed the same tokens.  That is what
+    makes greedy acceptance exact: comparing the draft's proposal to
+    ``greedy[:, j]`` is comparing against precisely what non-speculative
+    decode would have emitted.
+
+    Rows whose write position runs past the slot's page reservation
+    land on the trash page (the session widens the table by
+    ``spec_pad_pages`` all-trash columns so the page clip below can
+    never alias a real page); such rows are never committed, so their
+    garbage logits are dead by construction.
+    """
+    import jax.numpy as jnp
+
+    if exact is None:
+        exact = exact_mode()
+    s, w = tokens.shape
+    h, d = cfg.num_heads, cfg.head_dim
+    max_pages = tables.shape[1]
+    x = jnp.take(params["tok_embed_weight"], tokens.astype(jnp.int32),
+                 axis=0)
+    offs = jnp.arange(w, dtype=lengths.dtype)
+    abs_pos = lengths[:, None] + offs[None, :]            # (S, W)
+    pos = jnp.clip(abs_pos, 0, cfg.max_len - 1)
+    x = x + jnp.take(params["pos_embed"][0], pos, axis=0)
+    row_valid = abs_pos + 1                               # keys row j sees
+    page_slot = jnp.clip(abs_pos // page_size, 0, max_pages - 1)
+    pages = jnp.take_along_axis(tables, page_slot, axis=1)  # (S, W)
+    offsets = abs_pos % page_size
+    for i in range(cfg.num_layers):
+        hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
+                          params["blk%d_ln1_beta" % i])
+        qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
+            + params["blk%d_attn_in_bias" % i]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        k = k.reshape(s, w, h, d)
+        v = v.reshape(s, w, h, d)
+        # append all W rows' KV, then attend with per-row horizons: row
+        # j only ever reads rows <= j of this very step plus committed
+        # context, so write-then-attend reproduces the serial interleave
+        for j in range(w):
+            k_pool = k_pool.at[i, pages[:, j], offsets[:, j]].set(
+                k[:, j].astype(k_pool.dtype))
+            v_pool = v_pool.at[i, pages[:, j], offsets[:, j]].set(
+                v[:, j].astype(v_pool.dtype))
+        ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
+        ctx_v = v_pool[i][tables].reshape(s, max_pages * page_size, h, d)
+        ctx_k = ctx_k.transpose(0, 2, 1, 3)
+        ctx_v = ctx_v.transpose(0, 2, 1, 3)
+        att = decode_attention(q.reshape(s, w, h, d).transpose(0, 2, 1, 3),
+                               ctx_k, ctx_v, row_valid, block=page_size,
+                               mi=exact)
+        ctx = att.transpose(0, 2, 1, 3).reshape(s, w, cfg.d_model)
+        out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+            + params["blk%d_attn_out_bias" % i]
+        x = x + out
+        x = _block_mlp(params, i, x, exact)
+    x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
+    logits = _mm(x, params["lm_head_weight"], exact) \
+        + params["lm_head_bias"]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return greedy, logits, k_pool, v_pool
+
+
+def draft_propose(params, tokens, n_feed, lengths, tables, k_pool, v_pool,
+                  cfg, page_size, exact=None):
+    """Draft-model K+1-step scan: one dispatch that both *ingests*
+    committed tokens and *proposes* speculative continuations.
+
+    tokens: (S, W) int32 teacher tokens; n_feed: (S,) int32 — step ``j``
+    feeds ``tokens[s, j]`` while ``j < n_feed[s]`` and the draft's own
+    greedy output from step ``j - 1`` after that.  ``n_feed = 1`` is
+    propose mode (feed the last committed token, then autoregress);
+    ``n_feed = W`` is pure teacher forcing (prompt ingestion in W-token
+    chunks).  Every step appends its token's KV at ``lengths + j``, so
+    the draft cache tracks exactly the positions the target cache holds.
+    Returns (outs (S, W), k_pool, v_pool) where ``outs[:, j]`` is the
+    greedy token after feeding position ``lengths + j`` — propose mode
+    uses ``outs[:, :W-1]`` as its K proposals.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if exact is None:
+        exact = exact_mode()
+
+    def body(carry, xs):
+        prev, kp, vp = carry
+        teach, j = xs
+        tok = jnp.where(j < n_feed, teach, prev)
+        nxt, _, kp, vp = decode_step(params, tok, lengths + j, tables,
+                                     kp, vp, cfg, page_size, exact=exact)
+        return (nxt, kp, vp), nxt
+
+    w = tokens.shape[1]
+    xs = (tokens.T, jnp.arange(w, dtype=lengths.dtype))
+    (_, k_pool, v_pool), outs = lax.scan(
+        body, (tokens[:, 0].astype(jnp.int32), k_pool, v_pool), xs)
+    return outs.T, k_pool, v_pool
 
 
 @functools.lru_cache(maxsize=None)

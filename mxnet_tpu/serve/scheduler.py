@@ -19,11 +19,20 @@ run start) — the scheduler never back-pressures arrivals, so queueing
 delay shows up in TTFT exactly as a production load balancer would see
 it.
 
+With speculative decoding on (``session.config.spec_k > 0``) the
+per-step boundary calls :meth:`InferenceSession.spec_step` instead of
+:meth:`~InferenceSession.step` and a slot commits 1..K+1 tokens per
+boundary — the variable-advance accounting below consumes the committed
+tokens one at a time so EOS / ``max_new`` cut at exactly the token a
+non-speculative run would have stopped at (greedy acceptance is exact,
+so the streams are bit-identical).
+
 Fault sites (``testing/faults.py``): every admit / decode-step /
 response boundary crosses ``serve_queue`` plus a phase-specific site
-(``serve_admit`` / ``serve_decode`` / ``serve_respond``).  A fault
-fails *that request only*: its slot is released and surviving slots
-keep decoding — the chaos tests assert exactly this isolation.
+(``serve_admit`` / ``serve_decode`` — or ``serve_verify`` when
+speculation is on — / ``serve_respond``).  A fault fails *that request
+only*: its slot is released and surviving slots keep decoding — the
+chaos tests assert exactly this isolation.
 """
 from __future__ import annotations
 
@@ -138,23 +147,42 @@ class Scheduler(object):
                         time.sleep(min(wait, 0.05))
                 continue
 
-            # 2) per-request decode boundaries (deterministic slot order)
+            # 2) per-request step boundaries (deterministic slot order)
+            spec = getattr(sess.config, "spec_k", 0) > 0
+            site = "serve_verify" if spec else "serve_decode"
             for slot in sorted(active):
                 req = active[slot]
-                if not self._boundary(req, slot, "serve_decode"):
+                if not self._boundary(req, slot, site):
                     del active[slot]
 
             if not active:
                 continue
 
-            # 3) one fixed-shape decode step advances every survivor
-            step_tokens, _ = sess.step()
-            for slot in sorted(active):
-                req = active[slot]
-                req.tokens.append(step_tokens[slot])
-                if (len(req.tokens) >= req.max_new
-                        or step_tokens[slot] == req.eos_id):
-                    self._finish(req, slot, active, now)
+            # 3) one fixed-shape step advances every survivor — by one
+            # token (decode) or by 1..K+1 committed tokens (verify)
+            if spec:
+                limits = {slot: active[slot].max_new
+                          - len(active[slot].tokens) for slot in active}
+                committed = sess.spec_step(limits=limits)
+                for slot in sorted(active):
+                    req = active[slot]
+                    for tok in committed[slot]:
+                        req.tokens.append(tok)
+                        if (len(req.tokens) >= req.max_new
+                                or tok == req.eos_id):
+                            # EOS inside the speculated window: the
+                            # committed tail past it is dropped, exactly
+                            # where non-speculative decode would stop
+                            self._finish(req, slot, active, now)
+                            break
+            else:
+                step_tokens, _ = sess.step()
+                for slot in sorted(active):
+                    req = active[slot]
+                    req.tokens.append(step_tokens[slot])
+                    if (len(req.tokens) >= req.max_new
+                            or step_tokens[slot] == req.eos_id):
+                        self._finish(req, slot, active, now)
 
         return queue, now()
 

@@ -11,10 +11,28 @@ executable the session will ever run is compiled up front with
 
 Because every input shape is frozen (pools, page tables, token/length
 vectors), the compiled-executable count is exactly
-``len(buckets) + 1`` for the session's lifetime.  Each executable gets
-a ``compile_cache`` recompile guard seeded at compile time; a dispatch
-that would need a new trace (a bug) trips ``MXNET_RECOMPILE_WARN`` /
-``RecompileStorm`` just like training steps do.
+``len(buckets) + 1`` for the session's lifetime — or, with speculative
+decoding enabled (``spec_k > 0``), ``len(buckets) + 3``: the same
+prefill set and decode step plus one fixed-shape K+1-row **verify**
+executable and one **draft** decode executable (the draft executable is
+skipped for the host-side n-gram draft, giving ``len(buckets) + 2``).
+Each executable gets a ``compile_cache`` recompile guard seeded at
+compile time; a dispatch that would need a new trace (a bug) trips
+``MXNET_RECOMPILE_WARN`` / ``RecompileStorm`` just like training steps
+do.
+
+Speculative decoding (ROADMAP 3(b)): a draft proposes ``spec_k`` tokens
+per slot, the target model verifies all slots' proposals in ONE
+``spec_k + 1``-row teacher-forced step, and greedy acceptance commits
+the longest prefix the target agrees with — 1..K+1 tokens per step.
+Because verify runs under the same M-invariant ``exact`` mode as
+decode, acceptance is *exact*: row ``j`` of the verify is bit-identical
+to the ``j``-th serial decode step, so spec-on output == spec-off
+output token for token.  Rejected suffixes roll back through
+:meth:`PagedKVCache.truncate`; the draft keeps its own cache in
+lockstep.  Draft selection via ``MXNET_SERVE_DRAFT``: ``ngram`` (host
+prompt-lookup, no extra params), ``layers:N`` (the target's first N
+blocks — self-speculative layer skip), or a checkpoint directory.
 
 Model load goes through the v2 elastic checkpoint restore
 (:meth:`InferenceSession.from_checkpoint`), so an N-process training
@@ -22,7 +40,8 @@ run's shards serve directly in a single process.
 
 Env knobs (see docs/env_vars.md): ``MXNET_SERVE_SLOTS``,
 ``MXNET_SERVE_PAGE``, ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_NEW``,
-``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``.
+``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``, ``MXNET_SERVE_SPEC_K``,
+``MXNET_SERVE_DRAFT``.
 """
 from __future__ import annotations
 
@@ -32,8 +51,8 @@ import time
 
 from ..base import MXNetError, get_env
 from .kv_cache import PagedKVCache
-from .model import ModelConfig, config_from_params, decode_step, exact_mode, \
-    prefill_forward
+from .model import ModelConfig, config_from_params, decode_step, \
+    draft_propose, exact_mode, prefill_forward, verify_step
 
 __all__ = ["ServeConfig", "InferenceSession"]
 
@@ -55,7 +74,11 @@ class ServeConfig:
     ``buckets`` are padded prefill lengths (each a multiple of
     ``page_size``); ``max_new`` caps tokens generated per request;
     ``num_pages`` sizes the shared KV pool (default: full reservation
-    capacity for ``slots`` worst-case requests).
+    capacity for ``slots`` worst-case requests); ``spec_k`` > 0 turns
+    on speculative decoding with K draft proposals per step and
+    ``draft`` picks the proposer (``""``/``"ngram"`` host prompt-lookup,
+    ``"layers:N"`` target-derived truncation, else a checkpoint
+    directory).
     """
 
     slots: int = 4
@@ -64,6 +87,8 @@ class ServeConfig:
     max_new: int = 32
     num_pages: int = 0  # 0 = slots * max_pages_per_slot
     exact: bool = True
+    spec_k: int = 0  # 0 = speculative decoding off
+    draft: str = ""  # "", "ngram", "layers:N", or a checkpoint dir
 
     @classmethod
     def from_env(cls, **overrides):
@@ -75,6 +100,8 @@ class ServeConfig:
             max_new=get_env("MXNET_SERVE_MAX_NEW", cls.max_new, int),
             num_pages=get_env("MXNET_SERVE_PAGES", 0, int),
             exact=exact_mode(),
+            spec_k=get_env("MXNET_SERVE_SPEC_K", 0, int),
+            draft=get_env("MXNET_SERVE_DRAFT", "", str),
         )
         vals.update(overrides)
         return cls(**vals)
@@ -84,6 +111,8 @@ class ServeConfig:
         if self.slots < 1 or self.page_size < 1 or self.max_new < 1:
             raise MXNetError("ServeConfig: slots/page_size/max_new must "
                              "be >= 1")
+        if self.spec_k < 0:
+            raise MXNetError("ServeConfig: spec_k must be >= 0")
         for b in self.buckets:
             if b % self.page_size:
                 raise MXNetError(
@@ -98,6 +127,22 @@ class ServeConfig:
     @property
     def pool_pages(self):
         return self.num_pages or self.slots * self.max_pages_per_slot
+
+    @property
+    def spec_window(self):
+        """Verify rows per speculative step: the last committed token
+        plus the K proposals."""
+        return self.spec_k + 1
+
+    @property
+    def spec_pad_pages(self):
+        """All-trash page-table columns appended past the reservable
+        range.  A verify/draft step writes up to ``spec_k`` rows beyond
+        a slot's committed horizon; near the end of a request those can
+        cross the reservation boundary, and the executables' page-index
+        clip must then land on trash instead of aliasing the slot's
+        last real page."""
+        return -(-self.spec_k // self.page_size) if self.spec_k else 0
 
 
 class _Executable(object):
@@ -124,9 +169,17 @@ class InferenceSession(object):
     ``num_heads`` is required unless recoverable from a checkpoint
     symbol.  All executables are compiled in ``__init__`` — steady-state
     serving never traces.
+
+    With ``config.spec_k > 0`` the session also hosts a draft proposer:
+    pass ``draft_params`` (+ ``draft_num_heads``) explicitly, or let
+    ``config.draft`` resolve one (``"ngram"``, ``"layers:N"``, or a
+    checkpoint directory).  A parameterized draft gets its own
+    :class:`PagedKVCache` (same slot/page geometry, draft dims) that
+    the session keeps in exact lockstep with the target cache.
     """
 
-    def __init__(self, params, num_heads, config=None):
+    def __init__(self, params, num_heads, config=None, draft_params=None,
+                 draft_num_heads=None):
         import jax
         import jax.numpy as jnp
 
@@ -155,20 +208,94 @@ class InferenceSession(object):
             page_size=cfg.page_size,
             num_pages=cfg.pool_pages,
             slots=cfg.slots,
-            max_pages_per_slot=cfg.max_pages_per_slot)
+            max_pages_per_slot=cfg.max_pages_per_slot,
+            table_pad=cfg.spec_pad_pages)
         self._slot_tokens = {}  # slot -> next token to feed the decoder
+        self._slot_history = {}  # slot -> prompt + committed tokens
+        self._spec_stats = {"verify_steps": 0, "slot_steps": 0,
+                            "proposed": 0, "accepted": 0, "committed": 0}
+        self._resolve_draft(draft_params, draft_num_heads)
         self._exes = {}
         # Recompile guards live in the process-global registry; embed the
         # model + capacity fingerprint in the guard name so two sessions
         # with different shapes (different avals) don't share a guard and
         # read each other's compiles as retraces.  Identical-config
         # sessions deliberately share: same avals -> same signature.
+        # spec_k changes the table width (and adds executables), so it
+        # is part of the fingerprint.
         self._guard_prefix = (
             "InferenceSession(%dL-d%d-h%d-V%d-s%d-p%d-m%d-n%d)"
             % (self.model.num_layers, self.model.d_model,
                self.model.num_heads, self.model.vocab_size, cfg.slots,
                cfg.page_size, cfg.max_pages_per_slot, cfg.pool_pages))
+        if cfg.spec_k:
+            self._guard_prefix += "-k%d" % cfg.spec_k
         self._compile_all()
+
+    def _resolve_draft(self, draft_params, draft_num_heads):
+        """Pick the speculative proposer: explicit params, the host-side
+        n-gram lookup, a layer-truncated copy of the target, or a
+        checkpoint restore — then build its mirrored cache."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        self.draft_params = None
+        self.draft_model = None
+        self.draft_cache = None
+        self._draft_mode = "off"
+        if not cfg.spec_k:
+            if draft_params is not None:
+                raise MXNetError(
+                    "draft_params given but spec_k == 0 — set "
+                    "ServeConfig.spec_k (MXNET_SERVE_SPEC_K) to enable "
+                    "speculative decoding")
+            return
+        if draft_params is None:
+            spec = cfg.draft or "ngram"
+            if spec == "ngram":
+                self._draft_mode = "ngram"
+                return
+            if spec.startswith("layers:"):
+                n = int(spec.split(":", 1)[1])
+                draft_params = _layer_truncated(self.params, n)
+                draft_num_heads = draft_num_heads or self.model.num_heads
+            else:
+                from ..checkpoint import CheckpointManager
+
+                state = CheckpointManager(spec).load()
+                if draft_num_heads is None and state.symbol is not None:
+                    draft_num_heads = _num_heads_from_symbol(state.symbol)
+                draft_params = dict(state.arg_params)
+                draft_params.update(state.aux_params or {})
+        self._draft_mode = "model"
+        self.draft_params = {}
+        for k, v in draft_params.items():
+            if k in ("data", "softmax_label"):
+                continue
+            arr = getattr(v, "_data", v)
+            self.draft_params[k] = jnp.asarray(arr, jnp.float32)
+        self.draft_model = config_from_params(
+            self.draft_params,
+            num_heads=draft_num_heads or self.model.num_heads)
+        if self.draft_model.vocab_size != self.model.vocab_size:
+            raise MXNetError(
+                "draft vocab %d != target vocab %d — a draft must share "
+                "the target's token space"
+                % (self.draft_model.vocab_size, self.model.vocab_size))
+        if max(cfg.buckets) + cfg.max_new > self.draft_model.max_len:
+            raise MXNetError(
+                "draft max_len %d cannot cover the serve worst case %d"
+                % (self.draft_model.max_len,
+                   max(cfg.buckets) + cfg.max_new))
+        self.draft_cache = PagedKVCache(
+            num_layers=self.draft_model.num_layers,
+            num_heads=self.draft_model.num_heads,
+            head_dim=self.draft_model.head_dim,
+            page_size=cfg.page_size,
+            num_pages=cfg.pool_pages,
+            slots=cfg.slots,
+            max_pages_per_slot=cfg.max_pages_per_slot,
+            table_pad=cfg.spec_pad_pages)
 
     # -- compilation ------------------------------------------------------
     def _aot(self, name, fn, avals, donate_argnums):
@@ -230,7 +357,9 @@ class InferenceSession(object):
                        for k, v in self.params.items()}
         pool_shape = self.cache.k_pool.shape
         pool_aval = sds(pool_shape, f32)
-        max_pages = cfg.max_pages_per_slot
+        # table width includes the speculative all-trash pad columns
+        # (zero when spec_k == 0, so non-spec avals are unchanged)
+        max_pages = self.cache.table_width
 
         def decode_fn(params, tokens, lengths, tables, k_pool, v_pool):
             return decode_step(params, tokens, lengths, tables, k_pool,
@@ -254,6 +383,43 @@ class InferenceSession(object):
                 (param_avals, sds((1, bucket), i32), sds((), i32),
                  sds((max_pages,), i32), pool_aval, pool_aval),
                 donate_argnums=(4, 5))
+
+        if cfg.spec_k:
+            w = cfg.spec_window
+
+            def verify_fn(params, tokens, lengths, tables, k_pool,
+                          v_pool):
+                return verify_step(params, tokens, lengths, tables,
+                                   k_pool, v_pool, model, psize,
+                                   exact=exact)
+
+            self._aot(
+                "verify", verify_fn,
+                (param_avals, sds((cfg.slots, w), i32),
+                 sds((cfg.slots,), i32), sds((cfg.slots, max_pages), i32),
+                 pool_aval, pool_aval),
+                donate_argnums=(4, 5))
+
+        if self._draft_mode == "model":
+            w = cfg.spec_window
+            dmodel = self.draft_model
+            draft_avals = {k: sds(v.shape, v.dtype)
+                           for k, v in self.draft_params.items()}
+            dpool_aval = sds(self.draft_cache.k_pool.shape, f32)
+
+            def draft_fn(params, tokens, n_feed, lengths, tables, k_pool,
+                         v_pool):
+                return draft_propose(params, tokens, n_feed, lengths,
+                                     tables, k_pool, v_pool, dmodel,
+                                     psize, exact=exact)
+
+            self._aot(
+                "draft", draft_fn,
+                (draft_avals, sds((cfg.slots, w), i32),
+                 sds((cfg.slots,), i32), sds((cfg.slots,), i32),
+                 sds((cfg.slots, max_pages), i32), dpool_aval,
+                 dpool_aval),
+                donate_argnums=(5, 6))
 
     @classmethod
     def from_checkpoint(cls, directory, prefix="model", epoch=None,
@@ -309,7 +475,16 @@ class InferenceSession(object):
         if max_new > self.config.max_new:
             raise MXNetError("max_new %d exceeds the session cap %d"
                              % (max_new, self.config.max_new))
-        return self.cache.alloc(prompt_len, max_new)
+        slot = self.cache.alloc(prompt_len, max_new)
+        if slot is not None and self.draft_cache is not None:
+            # identical geometry + identical alloc/release sequences keep
+            # the two caches' deterministic free lists in lockstep
+            dslot = self.draft_cache.alloc(prompt_len, max_new)
+            if dslot != slot:
+                raise MXNetError(
+                    "draft cache desync: target slot %r vs draft slot %r"
+                    % (slot, dslot))
+        return slot
 
     def prefill(self, slot, prompt_tokens):
         """Run the bucketed prefill for ``slot``; returns
@@ -332,7 +507,41 @@ class InferenceSession(object):
         self.cache.lengths[slot] = p
         first = int(first)
         self._slot_tokens[slot] = first
+        self._slot_history[slot] = [int(t) for t in prompt] + [first]
+        if self._draft_mode == "model":
+            self._draft_ingest(slot, prompt)
         return first, np.asarray(last_logits)
+
+    def _draft_ingest(self, slot, prompt):
+        """Teacher-force the prompt through the draft executable in
+        W-token chunks so the draft cache holds the same positions the
+        target prefill just wrote.  The single scan executable serves
+        both ingest and propose (``n_feed`` switches the mode), keeping
+        the executable count frozen.  Rows a chunk writes past its feed
+        horizon — and the rows written for *other* active slots, whose
+        ``n_feed`` is 0 — are junk beyond each slot's committed length;
+        the next draft call overwrites those exact positions before any
+        validity mask admits them."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        cfg = self.config
+        w = cfg.spec_window
+        p = int(prompt.shape[0])
+        for off in range(0, p, w):
+            chunk = prompt[off:off + w]
+            toks = np.zeros((cfg.slots, w), np.int32)
+            toks[slot, :len(chunk)] = chunk
+            n_feed = np.zeros((cfg.slots,), np.int32)
+            n_feed[slot] = len(chunk)
+            args = (self.draft_params, jnp.asarray(toks),
+                    jnp.asarray(n_feed), self.draft_cache.device_lengths(),
+                    self.draft_cache.device_tables(),
+                    self.draft_cache.k_pool, self.draft_cache.v_pool)
+            _, dk_pool, dv_pool = self._dispatch("draft", args)
+            self.draft_cache.k_pool = dk_pool
+            self.draft_cache.v_pool = dv_pool
+            self.draft_cache.lengths[slot] = off + len(chunk)
 
     def step(self):
         """Advance every active slot one token with the single decode
@@ -358,12 +567,133 @@ class InferenceSession(object):
             self.cache.lengths[slot] += 1
             tok = int(next_np[slot])
             self._slot_tokens[slot] = tok
+            if slot in self._slot_history:
+                self._slot_history[slot].append(tok)
             out[slot] = tok
         return out, np.asarray(logits)
 
+    def spec_step(self, limits=None):
+        """One speculative step for every active slot: draft proposes K
+        tokens, ONE fixed-shape verify teacher-forces all ``W = K + 1``
+        rows through the target, and greedy acceptance commits the
+        longest agreeing prefix (1..W tokens — always at least one, the
+        target's own greedy continuation, so progress is unconditional).
+
+        ``limits`` (slot -> int) caps how many tokens a slot may commit
+        this step (the scheduler passes ``max_new - emitted`` so a slot
+        never overruns its page reservation).  Returns slot ->
+        ``[committed tokens]``, bit-identical to what the same number of
+        :meth:`step` calls would have emitted — exactness of the verify
+        kernel makes acceptance a pure integer comparison.
+
+        Both caches advance ``W`` rows then roll back the rejected
+        suffix via :meth:`PagedKVCache.truncate`, so target and draft
+        lengths stay equal and every retained row's KV belongs to a
+        committed token.
+        """
+        import numpy as np
+        import jax.numpy as jnp
+
+        cfg = self.config
+        if not cfg.spec_k:
+            raise MXNetError("spec_step on a session with spec_k == 0 — "
+                             "set MXNET_SERVE_SPEC_K / ServeConfig.spec_k")
+        out = {}
+        if not self._slot_tokens:
+            return out
+        w, k = cfg.spec_window, cfg.spec_k
+        active = sorted(self._slot_tokens)
+        tokens = np.zeros((cfg.slots, w), np.int32)
+        for slot, tok in self._slot_tokens.items():
+            tokens[slot, 0] = tok
+        if self._draft_mode == "model":
+            dtoks = np.zeros((cfg.slots, w), np.int32)
+            dtoks[:, 0] = tokens[:, 0]
+            n_feed = np.ones((cfg.slots,), np.int32)
+            args = (self.draft_params, jnp.asarray(dtoks),
+                    jnp.asarray(n_feed), self.draft_cache.device_lengths(),
+                    self.draft_cache.device_tables(),
+                    self.draft_cache.k_pool, self.draft_cache.v_pool)
+            outs, dk_pool, dv_pool = self._dispatch("draft", args)
+            self.draft_cache.k_pool = dk_pool
+            self.draft_cache.v_pool = dv_pool
+            tokens[:, 1:] = np.asarray(outs)[:, :k]
+        else:
+            for slot in active:
+                tokens[slot, 1:] = self._ngram_propose(slot, k)
+        args = (self.params, jnp.asarray(tokens),
+                self.cache.device_lengths(), self.cache.device_tables(),
+                self.cache.k_pool, self.cache.v_pool)
+        greedy, _, k_pool, v_pool = self._dispatch("verify", args)
+        self.cache.k_pool = k_pool
+        self.cache.v_pool = v_pool
+        greedy = np.asarray(greedy)
+        self._spec_stats["verify_steps"] += 1
+        for slot in active:
+            limit = w
+            if limits is not None:
+                limit = max(1, min(w, int(limits.get(slot, w))))
+            # commit greedy[:c]: row 0 unconditionally, then one more
+            # per proposal the target's previous row agreed with
+            c = 1
+            while c < limit and tokens[slot, c] == greedy[slot, c - 1]:
+                c += 1
+            committed = [int(t) for t in greedy[slot, :c]]
+            self.cache.lengths[slot] += w
+            self.cache.truncate(slot, w - c)
+            if self.draft_cache is not None:
+                self.draft_cache.lengths[slot] += w
+                self.draft_cache.truncate(slot, w - c)
+            self._slot_tokens[slot] = committed[-1]
+            self._slot_history[slot].extend(committed)
+            # proposals past the commit limit never had a chance, so
+            # they don't count against the draft's acceptance rate
+            self._spec_stats["slot_steps"] += 1
+            self._spec_stats["proposed"] += limit - 1
+            self._spec_stats["accepted"] += c - 1
+            self._spec_stats["committed"] += c
+            out[slot] = committed
+        return out
+
+    def _ngram_propose(self, slot, k, max_n=3):
+        """Prompt-lookup draft: match the longest suffix n-gram of the
+        slot's history (prompt + committed tokens, ending at the pending
+        feed token) against an earlier occurrence and propose its
+        continuation; shortfall pads with the last token.  Zero
+        executables, zero params — the fallback draft."""
+        hist = self._slot_history.get(slot) or [0]
+        for n in range(min(max_n, len(hist) - 1), 0, -1):
+            pat = hist[-n:]
+            for start in range(len(hist) - n - 1, -1, -1):
+                if hist[start:start + n] == pat:
+                    cont = hist[start + n:start + n + k]
+                    if cont:
+                        out = list(cont)
+                        while len(out) < k:
+                            out.append(out[-1])
+                        return out
+        return [hist[-1]] * k
+
+    def spec_report(self):
+        """Speculation counters: ``acceptance_rate`` = accepted /
+        proposed (proposals with a chance to commit), and
+        ``tokens_per_verify_step`` = committed tokens per slot per
+        verify dispatch (1..K+1 — the decode-throughput multiplier)."""
+        rep = dict(self._spec_stats)
+        rep["acceptance_rate"] = (
+            rep["accepted"] / float(rep["proposed"])
+            if rep["proposed"] else 0.0)
+        rep["tokens_per_verify_step"] = (
+            rep["committed"] / float(rep["slot_steps"])
+            if rep["slot_steps"] else 0.0)
+        return rep
+
     def release(self, slot):
         self._slot_tokens.pop(slot, None)
+        self._slot_history.pop(slot, None)
         self.cache.release(slot)
+        if self.draft_cache is not None:
+            self.draft_cache.release(slot)
 
     def active_slots(self):
         return sorted(self._slot_tokens)
@@ -371,7 +701,9 @@ class InferenceSession(object):
     # -- accounting -------------------------------------------------------
     @property
     def executables(self):
-        """name -> compiled executable (fixed set: buckets + decode)."""
+        """name -> compiled executable.  Fixed set for the session's
+        lifetime: prefill per bucket + decode, plus verify (and draft,
+        for a parameterized proposer) when ``spec_k > 0``."""
         return {name: rec.compiled for name, rec in self._exes.items()}
 
     def memory_analysis(self, name="decode"):
@@ -385,6 +717,30 @@ class InferenceSession(object):
 
     def fallback_count(self):
         return sum(rec.fallbacks for rec in self._exes.values())
+
+
+def _layer_truncated(params, n):
+    """Derive a draft from the target's own weights: its first ``n``
+    decoder blocks plus the shared embedding / final-LN / head — the
+    self-speculative "layer skip" draft.  ``n`` equal to the full depth
+    yields an (expensive, always-accepting) identity draft, useful for
+    exactness tests."""
+    total = 0
+    while "blk%d_attn_in_weight" % total in params:
+        total += 1
+    n = int(n)
+    if not 1 <= n <= total:
+        raise MXNetError(
+            "draft layers:%d out of range (target has %d blocks)"
+            % (n, total))
+    keep = {"tok_embed_weight", "pos_embed", "final_ln_gamma",
+            "final_ln_beta", "lm_head_weight", "lm_head_bias"}
+    out = {}
+    for key, val in params.items():
+        if key in keep or (key.startswith("blk")
+                           and int(key[3:].split("_", 1)[0]) < n):
+            out[key] = val
+    return out
 
 
 def _num_heads_from_symbol(symbol):

@@ -67,10 +67,11 @@ the backward re-gathers all run inside the one bounded dispatch, so a
 ``delay`` past ``MXNET_KV_TIMEOUT_S`` reports the gather as the stuck
 collective by name), ``serve_queue`` (the serving scheduler —
 crossed at *every* request boundary) plus its phase-specific companions
-``serve_admit`` / ``serve_decode`` / ``serve_respond`` (admission,
-per-request decode-step, and response boundaries; a fault fails that
-one request and releases its slot — surviving slots keep decoding, the
-isolation the serve chaos tests assert).  The serve sites fire in
+``serve_admit`` / ``serve_decode`` / ``serve_verify`` /
+``serve_respond`` (admission, per-request decode-step, per-request
+speculative propose/verify-step, and response boundaries; a fault fails
+that one request and releases its slot — surviving slots keep decoding,
+the isolation the serve chaos tests assert).  The serve sites fire in
 deterministic slot order each step, so ``after=N`` picks a specific
 request.  ``data_decode`` fires inside each data-service decode task
 (in the worker *process* with ``num_workers > 0`` — hits are counted
@@ -120,6 +121,8 @@ SITES = {
     "serve_queue": "serving scheduler, every request boundary",
     "serve_admit": "serving scheduler admission boundary",
     "serve_decode": "serving scheduler per-request decode step",
+    "serve_verify": "serving scheduler per-request speculative "
+                    "propose/verify step",
     "serve_respond": "serving scheduler response boundary",
     "data_decode": "inside each data-service decode task (worker "
                    "process, or inline with num_workers=0)",
