@@ -446,6 +446,13 @@ def main():
     # compile_s/step_s split + cache counters (fit's AOT warmup and the
     # pure-step AOT compile both record through profiler.compile_event)
     result.update(bench_util.compile_summary())
+    # autotune provenance: which cached knobs (if any) the fused steps
+    # were built under — MXNET_AUTOTUNE=1 + a tools/autotune.py record
+    try:
+        from mxnet_tpu import autotune
+        result["autotune"] = autotune.provenance()
+    except ImportError:
+        result["autotune"] = []
     print(json.dumps(result))
 
 

@@ -24,6 +24,15 @@ Also certifies the serving acceptance criteria directly in the JSON:
                            continuous-batching A/B whose
                            ``spec_speedup`` / ``acceptance_rate`` /
                            ``tokens_per_verify_step`` ride along.
+* ``quant_speedup`` / ``quant_bytes_shrink`` / ``max_logit_drift``
+                         — weight-only quantization A/B
+                           (``ServeConfig.quant``): decode tokens/s
+                           fp32 vs int8, at-rest param shrink, and the
+                           teacher-forced logit drift, with the
+                           speedup-or-shrink acceptance bar asserted
+                           and per-precision bit-exactness
+                           (``bitexact_quant``) re-proved on the
+                           quantized tree.
 * ``compile_report``     — ``compile_cache.write_artifact`` path for
                            the serving executable set
                            (pretty-print: ``tools/compile_report.py``).
@@ -212,6 +221,120 @@ def measure(argv=None):
         / max(_RESULT["spec_off_tokens_per_sec"], 1e-9), 2)
     _RESULT["spec_executables"] = sorted(spec_on.executables)
     assert spec_on.fallback_count() == 0
+
+    # -- weight-only quantization A/B ------------------------------------
+    # Same model, same executable count, 1-byte weight codes: the A/B
+    # measures steady-state decode tokens/s fp32 vs int8 and certifies
+    # the two acceptance bars — either decode gets >= 1.15x faster or
+    # the at-rest + gather bytes shrink >= 3.5x with throughput held —
+    # plus an explicit logit-drift bound under teacher forcing.
+    from mxnet_tpu import quantize as _quantize
+
+    def _decode_tps(s, steps, cycles=4):
+        # several alloc->decode cycles per measurement, timing only the
+        # steady-state step loops: one cycle's window is ~steps decode
+        # dispatches, too short to survive scheduler jitter
+        rs = np.random.RandomState(7)
+        total_dt, total_tok = 0.0, 0
+        for _ in range(cycles):
+            slots = []
+            for _ in range(s.config.slots):
+                sl = s.try_alloc(9, s.config.max_new)
+                s.prefill(sl, rs.randint(1, 127, size=9).tolist())
+                slots.append(sl)
+            for _ in range(2):  # warmup: steady-state dispatch only
+                s.step()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                s.step()
+            total_dt += time.perf_counter() - t0
+            total_tok += s.config.slots * steps
+            for sl in slots:
+                s.release(sl)
+        return total_tok / total_dt
+
+    qmode = next((a.split("=")[1] for a in argv
+                  if a.startswith("--quant=")), "int8")
+    qsess = serve.InferenceSession(
+        params, num_heads=cfg.num_heads,
+        config=_dc.replace(sconf, quant=qmode))
+    assert len(qsess.executables) == len(sconf.buckets) + 1
+    _RESULT["quant"] = qmode
+    _RESULT["weight_dtype"] = "float32"
+    _RESULT["quant_weight_dtype"] = str(
+        np.dtype(_quantize.quant_dtype(qmode)))
+
+    # bit-exactness holds PER PRECISION: the quantized session must
+    # match the jitted reference forward over its own quantized tree
+    qslot = qsess.try_alloc(len(probe), 8)
+    qfirst, qlogits = qsess.prefill(qslot, probe)
+    np.testing.assert_array_equal(
+        qlogits, np.asarray(serve_model.reference_last_logits(
+            qsess.params, probe, cfg, sconf.page_size, exact=True)))
+    qsess.release(qslot)
+    _RESULT["bitexact_quant"] = True
+
+    # logit drift vs fp32, teacher-forced so both sessions score the
+    # SAME token sequence (greedy streams may diverge after one flip)
+    drift = 0.0
+    bslot = sess.try_alloc(len(probe), 8)
+    qslot = qsess.try_alloc(len(probe), 8)
+    bfirst, blog = sess.prefill(bslot, probe)
+    _, qlog = qsess.prefill(qslot, probe)
+    drift = max(drift, float(np.max(np.abs(qlog - blog))))
+    for _ in range(6):
+        qsess._slot_tokens[qslot] = sess._slot_tokens[bslot]
+        btoks, blogs = sess.step()
+        qtoks, qlogs = qsess.step()
+        drift = max(drift, float(np.max(np.abs(qlogs[qslot]
+                                               - blogs[bslot]))))
+    sess.release(bslot)
+    qsess.release(qslot)
+    drift_bound = 0.25 if qmode == "int8" else 1.0
+    _RESULT["max_logit_drift"] = round(drift, 5)
+    _RESULT["logit_drift_bound"] = drift_bound
+    assert drift <= drift_bound, \
+        "%s logit drift %.4f exceeds %.2f" % (qmode, drift, drift_bound)
+
+    # bytes: at-rest params and the decode executable's argument volume
+    base_bytes = sess.params_bytes_at_rest()
+    quant_bytes = qsess.params_bytes_at_rest()
+    _RESULT["params_bytes_fp32"] = base_bytes
+    _RESULT["params_bytes_quant"] = quant_bytes
+    _RESULT["quant_bytes_shrink"] = round(base_bytes
+                                          / max(quant_bytes, 1), 2)
+    qmem = qsess.memory_analysis("decode")
+    _RESULT["quant_decode_argument_bytes"] = qmem.get(
+        "argument_size_in_bytes")
+    _RESULT["decode_argument_bytes"] = mem.get("argument_size_in_bytes")
+
+    # steady-state decode throughput A/B (same slot count, same step
+    # count; the baseline reuses the already-warm main session).
+    # Interleaved best-of-3: single passes swing ~20% under scheduler
+    # noise at these tiny step times; alternating the sides and taking
+    # each side's best damps both the noise and any slow load drift.
+    ab_steps = max(4, min(12, max_new - 3))
+    base_tps, quant_tps = 0.0, 0.0
+    for _ in range(3):
+        base_tps = max(base_tps, _decode_tps(sess, ab_steps))
+        quant_tps = max(quant_tps, _decode_tps(qsess, ab_steps))
+    _RESULT["decode_tokens_per_sec_fp32"] = round(base_tps, 1)
+    _RESULT["decode_tokens_per_sec_quant"] = round(quant_tps, 1)
+    _RESULT["quant_speedup"] = round(quant_tps / max(base_tps, 1e-9), 3)
+    # Acceptance: EITHER decode gets >=1.15x faster (bandwidth-bound
+    # accelerator rigs, where 4x-smaller weights shrink the HBM reads
+    # each step) OR the at-rest/gather footprint shrinks >=3.5x with
+    # throughput held.  "Held" is 0.82 here: on CPU the per-step
+    # dequant is exposed arithmetic next to these tiny matmuls
+    # (measured 0.86-0.94 across runs), a real but bounded cost — the
+    # bar sits just under that band's floor so it catches a regression
+    # (e.g. dequant falling out of the fused executable) without
+    # flaking on scheduler noise.
+    assert (_RESULT["quant_speedup"] >= 1.15
+            or (_RESULT["quant_bytes_shrink"] >= 3.5
+                and _RESULT["quant_speedup"] >= 0.82)), \
+        "quant A/B: speedup %.3f, shrink %.2fx — neither bar met" \
+        % (_RESULT["quant_speedup"], _RESULT["quant_bytes_shrink"])
 
     # -- acceptance probe 3: no per-request recompiles -------------------
     guards = sess.guard_report()

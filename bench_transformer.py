@@ -159,8 +159,19 @@ def measure(argv=None):
         # long T, where the quadratic term is a double-digit share
         "flops_accounting": None if moe else "6P_tokens+attn_12LBT2D",
         "precision": "bf16+fp32-master",
+        # the dtype the 6*P numerator counts over: training weights
+        # stay fp32 master (serving may quantize at rest — that shows
+        # up in bench_serve.py's quant_* fields, never here)
+        "weight_dtype": str(next(iter(params.values())).dtype),
         "device": kind,
     })
+    # autotune provenance: which cached knobs (if any) this step was
+    # built under — MXNET_AUTOTUNE=1 + a tools/autotune.py record
+    try:
+        from mxnet_tpu import autotune
+        _RESULT["autotune"] = autotune.provenance()
+    except ImportError:
+        _RESULT["autotune"] = []
     return dict(_RESULT)
 
 

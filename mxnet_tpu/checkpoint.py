@@ -426,6 +426,15 @@ class CheckpointManager:
                     "canonical_shape": [int(s)
                                         for s in ent["canonical_shape"]],
                 }
+                if ent.get("quant"):
+                    # weight-only quantized tiles (quantize.quantize_export):
+                    # codes ride the pieces, mode + per-channel scales ride
+                    # the manifest (float32 via JSON is bit-exact)
+                    zparams_meta[name]["quant"] = {
+                        "mode": str(ent["quant"]["mode"]),
+                        "scales": [float(s)
+                                   for s in ent["quant"]["scales"]],
+                    }
                 _add(key, ent["leaf"])
         if zero_states is None and self.save_optimizer_states and \
                 module is not None:
@@ -760,6 +769,14 @@ class CheckpointManager:
                 arrays[key] = arrays[key].reshape(-1)[
                     :int(ent["logical"])].reshape(
                     [int(s) for s in ent["canonical_shape"]])
+                if ent.get("quant"):
+                    # quantized tile save: expand the codes back to
+                    # float32 with the manifest scales, so every restore
+                    # topology sees ordinary full-precision params
+                    from .quantize import dequantize_with_meta
+
+                    arrays[key] = dequantize_with_meta(
+                        arrays[key], ent["quant"])
         arg_params, aux_params = {}, {}
         resolved_mesh, rule_shardings = self._restore_layout(
             mesh, sharding, arrays)

@@ -422,9 +422,16 @@ ARTIFACT_KIND = "mxnet_tpu-compile-report"
 
 def report():
     """The full compile-time picture of this process: persistent-cache
-    stats, the recompile registry, and every recorded compile event."""
+    stats, the recompile registry, every recorded compile event, and
+    the autotune knob applications the build ran under."""
     from . import profiler
 
+    try:
+        from . import autotune as _autotune
+
+        tuned = _autotune.provenance()
+    except ImportError:
+        tuned = []
     return {
         "kind": ARTIFACT_KIND,
         "pid": os.getpid(),
@@ -432,6 +439,7 @@ def report():
         "cache": cache_stats(),
         "recompiles": registry.report(),
         "compile_events": profiler.compile_events(),
+        "autotune": tuned,
     }
 
 

@@ -161,6 +161,11 @@ class TrainStep:
         # before anything lowers, so this process's compiles are
         # reusable by the next one
         ensure_initialized()
+        # cached autotune knobs (MXNET_AUTOTUNE=1) arm their env vars
+        # BEFORE anything traces — the ops read them at trace time
+        from . import autotune as _autotune
+
+        self._autotune_applied = _autotune.apply_train_env(symbol, mesh)
         self.symbol = symbol
         self._fwd_fn, self._arg_names, self._aux_names = _trace_fn(
             symbol, is_train=True)

@@ -257,14 +257,20 @@ def gather_param(flat, entry, mesh):
     return jnp.reshape(full[:entry.logical], entry.shape)
 
 
-def gather_bucket(flats, entries, mesh, axis):
+def gather_bucket(flats, entries, mesh, axis, scales=None):
     """ZeRO-3 on-demand gather of one layer bucket: flat 1/N tiles back
     to full parameter shapes.  Context-aware: inside the explicit-DDP
     ``shard_map`` trace the tiles are LOCAL values and the gather is one
     tuple ``lax.all_gather(tiled=True)`` per bucket (a single
     schedulable collective whose transpose is the grad reduce-scatter);
     under GSPMD it is a replication constraint per tensor and XLA
-    places/combines the gathers itself."""
+    places/combines the gathers itself.
+
+    ``scales``: optional per-entry sequence for weight-only quantized
+    tiles (``quantize.quantize_flat_leaf`` layout) — ``None`` members
+    pass through, the rest dequantize AFTER the collective, so the
+    gather moves 1-byte codes (~4x fewer network bytes) and only the
+    full gathered copy pays the float32 expansion."""
     import jax
     import jax.numpy as jnp
 
@@ -279,6 +285,12 @@ def gather_bucket(flats, entries, mesh, axis):
         repl = _replicated(mesh)
         fulls = tuple(jax.lax.with_sharding_constraint(f, repl)
                       for f in flats)
+    if scales is not None:
+        from .. import quantize as _quant
+
+        fulls = tuple(
+            f if s is None else _quant.dequant_flat(f, e, s)
+            for f, e, s in zip(fulls, entries, scales))
     return [jnp.reshape(f[:e.logical], e.shape)
             for f, e in zip(fulls, entries)]
 
@@ -529,11 +541,24 @@ def update_gather_bytes(lay):
                for e in lay.values() if e.sharded)
 
 
-def zero3_gather_bytes(lay):
+def zero3_gather_bytes(lay, quant=None):
     """Bytes the ZeRO-3 bucketed gathers move per step: every sharded
     parameter is gathered once for forward and re-gathered once by the
-    rematerialized backward."""
-    return 2 * update_gather_bytes(lay)
+    rematerialized backward.  ``quant`` (``"int8"``/``"fp8"``) accounts
+    the weight-only quantized interchange: eligible tiles move as
+    1-byte codes (scales are replicated and don't ride the gather)."""
+    from .. import quantize as _quant
+
+    mode = _quant.quant_mode(quant)
+    total = 0
+    for e in lay.values():
+        if not e.sharded:
+            continue
+        itemsize = e.dtype.itemsize
+        if mode and _quant.eligible(e.shape, e.dtype):
+            itemsize = _quant.quant_dtype(mode).itemsize
+        total += e.padded * itemsize
+    return 2 * total
 
 
 # -- fault/bounded dispatch ------------------------------------------------

@@ -1,0 +1,316 @@
+"""Weight-only quantization of at-rest parameters: int8 and fp8 (e4m3).
+
+Serving and the ZeRO-3 interchange spend their bytes on parameters at
+rest — executable arguments, gather collectives, checkpoint tiles — not
+on matmul math.  This module quantizes exactly that at-rest form:
+weights are stored as int8 (symmetric, scale = amax / 127) or fp8
+e4m3 (scale = amax / 448) with per-output-channel float32 scales, and
+dequantized back to float32 right where compute needs them — inside the
+traced serving functions (``serve/model.py``), inside the ZeRO-3 gather
+bucket (``parallel/zero.py``), or at checkpoint restore
+(``checkpoint._load_epoch``).  Matmuls always run full precision; only
+storage and movement shrink (~4x for int8/fp8 vs float32).
+
+Scale layout: for a canonical weight of shape ``(F, ...)`` (the
+``FullyConnected`` ``(out, in)`` convention) the scale vector has one
+entry per output channel ``F``, computed from the amax over the
+remaining axes.  For the ZeRO-3 flat tiles the channel of flat index
+``i`` is ``min(i // prod(shape[1:]), F - 1)`` — a pure function of the
+CANONICAL shape, not of the tiling, so quantization commutes with the
+flat-pad interchange: an N-way quantized tile save restores on M
+replicas or unsharded bit-exactly (same stored codes, same scales).
+
+Determinism contract: quantization is numpy ``rint``/cast arithmetic in
+float32 (bit-stable across processes and runs), and dequantization is
+an elementwise convert + multiply — the same IEEE ops whether executed
+by numpy on the host or fused into an XLA executable.  That is what
+lets the serving bit-exactness oracle work *per precision*: a quantized
+session's decode step and its batched verify step dequantize to
+identical weight values, so the M-invariant exact mode's guarantees
+carry over unchanged (see ``serve/model.py``).
+
+Eligibility: floating weights with ``ndim >= 2`` and at least
+``MIN_QUANT_BYTES`` of storage.  Biases, LayerNorm vectors, and scalars
+stay float32 — quantizing them saves nothing and costs accuracy.
+"""
+from __future__ import annotations
+
+import math
+
+from .base import MXNetError
+
+__all__ = ["MODES", "quant_mode", "quant_dtype", "eligible",
+           "quantize_array", "dequantize_array", "quantize_params",
+           "dequantize_params", "is_quantized", "at_rest_bytes",
+           "quantize_flat_leaf", "dequant_flat", "quantize_export",
+           "dequantize_with_meta"]
+
+MODES = ("int8", "fp8")
+INT8_MAX = 127.0
+FP8_MAX = 448.0  # float8_e4m3fn largest finite
+MIN_QUANT_BYTES = 1024
+
+
+def quant_mode(mode):
+    """Normalize a quant-mode spec: ``""``/``"off"``/``"none"``/``"0"``
+    -> ``""`` (disabled), else one of :data:`MODES`."""
+    raw = str(mode or "").strip().lower()
+    if raw in ("", "off", "none", "0", "false", "fp32", "float32"):
+        return ""
+    if raw in ("int8", "i8"):
+        return "int8"
+    if raw in ("fp8", "f8", "e4m3", "float8", "float8_e4m3fn"):
+        return "fp8"
+    raise MXNetError("quant mode must be off|int8|fp8 (got %r)" % (mode,))
+
+
+def quant_dtype(mode):
+    """The storage numpy dtype for ``mode`` (``ml_dtypes`` supplies the
+    fp8 e4m3 type, same as the PR 5 checkpoint dtype support)."""
+    import numpy as np
+
+    mode = quant_mode(mode)
+    if mode == "int8":
+        return np.dtype(np.int8)
+    if mode == "fp8":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.float8_e4m3fn)
+    raise MXNetError("quant_dtype: mode is off")
+
+
+def _qmax(mode):
+    return INT8_MAX if mode == "int8" else FP8_MAX
+
+
+def eligible(shape, dtype, min_bytes=MIN_QUANT_BYTES):
+    """Whether a canonical weight of ``shape``/``dtype`` is worth
+    quantizing: floating, matrix-or-higher rank, and at least
+    ``min_bytes`` of storage."""
+    import numpy as np
+
+    dtype = np.dtype(dtype)
+    if dtype.kind != "f" or len(shape) < 2:
+        return False
+    size = int(math.prod(int(s) for s in shape)) if shape else 1
+    return size * dtype.itemsize >= min_bytes
+
+
+def _scales(amax, mode):
+    """amax per channel -> float32 scales; all-zero channels get scale
+    1.0 so dequantization never divides by (or multiplies into) zero."""
+    import numpy as np
+
+    qmax = _qmax(mode)
+    amax = np.asarray(amax, np.float32)
+    return np.where(amax > 0, amax / np.float32(qmax),
+                    np.float32(1.0)).astype(np.float32)
+
+
+def quantize_array(arr, mode):
+    """Symmetric weight-only quantization of one canonical array.
+
+    Returns ``(q, scale)``: ``q`` has the storage dtype and ``scale``
+    is a broadcast-ready float32 array — per-output-channel (axis 0,
+    shape ``(F, 1, ..., 1)``) for ``ndim >= 2``, per-tensor (shape
+    ``()``) for vectors.  Pure numpy in float32: bit-stable across
+    processes.
+    """
+    import numpy as np
+
+    mode = quant_mode(mode)
+    if not mode:
+        raise MXNetError("quantize_array: mode is off")
+    x = np.asarray(arr, np.float32)
+    if x.ndim >= 2:
+        axes = tuple(range(1, x.ndim))
+        amax = np.max(np.abs(x), axis=axes, keepdims=True)
+    else:
+        amax = np.max(np.abs(x), keepdims=False) if x.size else 0.0
+    scale = _scales(amax, mode)
+    y = x / scale
+    if mode == "int8":
+        q = np.clip(np.rint(y), -INT8_MAX, INT8_MAX).astype(np.int8)
+    else:
+        q = np.clip(y, -FP8_MAX, FP8_MAX).astype(quant_dtype(mode))
+    return q, scale
+
+
+def dequantize_array(q, scale):
+    """Elementwise convert + multiply back to float32.  Works on host
+    numpy arrays and on jax values/tracers alike — the math is the same
+    IEEE float32 ops either way, which is what keeps the host oracle
+    and the in-graph dequantization bit-identical."""
+    import numpy as np
+
+    if isinstance(q, np.ndarray):
+        return q.astype(np.float32) * np.asarray(scale, np.float32)
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale
+
+
+def is_quantized(value):
+    """Whether one params-tree value is a quantized ``{"q", "s"}``
+    record rather than a plain array."""
+    return isinstance(value, dict) and "q" in value and "s" in value
+
+
+def quantize_params(params, mode, min_bytes=MIN_QUANT_BYTES):
+    """Quantize a flat name->array params dict for serving: eligible
+    weights become ``{"q": codes, "s": scales}`` sub-dicts (a plain
+    pytree — avals, jit signatures, and donation all see through it),
+    the rest pass through unchanged.  Leaves come back as jax arrays."""
+    import jax.numpy as jnp
+
+    mode = quant_mode(mode)
+    if not mode:
+        return dict(params)
+    out = {}
+    for name, v in params.items():
+        if is_quantized(v):
+            out[name] = v
+            continue
+        shape = tuple(getattr(v, "shape", ()))
+        if eligible(shape, v.dtype, min_bytes):
+            q, s = quantize_array(v, mode)
+            out[name] = {"q": jnp.asarray(q), "s": jnp.asarray(s)}
+        else:
+            out[name] = v
+    return out
+
+
+def dequantize_params(params):
+    """Resolve a (possibly quantized) params tree to plain float32
+    arrays.  Traceable — the serving functions call this at the top so
+    dequantization fuses into each executable; calling it eagerly gives
+    the host-side oracle view, bit-identical by the determinism
+    contract above."""
+    out = {}
+    for name, v in params.items():
+        out[name] = dequantize_array(v["q"], v["s"]) if is_quantized(v) \
+            else v
+    return out
+
+
+def at_rest_bytes(params):
+    """Storage bytes of a params tree as held (codes + scales for
+    quantized entries, full precision otherwise) — the at-rest memory
+    claim the bench shrink ratios report."""
+    import numpy as np
+
+    total = 0
+    for v in params.values():
+        leaves = (v["q"], v["s"]) if is_quantized(v) else (v,)
+        for leaf in leaves:
+            shape = tuple(getattr(leaf, "shape", ()))
+            size = int(math.prod(int(s) for s in shape)) if shape else 1
+            total += size * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+# -- ZeRO-3 flat-tile interchange ------------------------------------------
+#
+# Flat tiles are canonical weights reshaped (-1,) and zero-padded to
+# ``entry.padded`` (see parallel/zero.py).  The per-channel scale of flat
+# index i is scale[min(i // row, F - 1)] with row = prod(shape[1:]) —
+# padding lanes read the last channel's scale and hold zeros, so they
+# quantize to 0 and dequantize to 0.0 regardless.
+
+def _channel_index(entry):
+    """Traceable (padded,) int32 channel index for one layout entry."""
+    import jax.numpy as jnp
+
+    shape = entry.shape
+    row = max(1, int(math.prod(shape[1:])))
+    channels = max(1, int(shape[0]) if shape else 1)
+    idx = jnp.arange(entry.padded, dtype=jnp.int32) // row
+    return jnp.minimum(idx, channels - 1)
+
+
+def quantize_flat_leaf(leaf, entry, mode):
+    """Quantize one at-rest flat tile (``(padded,)``, canonical order)
+    with scales computed from the CANONICAL shape, so the result is
+    independent of the save topology's padding.  Runs as jax ops (the
+    leaf may be a sharded global array whose shards this process cannot
+    np.asarray).  Returns ``(q_flat, scales)`` with ``scales`` a
+    ``(F,)`` float32 vector."""
+    import jax.numpy as jnp
+
+    mode = quant_mode(mode)
+    if not mode:
+        raise MXNetError("quantize_flat_leaf: mode is off")
+    canonical = jnp.reshape(leaf[:entry.logical], entry.shape)
+    axes = tuple(range(1, len(entry.shape)))
+    amax = jnp.max(jnp.abs(canonical.astype(jnp.float32)), axis=axes)
+    qmax = _qmax(mode)
+    scales = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+    sflat = scales[_channel_index(entry)]
+    y = leaf.astype(jnp.float32) / sflat
+    if mode == "int8":
+        q = jnp.clip(jnp.round(y), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    else:
+        q = jnp.clip(y, -FP8_MAX, FP8_MAX).astype(quant_dtype(mode))
+    return q, scales
+
+
+def dequant_flat(flat, entry, scales):
+    """Traceable dequantization of one full (gathered) flat tile —
+    the ``gather_bucket`` hook: the collective moved 1-byte codes, this
+    multiplies the replicated per-channel scales back in."""
+    import jax.numpy as jnp
+
+    sflat = jnp.asarray(scales, jnp.float32).reshape(-1)[
+        _channel_index(entry)]
+    return flat.astype(jnp.float32) * sflat
+
+
+def quantize_export(zparams, mode, min_bytes=MIN_QUANT_BYTES):
+    """Quantize a :func:`zero.export_params` descriptor for checkpoint
+    save: eligible flat tiles swap their ``leaf`` for quantized codes
+    and grow a ``quant`` record (mode + scales as a JSON-exact float
+    list — float32 -> float64 -> float32 round-trips bitwise).  The
+    restore path (:func:`dequantize_with_meta`) reverses it after the
+    standard flat->canonical trim, so any topology — M replicas or
+    unsharded — sees identical full-precision values."""
+    import numpy as np
+
+    mode = quant_mode(mode)
+    if not mode:
+        return zparams
+
+    class _Ent(object):
+        __slots__ = ("shape", "logical", "padded")
+
+    out = {}
+    for name, ent in zparams.items():
+        shape = tuple(int(s) for s in ent["canonical_shape"])
+        leaf = ent["leaf"]
+        if not (ent.get("flat") and eligible(shape, leaf.dtype, min_bytes)):
+            out[name] = ent
+            continue
+        e = _Ent()
+        e.shape = shape
+        e.logical = int(ent["logical"])
+        e.padded = int(leaf.shape[0])
+        q, scales = quantize_flat_leaf(leaf, e, mode)
+        rec = dict(ent)
+        rec["leaf"] = q
+        rec["quant"] = {
+            "mode": mode,
+            "scales": [float(s) for s in
+                       np.asarray(scales, np.float32).reshape(-1)],
+        }
+        out[name] = rec
+    return out
+
+
+def dequantize_with_meta(arr, qmeta):
+    """Restore-side inverse of :func:`quantize_export`: ``arr`` is the
+    trimmed canonical-shape array of codes, ``qmeta`` the manifest's
+    ``quant`` record.  Host numpy, float32 out."""
+    import numpy as np
+
+    scales = np.asarray(qmeta["scales"], np.float32)
+    scale = scales.reshape((scales.size,) + (1,) * (arr.ndim - 1))
+    return np.asarray(arr).astype(np.float32) * scale

@@ -72,6 +72,22 @@ class ModelConfig:
         return self
 
 
+def _resolve_params(params):
+    """See through a weight-only quantized params tree (name ->
+    ``{"q", "s"}``, see ``mxnet_tpu.quantize``): dequantize to float32
+    *inside* the traced function, so the executable's arguments stay
+    1-byte codes while every matmul runs full precision.  Dequantization
+    is an elementwise convert + multiply, so the resolved weight VALUES
+    are identical across executables — which is why the M-invariant
+    bit-exactness contract below holds per precision (quantized serial
+    decode == quantized batched verify)."""
+    if any(isinstance(v, dict) for v in params.values()):
+        from ..quantize import dequantize_params
+
+        return dequantize_params(params)
+    return params
+
+
 def _mm(x, w, exact):
     """``x (..., C) @ w (F, C)^T -> (..., F)`` — the ``FullyConnected``/
     MHA-projection contraction.  ``exact`` selects the M-invariant
@@ -144,8 +160,13 @@ def config_from_params(params, num_heads):
             "not a transformer LM parameter dict (expected "
             "tok_embed_weight / pos_embed; got %s)"
             % sorted(params)[:8])
-    vocab, d_model = params["tok_embed_weight"].shape
-    max_len = params["pos_embed"].shape[1]
+
+    def _shape(v):
+        # quantized entries keep the canonical shape on their codes
+        return v["q"].shape if isinstance(v, dict) else v.shape
+
+    vocab, d_model = _shape(params["tok_embed_weight"])
+    max_len = _shape(params["pos_embed"])[1]
     n = 0
     while "blk%d_attn_in_weight" % n in params:
         n += 1
@@ -207,6 +228,7 @@ def full_forward(params, tokens, cfg, exact=None, block=None,
 
     if exact is None:
         exact = exact_mode()
+    params = _resolve_params(params)
     t = tokens.shape[-1]
     if t > cfg.max_len:
         raise MXNetError("sequence length %d > model max_len %d"
@@ -285,6 +307,7 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
 
     if exact is None:
         exact = exact_mode()
+    params = _resolve_params(params)
     s = tokens.shape[0]
     h, d = cfg.num_heads, cfg.head_dim
     max_pages = tables.shape[1]
@@ -359,6 +382,7 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
 
     if exact is None:
         exact = exact_mode()
+    params = _resolve_params(params)
     s, w = tokens.shape
     h, d = cfg.num_heads, cfg.head_dim
     max_pages = tables.shape[1]
@@ -428,6 +452,9 @@ def draft_propose(params, tokens, n_feed, lengths, tables, k_pool, v_pool,
 
     if exact is None:
         exact = exact_mode()
+    # resolve once, outside the scan body, so the dequantized weights
+    # are loop invariants XLA hoists rather than per-step work
+    params = _resolve_params(params)
 
     def body(carry, xs):
         prev, kp, vp = carry

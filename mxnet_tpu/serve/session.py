@@ -38,10 +38,21 @@ Model load goes through the v2 elastic checkpoint restore
 (:meth:`InferenceSession.from_checkpoint`), so an N-process training
 run's shards serve directly in a single process.
 
+Weight-only quantization (``ServeConfig.quant`` / ``MXNET_SERVE_QUANT``,
+``int8`` or ``fp8``): eligible weights are stored as 1-byte codes with
+per-channel scales (see ``mxnet_tpu.quantize``) and dequantized INSIDE
+each executable — at-rest and argument bytes shrink ~4x, the executable
+count stays frozen, and because dequantization is deterministic
+elementwise math the M-invariant exact mode still certifies bit-
+exactness per precision (quantized decode == quantized verify, so
+speculative decoding composes unchanged).  Quantized and full-precision
+sessions never alias recompile guards: the guard prefix grows a
+``-q<mode>`` tag.
+
 Env knobs (see docs/env_vars.md): ``MXNET_SERVE_SLOTS``,
 ``MXNET_SERVE_PAGE``, ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_NEW``,
 ``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``, ``MXNET_SERVE_SPEC_K``,
-``MXNET_SERVE_DRAFT``.
+``MXNET_SERVE_DRAFT``, ``MXNET_SERVE_QUANT``.
 """
 from __future__ import annotations
 
@@ -50,6 +61,7 @@ import json
 import time
 
 from ..base import MXNetError, get_env
+from ..quantize import quant_mode
 from .kv_cache import PagedKVCache
 from .model import ModelConfig, config_from_params, decode_step, \
     draft_propose, exact_mode, prefill_forward, verify_step
@@ -89,6 +101,7 @@ class ServeConfig:
     exact: bool = True
     spec_k: int = 0  # 0 = speculative decoding off
     draft: str = ""  # "", "ngram", "layers:N", or a checkpoint dir
+    quant: str = ""  # "", "int8", or "fp8" weight-only quantization
 
     @classmethod
     def from_env(cls, **overrides):
@@ -102,12 +115,14 @@ class ServeConfig:
             exact=exact_mode(),
             spec_k=get_env("MXNET_SERVE_SPEC_K", 0, int),
             draft=get_env("MXNET_SERVE_DRAFT", "", str),
+            quant=get_env("MXNET_SERVE_QUANT", "", str),
         )
         vals.update(overrides)
         return cls(**vals)
 
     def __post_init__(self):
         object.__setattr__(self, "buckets", _parse_buckets(self.buckets))
+        object.__setattr__(self, "quant", quant_mode(self.quant))
         if self.slots < 1 or self.page_size < 1 or self.max_new < 1:
             raise MXNetError("ServeConfig: slots/page_size/max_new must "
                              "be >= 1")
@@ -187,6 +202,13 @@ class InferenceSession(object):
 
         compile_cache.ensure_initialized()
         self.config = config or ServeConfig.from_env()
+        if config is None:
+            # env-driven config: a cached autotune record for this
+            # (model-fingerprint, backend) may override knobs (opt-in
+            # via MXNET_AUTOTUNE; provenance rides the compile report)
+            from .. import autotune as _autotune
+
+            self.config = _autotune.apply_serve(self.config, params)
         cfg = self.config
         self.params = {}
         for k, v in params.items():
@@ -215,6 +237,16 @@ class InferenceSession(object):
         self._spec_stats = {"verify_steps": 0, "slot_steps": 0,
                             "proposed": 0, "accepted": 0, "committed": 0}
         self._resolve_draft(draft_params, draft_num_heads)
+        if cfg.quant:
+            # weight-only quantization of the at-rest params (the draft
+            # shares the mode): eligible weights become {"q", "s"} code/
+            # scale records that every executable dequantizes in-graph
+            from .. import quantize as _quant
+
+            self.params = _quant.quantize_params(self.params, cfg.quant)
+            if self.draft_params is not None:
+                self.draft_params = _quant.quantize_params(
+                    self.draft_params, cfg.quant)
         self._exes = {}
         # Recompile guards live in the process-global registry; embed the
         # model + capacity fingerprint in the guard name so two sessions
@@ -230,6 +262,10 @@ class InferenceSession(object):
                cfg.page_size, cfg.max_pages_per_slot, cfg.pool_pages))
         if cfg.spec_k:
             self._guard_prefix += "-k%d" % cfg.spec_k
+        if cfg.quant:
+            # quantized avals differ from full-precision ones, so the
+            # sessions must never share a guard fingerprint
+            self._guard_prefix += "-q%s" % cfg.quant
         self._compile_all()
 
     def _resolve_draft(self, draft_params, draft_num_heads):
@@ -353,8 +389,10 @@ class InferenceSession(object):
         f32 = jax.numpy.float32
         i32 = jax.numpy.int32
         sds = jax.ShapeDtypeStruct
-        param_avals = {k: sds(v.shape, v.dtype)
-                       for k, v in self.params.items()}
+        # tree.map sees through quantized {"q", "s"} records, so the
+        # executables' arguments are the 1-byte codes themselves
+        param_avals = jax.tree.map(lambda v: sds(v.shape, v.dtype),
+                                   self.params)
         pool_shape = self.cache.k_pool.shape
         pool_aval = sds(pool_shape, f32)
         # table width includes the speculative all-trash pad columns
@@ -403,8 +441,8 @@ class InferenceSession(object):
         if self._draft_mode == "model":
             w = cfg.spec_window
             dmodel = self.draft_model
-            draft_avals = {k: sds(v.shape, v.dtype)
-                           for k, v in self.draft_params.items()}
+            draft_avals = jax.tree.map(lambda v: sds(v.shape, v.dtype),
+                                       self.draft_params)
             dpool_aval = sds(self.draft_cache.k_pool.shape, f32)
 
             def draft_fn(params, tokens, n_feed, lengths, tables, k_pool,
@@ -710,6 +748,24 @@ class InferenceSession(object):
         """Compile-time ``memory_analysis()`` numbers for one
         executable — the decode entry is the flat per-step watermark."""
         return dict(self._exes[name].memory)
+
+    def params_bytes_at_rest(self):
+        """Bytes the serving params occupy as held — quantized codes +
+        scales under ``config.quant``, full precision otherwise (the
+        bench shrink ratios compare the two)."""
+        from ..quantize import at_rest_bytes
+
+        return at_rest_bytes(self.params)
+
+    def dequantized_params(self):
+        """Plain float32 view of the serving params — for a quantized
+        session, exactly the weight values the executables' in-graph
+        dequantization computes (elementwise convert + multiply is
+        bit-identical on host and in-graph).  Full-precision sessions
+        get the params as-is."""
+        from ..quantize import dequantize_params
+
+        return dequantize_params(self.params)
 
     def guard_report(self):
         return {name: rec.guard.snapshot() for name, rec in

@@ -1,0 +1,326 @@
+"""Persistent autotuner (mxnet_tpu/autotune.py): store round trips,
+greedy search + the zero-re-measure cache-hit contract, temp-bytes
+tie-breaking, and the MXNET_AUTOTUNE apply hooks (InferenceSession /
+TrainStep) with compile-report provenance.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import autotune, serve
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import model as serve_model
+
+CFG = serve.ModelConfig(vocab_size=61, num_layers=2, d_model=32,
+                        num_heads=2, max_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Every test gets a throwaway store and a clean applied log."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path / "store"))
+    monkeypatch.delenv("MXNET_AUTOTUNE", raising=False)
+    monkeypatch.delenv("MXNET_AUTOTUNE_BUDGET_S", raising=False)
+    autotune.clear_applied()
+    yield
+    autotune.clear_applied()
+
+
+def _space():
+    return [autotune.Knob("block", (128, 64, 32)),
+            autotune.Knob("bucket_mb", (4, 1))]
+
+
+def _key(kind="train", fp="abc123def456"):
+    return autotune.Key(kind, fp, backend="cpu")
+
+
+# ---------------------------------------------------------------------------
+# keys + store
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_stable_and_shape_sensitive():
+    params = {"w": np.zeros((4, 4), np.float32),
+              "b": np.zeros(4, np.float32)}
+    fp = autotune.fingerprint(params)
+    assert len(fp) == 12
+    assert fp == autotune.fingerprint(dict(reversed(params.items())))
+    other = {"w": np.zeros((4, 5), np.float32),
+             "b": np.zeros(4, np.float32)}
+    assert fp != autotune.fingerprint(other)
+    # quantized {"q","s"} records fingerprint by their code array, so
+    # a session quantized after apply_serve still matches its record
+    from mxnet_tpu import quantize
+
+    big = {"w": np.zeros((32, 32), np.float32)}
+    assert autotune.fingerprint(big) == autotune.fingerprint(
+        quantize.quantize_params(big, "int8"))
+
+
+def test_knob_requires_values():
+    with pytest.raises(MXNetError):
+        autotune.Knob("empty", ())
+
+
+def test_store_roundtrip(tmp_path):
+    store = autotune.AutotuneStore(str(tmp_path / "s"))
+    key = _key()
+    assert store.get(key) is None
+    rec = {"kind": "train", "knobs": {"block": 64}, "metric": 2.5}
+    path = store.put(key, rec)
+    assert os.path.basename(path) == "autotune-%s.json" % key.slug
+    assert store.get(key) == rec
+    assert store.records() == [rec]
+    # a different backend/mesh/model is a different record
+    assert store.get(autotune.Key("train", "abc123def456",
+                                  backend="tpu")) is None
+    assert store.get(autotune.Key("train", "feedbeefcafe",
+                                  backend="cpu")) is None
+
+
+# ---------------------------------------------------------------------------
+# search + the cache-hit contract
+# ---------------------------------------------------------------------------
+
+def test_search_picks_best_and_persists(tmp_path):
+    store = autotune.AutotuneStore(str(tmp_path / "s"))
+    rates = {(128, 4): 1.0, (64, 4): 3.0, (32, 4): 2.0,
+             (64, 1): 4.0}
+
+    def measure(knobs):
+        return rates.get((knobs["block"], knobs["bucket_mb"]), 0.5)
+
+    rec = autotune.search(measure, _space(), _key(), store=store)
+    assert rec["cache_hit"] is False
+    # coordinate descent: block sweep lands on 64, then the bucket
+    # sweep improves it to (64, 1)
+    assert rec["knobs"] == {"block": 64, "bucket_mb": 1}
+    assert rec["metric"] == 4.0
+    assert rec["baseline_metric"] == 1.0
+    assert rec["speedup_vs_default"] == pytest.approx(4.0)
+    # baseline + 2 non-default blocks + 1 non-default bucket
+    assert rec["measurements"] == 4
+    stored = store.get(_key())
+    assert stored["knobs"] == rec["knobs"]
+    assert [t["knobs"] for t in stored["trials"]][0] == \
+        {"block": 128, "bucket_mb": 4}
+
+
+def test_second_search_is_pure_cache_hit(tmp_path):
+    """The acceptance contract: a repeat search over the same key and
+    knob space returns the stored record with ZERO measure calls."""
+    store = autotune.AutotuneStore(str(tmp_path / "s"))
+    calls = []
+
+    def measure(knobs):
+        calls.append(dict(knobs))
+        return 1.0 + knobs["block"] / 100.0
+
+    first = autotune.search(measure, _space(), _key(), store=store)
+    assert first["cache_hit"] is False
+    n = len(calls)
+    assert n == first["measurements"] > 0
+
+    second = autotune.search(measure, _space(), _key(), store=store)
+    assert second["cache_hit"] is True
+    assert len(calls) == n  # not one more measurement
+    assert second["knobs"] == first["knobs"]
+    assert second["metric"] == first["metric"]
+
+    # a CHANGED knob space invalidates the hit (re-measures)...
+    wider = _space() + [autotune.Knob("extra", (0, 1))]
+    third = autotune.search(measure, wider, _key(), store=store)
+    assert third["cache_hit"] is False
+    assert len(calls) > n
+    # ...and force=True always re-measures
+    calls[:] = []
+    forced = autotune.search(measure, wider, _key(), store=store,
+                             force=True)
+    assert forced["cache_hit"] is False
+    assert calls
+
+
+def test_tie_breaks_on_temp_bytes(tmp_path):
+    """Within the rel_tie band the lower temp-bytes candidate wins —
+    the fusion-audit memory signal decides when throughput is noise."""
+    store = autotune.AutotuneStore(str(tmp_path / "s"))
+    temp = {128: 900, 64: 100, 32: 500}
+
+    def measure(knobs):
+        return {"metric": 1.0,  # dead heat on throughput
+                "aux": {"temp_bytes": temp[knobs["block"]]}}
+
+    rec = autotune.search(measure, [autotune.Knob("block",
+                                                  (128, 64, 32))],
+                          _key(), store=store, rel_tie=0.02)
+    assert rec["knobs"] == {"block": 64}
+
+
+def test_budget_bounds_measurements(tmp_path):
+    store = autotune.AutotuneStore(str(tmp_path / "s"))
+    calls = []
+
+    def measure(knobs):
+        calls.append(1)
+        import time
+
+        time.sleep(0.05)
+        return 1.0
+
+    rec = autotune.search(measure, _space(), _key(), store=store,
+                          budget=0.01)
+    assert rec["budget_exhausted"] is True
+    assert len(calls) == 1  # the baseline always measures
+    assert rec["knobs"] == {"block": 128, "bucket_mb": 4}  # defaults
+
+
+def test_search_rejects_empty_space(tmp_path):
+    with pytest.raises(MXNetError):
+        autotune.search(lambda k: 1.0, [], _key(),
+                        store=autotune.AutotuneStore(str(tmp_path)))
+
+
+# ---------------------------------------------------------------------------
+# apply hooks
+# ---------------------------------------------------------------------------
+
+def _seed_serve_record(params, knobs, tmp_path):
+    store = autotune.AutotuneStore(str(tmp_path / "store"))
+    key = autotune.Key("serve", autotune.fingerprint(params))
+    store.put(key, {
+        "kind": "serve", "fingerprint": key.fingerprint,
+        "mesh": key.mesh, "backend": key.backend,
+        "knobs": knobs, "metric": 10.0,
+    })
+    return store
+
+
+def test_apply_serve_folds_record_into_session(monkeypatch, tmp_path):
+    """MXNET_AUTOTUNE=1 + a stored record: a session built WITHOUT an
+    explicit config picks up the tuned quant/bucket knobs, and the
+    application lands in compile_cache.report()['autotune']."""
+    from mxnet_tpu import compile_cache, quantize
+
+    params = serve_model.init_params(CFG, seed=3)
+    _seed_serve_record(params, {"quant": "int8", "buckets": [8, 16]},
+                       tmp_path)
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    monkeypatch.setenv("MXNET_SERVE_PAGE", "8")
+    monkeypatch.setenv("MXNET_SERVE_MAX_NEW", "8")
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads)
+    assert sess.config.quant == "int8"
+    assert sess.config.buckets == (8, 16)
+    assert quantize.is_quantized(sess.params["blk0_ffn1_weight"])
+    prov = compile_cache.report()["autotune"]
+    assert prov and prov[-1]["where"] == "InferenceSession"
+    assert prov[-1]["knobs"]["quant"] == "int8"
+
+
+def test_apply_serve_respects_explicit_config(monkeypatch, tmp_path):
+    params = serve_model.init_params(CFG, seed=3)
+    _seed_serve_record(params, {"quant": "int8"}, tmp_path)
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    sconf = serve.ServeConfig(slots=2, page_size=8, buckets=(8,),
+                              max_new=8)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    assert sess.config.quant == ""  # explicit config wins outright
+    assert autotune.provenance() == []
+
+
+def test_apply_serve_off_without_env(tmp_path):
+    params = serve_model.init_params(CFG, seed=3)
+    store = _seed_serve_record(params, {"quant": "int8"}, tmp_path)
+    cfg = serve.ServeConfig(slots=2, page_size=8, buckets=(8,),
+                            max_new=8)
+    out = autotune.apply_serve(cfg, params, store=store)
+    assert out is cfg  # MXNET_AUTOTUNE unset: no-op
+
+
+def test_apply_train_env_arms_and_respects_user(monkeypatch, tmp_path):
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    store = autotune.AutotuneStore(str(tmp_path / "store"))
+    key = autotune.Key("train", autotune.fingerprint_symbol(sym))
+    store.put(key, {"kind": "train", "fingerprint": key.fingerprint,
+                    "mesh": key.mesh, "backend": key.backend,
+                    "knobs": {"attn_block": 64, "grad_bucket_mb": 2},
+                    "metric": 5.0})
+    # user pinned one knob: the record must not override it
+    monkeypatch.setenv("MXNET_GRAD_BUCKET_MB", "8")
+    monkeypatch.delenv("MXNET_ATTN_BLOCK", raising=False)
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    rec = autotune.apply_train_env(sym, None, store=store)
+    assert rec is not None
+    assert os.environ["MXNET_ATTN_BLOCK"] == "64"
+    assert os.environ["MXNET_GRAD_BUCKET_MB"] == "8"
+    prov = autotune.provenance()
+    assert prov[-1]["applied"] == ["MXNET_ATTN_BLOCK"]
+    # the test-hook cleanup removes exactly what apply set
+    autotune.clear_applied()
+    assert "MXNET_ATTN_BLOCK" not in os.environ
+    assert os.environ["MXNET_GRAD_BUCKET_MB"] == "8"
+
+
+def test_apply_train_env_disabled_or_missing(monkeypatch, tmp_path):
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"),
+        name="softmax")
+    store = autotune.AutotuneStore(str(tmp_path / "store"))
+    assert autotune.apply_train_env(sym, None, store=store) is None
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    assert autotune.apply_train_env(sym, None, store=store) is None
+
+
+def test_mesh_desc():
+    assert autotune.mesh_desc(None) == "-"
+
+    class FakeMesh(object):
+        shape = {"data": 4, "model": 2}
+
+    assert autotune.mesh_desc(FakeMesh()) == "data:4,model:2"
+
+
+def test_report_embeds_provenance(monkeypatch, tmp_path):
+    """compile_cache.report() carries the autotune section, and the
+    compile-report pretty-printer renders it."""
+    from mxnet_tpu import compile_cache
+
+    autotune.note_applied({"kind": "serve", "fingerprint": "f" * 12,
+                           "mesh": "-", "backend": "cpu",
+                           "knobs": {"quant": "int8"}, "metric": 1.0},
+                          where="InferenceSession",
+                          applied=["quant"])
+    rep = compile_cache.report()
+    assert rep["autotune"][-1]["where"] == "InferenceSession"
+    # the stdlib pretty-printer path (tools/compile_report.py)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "compile_report_cli", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "compile_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        mod.print_autotune(rep["autotune"])
+    out = buf.getvalue()
+    assert "InferenceSession" in out and "quant" in out
+    # absent/empty section prints nothing (pre-autotune artifacts)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        mod.print_autotune(None)
+        mod.print_autotune([])
+    assert buf.getvalue() == ""

@@ -4,6 +4,7 @@ tools/parse_log.py, tools/launch.py)."""
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -524,3 +525,46 @@ int main(int argc, char** argv) {
                    "float32").reshape(4, 3)
     np.testing.assert_allclose(got, expected, rtol=5e-3, atol=1e-3)
     assert lines[12].startswith("NDLIST mean_img 1 6 0.0 5.0"), lines[12]
+
+
+def test_autotune_report_cli(tmp_path):
+    """tools/autotune.py --report pretty-prints stored records (stdlib
+    only) and exits 1 with a hint on an empty store."""
+    from mxnet_tpu import autotune
+
+    d = str(tmp_path / "store")
+    store = autotune.AutotuneStore(d)
+    key = autotune.Key("serve", "aabbccddeeff", backend="cpu")
+    store.put(key, {
+        "kind": "serve", "fingerprint": "aabbccddeeff", "mesh": "-",
+        "backend": "cpu",
+        "knob_space": {"quant": ["", "int8"]},
+        "knobs": {"quant": "int8", "buckets": [16, 64]},
+        "metric": 1234.5, "baseline_metric": 1000.0,
+        "speedup_vs_default": 1.2345, "measurements": 4,
+        "trials": [], "elapsed_s": 2.5, "budget_exhausted": False,
+        "created": time.time(),
+    })
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "autotune.py")
+
+    def run(directory):
+        return subprocess.run(
+            [sys.executable, tool, "--report", "--dir", directory],
+            capture_output=True, text=True, timeout=60,
+            cwd=os.path.join(os.path.dirname(__file__), ".."))
+
+    res = run(d)
+    assert res.returncode == 0, res.stderr
+    out = res.stdout
+    assert "serve" in out and "aabbccddeeff" in out
+    assert "quant='int8'" in out and "buckets=[16, 64]" in out
+    assert "1234" in out and "1.23x default" in out
+    assert "4 measurements" in out
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    res = run(empty)
+    assert res.returncode == 1
+    assert "no autotune records" in res.stderr

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Autotune the knob surface on THIS rig, or report stored results.
+
+Usage::
+
+    python tools/autotune.py --report [--dir DIR]
+    python tools/autotune.py --search serve [--budget-s N] [--dir DIR]
+    python tools/autotune.py --search train [--budget-s N] [--dir DIR]
+
+``--report`` pretty-prints the records ``mxnet_tpu.autotune`` persists
+(one JSON per (kind, model-fingerprint, mesh, backend)) — stdlib only,
+so it runs anywhere the store directory survives.
+
+``--search`` imports mxnet_tpu and runs a measured greedy search on a
+small built-in model: ``serve`` sweeps {quant mode, prefill-bucket
+ladder} against decode tokens/s (``bench_serve.py``-style timing, with
+``memory_analysis`` temp bytes as the tie-breaker); ``train`` sweeps
+{attn block, grad bucket MB} against fused-step steps/s
+(``bench_fit.py``-style).  Results land in the store; any later build
+with ``MXNET_AUTOTUNE=1`` and a matching fingerprint applies them with
+zero re-measures, and the compile report records the application.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _fmt_age(created):
+    try:
+        age = max(0.0, time.time() - float(created))
+    except (TypeError, ValueError):
+        return "?"
+    for unit, div in (("s", 1), ("m", 60), ("h", 3600), ("d", 86400)):
+        if age < 90 * div or unit == "d":
+            return "%.0f%s" % (age / div, unit)
+
+
+def _default_dir():
+    path = os.environ.get("MXNET_AUTOTUNE_DIR") \
+        or os.environ.get("MXTPU_AUTOTUNE_DIR")
+    if not path:
+        path = os.path.join(os.path.expanduser("~"), ".cache",
+                            "mxnet_tpu", "autotune")
+    return path
+
+
+def print_records(directory):
+    """Stdlib pretty-printer for the store; returns the record count."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        names = []
+    shown = 0
+    for name in names:
+        if not (name.startswith("autotune-") and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            print("%s: unreadable (%s)" % (path, e), file=sys.stderr)
+            continue
+        if shown == 0:
+            print("AUTOTUNE STORE  %s" % directory)
+        shown += 1
+        knobs = ", ".join("%s=%r" % (k, v)
+                          for k, v in sorted((rec.get("knobs")
+                                              or {}).items()))
+        print("-" * 72)
+        print("%-6s %s  mesh=%s  backend=%s  age=%s"
+              % (rec.get("kind", "?"), rec.get("fingerprint", "?"),
+                 rec.get("mesh", "-"), rec.get("backend", "?"),
+                 _fmt_age(rec.get("created"))))
+        print("  best knobs   %s" % (knobs or "(defaults)"))
+        print("  metric       %.4g (baseline %.4g, %.2fx default)"
+              % (float(rec.get("metric", 0.0)),
+                 float(rec.get("baseline_metric", 0.0)),
+                 float(rec.get("speedup_vs_default", 0.0))))
+        print("  search       %d measurements in %.1fs%s"
+              % (int(rec.get("measurements", 0)),
+                 float(rec.get("elapsed_s", 0.0)),
+                 "  (budget exhausted)" if rec.get("budget_exhausted")
+                 else ""))
+    if not shown:
+        print("no autotune records under %s (run tools/autotune.py "
+              "--search serve|train)" % directory, file=sys.stderr)
+    return shown
+
+
+def search_serve(directory, budget):
+    """Measured serve-knob search on the built-in small LM."""
+    from mxnet_tpu import autotune, serve
+    from mxnet_tpu.serve import model as serve_model
+
+    cfg = serve.ModelConfig(vocab_size=128, num_layers=2, d_model=64,
+                            num_heads=2, max_len=128)
+    params = serve_model.init_params(cfg, seed=0)
+
+    def measure(knobs):
+        import numpy as np
+
+        sconf = serve.ServeConfig(
+            slots=8, page_size=16, max_new=16, exact=True,
+            buckets=tuple(knobs["buckets"]), quant=knobs["quant"])
+        sess = serve.InferenceSession(params, num_heads=cfg.num_heads,
+                                      config=sconf)
+        rs = np.random.RandomState(11)
+        slots = []
+        for _ in range(sconf.slots):
+            slot = sess.try_alloc(9, sconf.max_new)
+            sess.prefill(slot, rs.randint(1, 127, size=9).tolist())
+            slots.append(slot)
+        for _ in range(2):
+            sess.step()
+        steps = 10
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            sess.step()
+        dt = time.perf_counter() - t0
+        for slot in slots:
+            sess.release(slot)
+        mem = sess.memory_analysis("decode")
+        return {"metric": sconf.slots * steps / dt,
+                "aux": {"temp_bytes": mem.get("temp_size_in_bytes"),
+                        "argument_bytes":
+                            mem.get("argument_size_in_bytes"),
+                        "at_rest_bytes": sess.params_bytes_at_rest()}}
+
+    space = [
+        autotune.Knob("quant", ("", "int8", "fp8")),
+        autotune.Knob("buckets", ((16, 32, 64), (16, 64), (64,))),
+    ]
+    key = autotune.Key("serve", autotune.fingerprint(params))
+    rec = autotune.search(measure, space, key,
+                          store=autotune.AutotuneStore(directory),
+                          budget=budget)
+    print(json.dumps({k: rec[k] for k in
+                      ("kind", "fingerprint", "backend", "knobs",
+                       "metric", "baseline_metric", "measurements",
+                       "cache_hit")}, sort_keys=True))
+    return 0
+
+
+def search_train(directory, budget):
+    """Measured train-knob search on a small fused-step transformer."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu import autotune
+    from mxnet_tpu.fused import TrainStep
+    from mxnet_tpu.models import transformer
+
+    seq_len, batch = 32, 4
+    sym = transformer.get_symbol(vocab_size=128, num_layers=2,
+                                 d_model=64, num_heads=2,
+                                 seq_len=seq_len)
+    shapes = {"data": (batch, seq_len),
+              "softmax_label": (batch, seq_len)}
+    rs = np.random.RandomState(0)
+    batch_np = {
+        "data": rs.randint(1, 127, size=shapes["data"]).astype(np.int32),
+        "softmax_label":
+            rs.randint(1, 127,
+                       size=shapes["softmax_label"]).astype(np.int32),
+    }
+
+    def measure(knobs):
+        saved = {}
+        for kname, env_name in autotune.TRAIN_KNOB_ENV.items():
+            if kname in knobs:
+                saved[env_name] = os.environ.get(env_name)
+                os.environ[env_name] = str(knobs[kname])
+        try:
+            step = TrainStep(sym, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.01})
+            params, aux, states = step.init_state(shapes)
+            rng = jax.random.PRNGKey(0)
+            for _ in range(2):
+                params, aux, states, out = step(params, aux, states,
+                                                batch_np, rng)
+            jax.block_until_ready(params)
+            n = 6
+            t0 = time.perf_counter()
+            for _ in range(n):
+                params, aux, states, out = step(params, aux, states,
+                                                batch_np, rng)
+            jax.block_until_ready(params)
+            dt = time.perf_counter() - t0
+            return n / dt
+        finally:
+            for env_name, old in saved.items():
+                if old is None:
+                    os.environ.pop(env_name, None)
+                else:
+                    os.environ[env_name] = old
+
+    space = [
+        autotune.Knob("attn_block", (128, 64, 32)),
+        autotune.Knob("grad_bucket_mb", (4, 1)),
+    ]
+    key = autotune.Key("train", autotune.fingerprint_symbol(sym))
+    rec = autotune.search(measure, space, key,
+                          store=autotune.AutotuneStore(directory),
+                          budget=budget)
+    print(json.dumps({k: rec[k] for k in
+                      ("kind", "fingerprint", "backend", "knobs",
+                       "metric", "baseline_metric", "measurements",
+                       "cache_hit")}, sort_keys=True))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="measure/report autotune records for mxnet_tpu")
+    ap.add_argument("--report", action="store_true",
+                    help="pretty-print the store (stdlib only)")
+    ap.add_argument("--search", choices=("serve", "train"),
+                    help="run a measured knob search on this rig "
+                         "(imports mxnet_tpu)")
+    ap.add_argument("--dir", default=None,
+                    help="store directory (default: $MXNET_AUTOTUNE_DIR "
+                         "or ~/.cache/mxnet_tpu/autotune)")
+    ap.add_argument("--budget-s", type=float, default=0.0,
+                    help="wall-clock cap for measurement passes "
+                         "(0 = unbounded)")
+    args = ap.parse_args(argv)
+    directory = args.dir or _default_dir()
+    if args.report:
+        return 0 if print_records(directory) else 1
+    if args.search == "serve":
+        return search_serve(directory, args.budget_s)
+    if args.search == "train":
+        return search_train(directory, args.budget_s)
+    print("nothing to do: pass --report or --search serve|train",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,21 @@ def print_compile_events(events, indent="  "):
     print(indent + "  total compile wall time: %.2fs" % total)
 
 
+def print_autotune(tuned, indent="  "):
+    # pre-autotune artifacts have no section: print nothing rather
+    # than a misleading "(none)"
+    if not tuned:
+        return
+    print(indent + "autotune knobs applied (tools/autotune.py):")
+    for rec in tuned:
+        knobs = ", ".join("%s=%r" % (k, v)
+                          for k, v in sorted((rec.get("knobs")
+                                              or {}).items()))
+        print(indent + "  %-16s %s [%s @ %s]"
+              % (rec.get("where", "?"), knobs or "(no knobs)",
+                 rec.get("fingerprint", "?"), rec.get("backend", "?")))
+
+
 def print_report(path, payload):
     print("=" * 72)
     print("COMPILE REPORT  %s" % path)
@@ -115,6 +130,7 @@ def print_report(path, payload):
     print_cache(payload.get("cache"))
     print_recompiles(payload.get("recompiles"))
     print_compile_events(payload.get("compile_events"))
+    print_autotune(payload.get("autotune"))
 
 
 def report_file(path):
