@@ -33,6 +33,22 @@ Also certifies the serving acceptance criteria directly in the JSON:
                            and per-precision bit-exactness
                            (``bitexact_quant``) re-proved on the
                            quantized tree.
+* ``prefix_*`` / ``bitexact_prefix`` — prefix-cache A/B over a
+                           shared-preamble trace (same executables, only
+                           ``prefix_pages`` flips): hit rate, prefill
+                           tokens saved, TTFT p50/p99 per side, with the
+                           measured TTFT reduction on hits and
+                           stream-level bit-exactness asserted.
+* ``oversub_*`` / ``bitexact_oversub`` — admission A/B at an equal
+                           undersized page pool: reservation vs
+                           oversubscription peak concurrency (oversub
+                           must sustain more requests in flight),
+                           preemption/resume counts, and bit-identical
+                           token streams across the two policies.
+* ``closed_loop_*``      — closed-loop load generator (the scheduler's
+                           ``followup`` hook holds concurrency constant)
+                           under a TTFT budget: goodput-under-SLO and
+                           SLO attainment.
 * ``compile_report``     — ``compile_cache.write_artifact`` path for
                            the serving executable set
                            (pretty-print: ``tools/compile_report.py``).
@@ -335,6 +351,151 @@ def measure(argv=None):
                 and _RESULT["quant_speedup"] >= 0.82)), \
         "quant A/B: speedup %.3f, shrink %.2fx — neither bar met" \
         % (_RESULT["quant_speedup"], _RESULT["quant_bytes_shrink"])
+
+    # -- prefix caching A/B ----------------------------------------------
+    # Prefix-heavy trace: every prompt opens with the same 96-token
+    # system preamble (6 full pages at page_size 16) and a 16-token
+    # per-request suffix.  The two sessions compile the SAME executable
+    # set; only prefix_pages flips.  On a hit the preamble's pages are
+    # mapped read-only and prefill runs just the suffix through the
+    # 32-bucket instead of the whole prompt through the 112-bucket —
+    # the TTFT delta is that compute, measured.
+    pfx_conf = _dc.replace(sconf, slots=4, buckets=(32, 112), max_new=4)
+    pfx_off = serve.InferenceSession(params, num_heads=cfg.num_heads,
+                                     config=pfx_conf)
+    pfx_on = serve.InferenceSession(
+        params, num_heads=cfg.num_heads,
+        config=_dc.replace(pfx_conf, prefix_pages=-1))
+    assert len(pfx_on.executables) == len(pfx_conf.buckets) + 1
+    assert len(pfx_off.executables) == len(pfx_conf.buckets) + 1
+    rs = np.random.RandomState(9)
+    preamble = rs.randint(1, 127, size=96).tolist()
+    pfx_trace = _poisson_trace(8, mean_gap_s=0.002, prompt_lens=(16,),
+                               max_new=4, seed=5)
+    for spec in pfx_trace:
+        spec["prompt"] = preamble + spec["prompt"]
+    # interleaved best-of-3 (as in the quant A/B): each pass replays the
+    # identical trace; the on-session's published preamble pages persist
+    # across passes, so from the first pass's second request onward
+    # every admission is a hit
+    pfx_p50 = {"off": float("inf"), "on": float("inf")}
+    pfx_p99 = {"off": float("inf"), "on": float("inf")}
+    pfx_streams = {}
+    for _ in range(3):
+        for tag, psess in (("off", pfx_off), ("on", pfx_on)):
+            reqs = [serve.Request(**spec) for spec in pfx_trace]
+            done, makespan = serve.Scheduler(
+                psess, policy="continuous").run(reqs)
+            summary = serve.summarize(done, makespan)
+            assert summary["failed"] == 0
+            pfx_p50[tag] = min(pfx_p50[tag], summary["ttft_p50_s"])
+            pfx_p99[tag] = min(pfx_p99[tag], summary["ttft_p99_s"])
+            pfx_streams[tag] = {r.rid: list(r.tokens) for r in done}
+    stats = pfx_on.cache.prefix_stats
+    _RESULT["prefix_hit_rate"] = round(
+        stats["hits"] / max(stats["lookups"], 1), 3)
+    _RESULT["prefix_prefill_tokens_saved"] = stats["hit_tokens"]
+    _RESULT["prefix_ttft_p50_off_s"] = round(pfx_p50["off"], 5)
+    _RESULT["prefix_ttft_p50_on_s"] = round(pfx_p50["on"], 5)
+    _RESULT["prefix_ttft_p99_off_s"] = round(pfx_p99["off"], 5)
+    _RESULT["prefix_ttft_p99_on_s"] = round(pfx_p99["on"], 5)
+    _RESULT["prefix_ttft_reduction"] = round(
+        1.0 - pfx_p50["on"] / max(pfx_p50["off"], 1e-9), 3)
+    # acceptance: hits must MEASURABLY cut TTFT, and the cache may
+    # change only the cost of a stream, never its content
+    assert _RESULT["prefix_hit_rate"] > 0.5
+    assert _RESULT["prefix_prefill_tokens_saved"] > 0
+    assert _RESULT["prefix_ttft_reduction"] > 0, \
+        "prefix hits did not reduce TTFT (p50 on %.5fs vs off %.5fs)" \
+        % (pfx_p50["on"], pfx_p50["off"])
+    _RESULT["bitexact_prefix"] = pfx_streams["on"] == pfx_streams["off"]
+    assert _RESULT["bitexact_prefix"], "prefix-cache hits drifted"
+    assert pfx_on.fallback_count() == 0
+
+    # -- oversubscription A/B at an equal undersized pool ----------------
+    # 7-page pool, 16-token prompts decoding 16 tokens (2 pages at
+    # rest).  Reservation admission can hold at most 3 requests in
+    # flight; oversubscription admits by current need (1 page), fills
+    # all 6 slots, and pays with watermark preemption + deterministic
+    # re-prefill when growth drains the pool.
+    ovs_conf = _dc.replace(sconf, slots=6, buckets=(16, 32), max_new=16,
+                           num_pages=7)
+    ovs_burst = [dict(rid=i,
+                      prompt=np.random.RandomState(20 + i).randint(
+                          1, 127, size=16).tolist(),
+                      max_new=16, arrival_s=0.0) for i in range(12)]
+    ovs_streams, ovs_peak = {}, {}
+    for tag, oconf in (("reserved", ovs_conf),
+                       ("oversub", _dc.replace(ovs_conf, oversub=True,
+                                               watermark=1))):
+        osess = serve.InferenceSession(params, num_heads=cfg.num_heads,
+                                       config=oconf)
+        assert len(osess.executables) == len(oconf.buckets) + 1
+        sched = serve.Scheduler(osess, policy="continuous")
+        done, makespan = sched.run(
+            [serve.Request(**spec) for spec in ovs_burst])
+        summary = serve.summarize(done, makespan)
+        assert summary["failed"] == 0, "%s: %d requests failed" \
+            % (tag, summary["failed"])
+        ovs_streams[tag] = {r.rid: list(r.tokens) for r in done}
+        ovs_peak[tag] = sched.stats["peak_active"]
+        _RESULT["oversub_%s_peak_active" % tag] = sched.stats["peak_active"]
+        _RESULT["oversub_%s_tokens_per_sec" % tag] = round(
+            summary["tokens_per_sec"], 1)
+        if tag == "oversub":
+            _RESULT["oversub_preemptions"] = sched.stats["preemptions"]
+            _RESULT["oversub_resumes"] = sched.stats["resumes"]
+            assert sched.stats["preemptions"] > 0
+            assert osess.fallback_count() == 0
+    # acceptance: more requests in flight at the same pool size, with
+    # bit-identical streams — oversubscription changes capacity only
+    assert ovs_peak["oversub"] > ovs_peak["reserved"], \
+        "oversub peak %d not above reservation peak %d" \
+        % (ovs_peak["oversub"], ovs_peak["reserved"])
+    _RESULT["bitexact_oversub"] = (ovs_streams["oversub"]
+                                   == ovs_streams["reserved"])
+    assert _RESULT["bitexact_oversub"], "preempt-and-recompute drifted"
+
+    # -- closed-loop goodput under a TTFT SLO ----------------------------
+    # The scheduler's followup hook spawns one replacement request per
+    # completion, holding concurrency at the slot count instead of
+    # replaying an open-loop trace; the session's TTFT budget drives
+    # can-still-meet-first admission and summarize() reports goodput.
+    slo_ms = 250.0
+    slo_sess = serve.InferenceSession(
+        params, num_heads=cfg.num_heads,
+        config=_dc.replace(sconf, slots=4, max_new=8, ttft_slo_ms=slo_ms))
+    cl_total = max(n_requests, 12)
+    cl_rs = np.random.RandomState(13)
+    cl_issued = {"n": 0}
+
+    def _cl_request(now_s):
+        cl_issued["n"] += 1
+        plen = int(cl_rs.choice((9, 14, 23)))
+        return serve.Request(rid=2000 + cl_issued["n"],
+                             prompt=cl_rs.randint(1, 127,
+                                                  size=plen).tolist(),
+                             max_new=8, arrival_s=now_s)
+
+    def _cl_followup(req, now_s):
+        return _cl_request(now_s) if cl_issued["n"] < cl_total else None
+
+    seeds = [_cl_request(0.0) for _ in range(4)]
+    done, makespan = serve.Scheduler(slo_sess, policy="continuous").run(
+        seeds, followup=_cl_followup)
+    summary = serve.summarize(done, makespan, ttft_slo_ms=slo_ms)
+    assert summary["failed"] == 0
+    assert summary["completed"] == cl_total
+    assert summary["goodput_rps"] > 0
+    _RESULT["closed_loop_requests"] = summary["completed"]
+    _RESULT["closed_loop_ttft_slo_ms"] = slo_ms
+    _RESULT["closed_loop_goodput_rps"] = round(summary["goodput_rps"], 2)
+    _RESULT["closed_loop_slo_attainment"] = round(
+        summary["slo_attainment"], 3)
+    _RESULT["closed_loop_ttft_p50_s"] = round(summary["ttft_p50_s"], 5)
+    _RESULT["closed_loop_ttft_p99_s"] = round(summary["ttft_p99_s"], 5)
+    _RESULT["closed_loop_tokens_per_sec"] = round(
+        summary["tokens_per_sec"], 1)
 
     # -- acceptance probe 3: no per-request recompiles -------------------
     guards = sess.guard_report()

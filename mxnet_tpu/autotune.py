@@ -363,10 +363,12 @@ def _user_set(env_name):
 def apply_serve(config, params, store=None):
     """Fold a cached serve tuning record into an env-derived
     ``ServeConfig`` (called by ``InferenceSession`` only when the
-    caller did NOT pass an explicit config).  Applies ``quant`` and
-    ``buckets`` knobs; anything the record doesn't carry keeps the
-    env/default value.  No-op unless ``MXNET_AUTOTUNE`` is on and a
-    record exists for this (model-fingerprint, backend)."""
+    caller did NOT pass an explicit config).  Applies ``quant``,
+    ``buckets``, ``prefix_pages`` (prefix-cache retention size) and
+    ``watermark`` (preemption free-pool floor; inert until the caller
+    turns ``oversub`` on) knobs; anything the record doesn't carry
+    keeps the env/default value.  No-op unless ``MXNET_AUTOTUNE`` is on
+    and a record exists for this (model-fingerprint, backend)."""
     if not autotune_enabled():
         return config
     import dataclasses
@@ -383,6 +385,10 @@ def apply_serve(config, params, store=None):
         updates["quant"] = quant_mode(knobs["quant"])
     if "buckets" in knobs:
         updates["buckets"] = tuple(int(b) for b in knobs["buckets"])
+    if "prefix_pages" in knobs:
+        updates["prefix_pages"] = int(knobs["prefix_pages"])
+    if "watermark" in knobs:
+        updates["watermark"] = int(knobs["watermark"])
     if not updates:
         return config
     note_applied(rec, where="InferenceSession",

@@ -11,30 +11,71 @@ no per-request allocation, no growing tensors, no recompiles.  Requests
 own *pages* (rows of the pool), recorded in a per-slot page table the
 executables consume as a plain (slots, max_pages) int32 array.
 
-Two deliberate simplifications vs a vLLM-style pager:
+Two admission modes (vs the original reservation-only pager):
 
-* **Reservation admission** — a request is admitted only when pages for
-  its whole worst case (prompt + max_new tokens) are free, so an
-  admitted request can never stall mid-decode waiting for a page and no
-  preemption/swap machinery is needed.  The cost is lower pool
-  utilization when requests finish early; the scheduler's continuous
-  admission backfills freed pages at the next step boundary.
-* **The trash page** — pool row ``num_pages`` is a write-only dump.
-  Unreserved page-table entries and inactive slots point at it, so the
-  fixed-shape executables can always scatter (padded prefill positions,
-  idle slots) without conditionals; nothing ever reads it through a
-  validity mask.
+* **Reservation admission** (default) — a request is admitted only when
+  pages for its whole worst case (prompt + max_new tokens) are free, so
+  an admitted request can never stall mid-decode waiting for a page and
+  no preemption machinery is needed.  The cost is lower pool
+  utilization when requests finish early.
+* **Oversubscription** (``alloc(..., oversub=True)``, driven by
+  ``MXNET_SERVE_OVERSUB``) — admit by *current* need (the prompt pages
+  only) and grow on demand at decode boundaries via
+  :meth:`append_pages`.  The scheduler watches
+  :attr:`reclaimable_pages` against a watermark and preempts requests
+  when the pool runs dry; preempted requests re-prefill
+  deterministically on resume, so oversubscription changes capacity,
+  never content.
+
+**Prefix cache** (``prefix_pages != 0``): a page-aligned token-hash
+index over the pool.  :meth:`alloc` matches the prompt's full pages
+against a chain hash (page ``i``'s key folds page ``i-1``'s key, so a
+hit certifies the whole transcript prefix, not just one page's tokens)
+and maps hits read-only into the new slot's table with a reference
+count; prefill then runs only on the uncached suffix.
+:meth:`register_prefix` publishes a slot's full prompt pages after
+prefill so later requests (and preempted-then-resumed ones) hit them.
+Pages whose refcount drops to zero are *retained* in LRU order (up to
+``prefix_pages`` when positive) and reclaimed lazily — the free heap is
+always preferred, so retention never costs an admission.  Shared or
+published pages are never written in place: :meth:`ensure_writable` is
+the copy-on-write guard every write path crosses.
+
+**The trash page** — pool row ``num_pages`` is a write-only dump.
+Unreserved page-table entries and inactive slots point at it, so the
+fixed-shape executables can always scatter (padded prefill positions,
+idle slots) without conditionals; nothing ever reads it through a
+validity mask.
 
 Page-table/length bookkeeping is host-side numpy (the scheduler mutates
 it between steps); :meth:`device_tables` re-uploads only after a
 mutation.  The pools themselves live on device and flow through the
-donated executable arguments.
+donated executable arguments.  Free slots and pages are min-heaps
+popped lowest-id-first, so allocation order stays deterministic no
+matter the order requests finished in (the old implementation re-sorted
+a list on every release; the heap keeps the same reuse contract at
+O(log n) per op).
 """
 from __future__ import annotations
+
+import hashlib
+import heapq
+from collections import OrderedDict
 
 from ..base import MXNetError
 
 __all__ = ["PagedKVCache"]
+
+
+def _chain_key(prev_key, page_tokens):
+    """Chain hash over one page of prompt tokens: folds the previous
+    page's key so equal keys certify equal *transcripts*, not just
+    equal final pages.  Content-addressed and deterministic."""
+    import numpy as np
+
+    h = hashlib.sha256(prev_key)
+    h.update(np.asarray(page_tokens, np.int64).tobytes())
+    return h.digest()
 
 
 class PagedKVCache:
@@ -42,7 +83,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, page_size,
                  num_pages, slots, max_pages_per_slot, dtype=None,
-                 table_pad=0):
+                 table_pad=0, prefix_pages=0):
         import jax.numpy as jnp
         import numpy as np
 
@@ -51,6 +92,9 @@ class PagedKVCache:
             raise MXNetError("PagedKVCache: all dimensions must be >= 1")
         if table_pad < 0:
             raise MXNetError("PagedKVCache: table_pad must be >= 0")
+        if prefix_pages < -1:
+            raise MXNetError("PagedKVCache: prefix_pages must be >= -1 "
+                             "(-1 = unbounded retention, 0 = off)")
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
@@ -63,19 +107,35 @@ class PagedKVCache:
         # (the speculative verify's overflow rows) land on the trash
         # page instead of aliasing the slot's last real page
         self.table_pad = int(table_pad)
+        # prefix-cache retention cap: 0 disables the token-hash index
+        # entirely, -1 retains refcount-0 pages without bound (the pool
+        # size is the real bound), > 0 caps retained pages LRU-first
+        self.prefix_pages = int(prefix_pages)
         self.trash_page = self.num_pages  # reserved last pool row
         dtype = dtype or jnp.float32
         pool_shape = (self.num_layers, self.num_pages + 1, self.page_size,
                       self.num_heads, self.head_dim)
         self.k_pool = jnp.zeros(pool_shape, dtype)
         self.v_pool = jnp.zeros(pool_shape, dtype)
-        self._free_pages = list(range(self.num_pages - 1, -1, -1))
-        self._free_slots = list(range(self.slots - 1, -1, -1))
+        # min-heaps: heappop yields the lowest free id, preserving the
+        # deterministic lowest-first reuse contract (a sorted range is
+        # already a valid heap)
+        self._free_pages = list(range(self.num_pages))
+        self._free_slots = list(range(self.slots))
         self._tables = np.full((self.slots, self.table_width),
                                self.trash_page, np.int32)
-        self._pages_of = {}  # slot -> [page, ...]
+        self._pages_of = {}    # slot -> [page, ...] (prefix hits first)
+        self._cached_len = {}  # slot -> tokens covered by mapped hits
         self.lengths = np.zeros((self.slots,), np.int32)
         self._tables_dev = None  # upload cache, invalidated on mutation
+        # -- prefix-cache state ------------------------------------------
+        self._refcount = {}  # page -> count of slots currently mapping it
+        self._index = {}     # chain key -> page (published prefix pages)
+        self._key_of = {}    # page -> chain key (reverse of _index)
+        self._retained = OrderedDict()  # refcount-0 published pages, LRU
+        self.prefix_stats = {"lookups": 0, "hits": 0, "hit_pages": 0,
+                             "hit_tokens": 0, "published_pages": 0,
+                             "evicted_pages": 0, "cow_copies": 0}
 
     @property
     def table_width(self):
@@ -91,12 +151,24 @@ class PagedKVCache:
     def free_slots(self):
         return len(self._free_slots)
 
+    @property
+    def retained_pages(self):
+        """Published prefix pages no live request maps (reclaimable)."""
+        return len(self._retained)
+
+    @property
+    def reclaimable_pages(self):
+        """Pages an allocation could obtain right now: the free heap
+        plus retained prefix pages it may lazily evict.  This is the
+        quantity the scheduler's oversubscription watermark watches."""
+        return len(self._free_pages) + len(self._retained)
+
     def pages_needed(self, prompt_len, max_new):
         """Worst-case page reservation for one request."""
         total = int(prompt_len) + int(max_new)
         return -(-total // self.page_size)
 
-    def can_admit(self, prompt_len, max_new):
+    def can_admit(self, prompt_len, max_new, tokens=None, oversub=False):
         need = self.pages_needed(prompt_len, max_new)
         if need > self.max_pages_per_slot:
             raise MXNetError(
@@ -104,53 +176,288 @@ class PagedKVCache:
                 "size %d) but slots hold at most %d — raise the session's "
                 "max context" % (need, prompt_len, max_new,
                                  self.page_size, self.max_pages_per_slot))
-        return self._free_slots and len(self._free_pages) >= need
+        if not self._free_slots:
+            return False
+        hit = self._usable_hit(tokens, prompt_len)
+        fresh = self._fresh_needed(prompt_len, max_new, hit, oversub)
+        return self._available_for(hit) >= fresh
+
+    def _usable_hit(self, tokens, prompt_len):
+        """Longest mapped-page chain the prompt may reuse: full pages
+        whose chain key is published, capped so at least one prompt
+        token is always left for prefill (the suffix computes the
+        request's first logits, and suffix offsets stay page-aligned)."""
+        if tokens is None or not self.prefix_pages:
+            return []
+        hit = self.match_prefix(tokens)
+        cap = (int(prompt_len) - 1) // self.page_size
+        return hit[:cap]
+
+    def _fresh_needed(self, prompt_len, max_new, hit, oversub):
+        if oversub:
+            now = -(-int(prompt_len) // self.page_size)
+        else:
+            now = self.pages_needed(prompt_len, max_new)
+        return max(now - len(hit), 0)
+
+    def _available_for(self, hit):
+        """Pages obtainable without touching the hit set (hit pages may
+        themselves sit in the retained LRU; they are about to be
+        re-activated, not evicted)."""
+        hits = set(hit)
+        avail = len(self._free_pages)
+        avail += sum(1 for p in self._retained if p not in hits)
+        return avail
+
+    # -- prefix index -----------------------------------------------------
+    def match_prefix(self, tokens):
+        """Pages of the longest published chain prefix of ``tokens``
+        (full pages only; stops at the first unpublished page)."""
+        if not self.prefix_pages:
+            return []
+        pages = []
+        key = b""
+        n_full = len(tokens) // self.page_size
+        for i in range(n_full):
+            key = _chain_key(
+                key, tokens[i * self.page_size:(i + 1) * self.page_size])
+            page = self._index.get(key)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def register_prefix(self, slot, tokens):
+        """Publish the slot's full prompt pages into the token-hash
+        index (called after prefill, when their KV is final — positions
+        below the committed length are never rewritten).  Pages already
+        published under the same chain (the slot's own hits) are left
+        alone; a chain another slot published concurrently wins and this
+        slot's duplicate page stays private.  Returns pages published."""
+        if not self.prefix_pages:
+            return 0
+        pages = self._pages_of.get(slot)
+        if pages is None:
+            raise MXNetError("register_prefix of unallocated slot %r"
+                             % (slot,))
+        key = b""
+        published = 0
+        n_full = min(len(tokens) // self.page_size, len(pages))
+        for i in range(n_full):
+            key = _chain_key(
+                key, tokens[i * self.page_size:(i + 1) * self.page_size])
+            page = pages[i]
+            if key in self._index or page in self._key_of:
+                continue
+            self._index[key] = page
+            self._key_of[page] = key
+            published += 1
+        self.prefix_stats["published_pages"] += published
+        return published
+
+    def cached_len(self, slot):
+        """Prompt tokens covered by mapped prefix hits at admission —
+        the position prefill starts from."""
+        return self._cached_len.get(slot, 0)
+
+    def _take_page(self):
+        """Lowest free page, or — free heap empty — the least-recently
+        retained prefix page, unpublished and recycled."""
+        if self._free_pages:
+            return heapq.heappop(self._free_pages)
+        if not self._retained:
+            raise MXNetError("page pool exhausted (no free or retained "
+                             "pages) — preempt or release a request first")
+        page, key = self._retained.popitem(last=False)
+        del self._index[key]
+        del self._key_of[page]
+        self.prefix_stats["evicted_pages"] += 1
+        return page
+
+    def _drop_ref(self, page):
+        """Release one slot's hold on ``page``; a published page whose
+        count hits zero is retained (evictable), others go back to the
+        free heap."""
+        rc = self._refcount.get(page, 0) - 1
+        if rc > 0:
+            self._refcount[page] = rc
+            return
+        self._refcount.pop(page, None)
+        key = self._key_of.get(page)
+        if key is not None and self.prefix_pages:
+            self._retained[page] = key
+        else:
+            heapq.heappush(self._free_pages, page)
+
+    def _enforce_retention_cap(self):
+        if self.prefix_pages <= 0:
+            return
+        while len(self._retained) > self.prefix_pages:
+            page, key = self._retained.popitem(last=False)
+            del self._index[key]
+            del self._key_of[page]
+            self.prefix_stats["evicted_pages"] += 1
+            heapq.heappush(self._free_pages, page)
 
     # -- slot lifecycle ---------------------------------------------------
-    def alloc(self, prompt_len, max_new):
-        """Reserve a slot + its worst-case pages; returns the slot id or
+    def alloc(self, prompt_len, max_new, tokens=None, oversub=False):
+        """Admit a request: reserve a slot plus its pages — the worst
+        case by default, the *current* need (prompt pages only) under
+        ``oversub`` — mapping published prefix pages first when
+        ``tokens`` is given and the index hits.  Returns the slot id or
         ``None`` when either resource is exhausted (the scheduler keeps
-        the request queued)."""
-        if not self.can_admit(prompt_len, max_new):
+        the request queued); :meth:`cached_len` reports how many prompt
+        tokens the mapped hits already cover."""
+        if not self.can_admit(prompt_len, max_new, tokens=tokens,
+                              oversub=oversub):
             return None
-        need = self.pages_needed(prompt_len, max_new)
-        slot = self._free_slots.pop()
-        pages = [self._free_pages.pop() for _ in range(need)]
+        hit = self._usable_hit(tokens, prompt_len)
+        fresh = self._fresh_needed(prompt_len, max_new, hit, oversub)
+        slot = heapq.heappop(self._free_slots)
+        for page in hit:
+            self._retained.pop(page, None)  # re-activated, not evictable
+            self._refcount[page] = self._refcount.get(page, 0) + 1
+        pages = list(hit)
+        for _ in range(fresh):
+            page = self._take_page()
+            self._refcount[page] = 1
+            pages.append(page)
         self._pages_of[slot] = pages
         self._tables[slot, :] = self.trash_page
-        self._tables[slot, :need] = pages
-        self.lengths[slot] = 0
+        self._tables[slot, :len(pages)] = pages
+        self._cached_len[slot] = len(hit) * self.page_size
+        # lengths starts AT the cached prefix, not 0: fixed-shape
+        # executables write junk rows for every slot at its current
+        # length, and those must land in the slot's private fresh pages
+        # (suffix prefill overwrites them), never inside a shared hit
+        # page
+        self.lengths[slot] = self._cached_len[slot]
         self._tables_dev = None
+        if tokens is not None and self.prefix_pages:
+            self.prefix_stats["lookups"] += 1
+            if hit:
+                self.prefix_stats["hits"] += 1
+                self.prefix_stats["hit_pages"] += len(hit)
+                self.prefix_stats["hit_tokens"] += \
+                    len(hit) * self.page_size
         return slot
 
+    def append_pages(self, slot, new_len):
+        """Grow the slot's mapped pages to cover ``new_len`` token
+        positions (capped at the reservable range — speculative rows
+        past it land on the trash pad by design).  On-demand growth for
+        oversubscribed admission; a no-op when the slot already covers
+        the range (always, under reservation).  Returns pages appended;
+        raises when the pool cannot supply — the scheduler's watermark
+        preemption runs first precisely so this never fires."""
+        pages = self._pages_of.get(slot)
+        if pages is None:
+            raise MXNetError("append_pages of unallocated slot %r"
+                             % (slot,))
+        need = min(-(-int(new_len) // self.page_size),
+                   self.max_pages_per_slot)
+        added = 0
+        while len(pages) < need:
+            page = self._take_page()
+            self._refcount[page] = 1
+            self._tables[slot, len(pages)] = page
+            pages.append(page)
+            added += 1
+        if added:
+            self._tables_dev = None
+        return added
+
+    def pages_short(self, slot, new_len):
+        """Pages :meth:`append_pages` would have to obtain to cover
+        ``new_len`` positions — the scheduler's per-step need probe."""
+        pages = self._pages_of.get(slot)
+        if pages is None:
+            raise MXNetError("pages_short of unallocated slot %r"
+                             % (slot,))
+        need = min(-(-int(new_len) // self.page_size),
+                   self.max_pages_per_slot)
+        return max(need - len(pages), 0)
+
+    def ensure_writable(self, slot, start_pos, n_rows=1):
+        """Copy-on-write guard: before a dispatch writes KV rows
+        [``start_pos``, ``start_pos + n_rows``) for ``slot``, make every
+        mapped page in that range private.  A page other slots also map
+        (refcount > 1) is copied device-side into a fresh page and the
+        table repointed, so readers of the shared page never observe the
+        write; a page only *published* (refcount 1 but in the index) is
+        cheaper — it is unpublished in place, since no one else reads
+        it yet.  The natural write paths (suffix prefill, decode,
+        verify) only ever touch positions past the shared prefix, so
+        this is a no-op there; it exists so that no future write path
+        can corrupt a shared page by construction.  Returns pages
+        copied."""
+        pages = self._pages_of.get(slot)
+        if pages is None:
+            raise MXNetError("ensure_writable of unallocated slot %r"
+                             % (slot,))
+        if n_rows < 1:
+            return 0
+        first = max(int(start_pos), 0) // self.page_size
+        last = (int(start_pos) + int(n_rows) - 1) // self.page_size
+        copied = 0
+        for idx in range(first, min(last + 1, len(pages))):
+            page = pages[idx]
+            shared = self._refcount.get(page, 0) > 1
+            published = page in self._key_of
+            if not shared and not published:
+                continue
+            if not shared:
+                # sole holder: unpublish and write in place (chains
+                # beyond this page become unreachable and age out of
+                # the retained LRU like any cold entry)
+                key = self._key_of.pop(page)
+                self._index.pop(key, None)
+                self._retained.pop(page, None)
+                continue
+            new = self._take_page()
+            # device-side page copy across all layers in one op; pure
+            # copy, so the private page is bit-identical to the shared
+            # one and the stream stays exact
+            self.k_pool = self.k_pool.at[:, new].set(self.k_pool[:, page])
+            self.v_pool = self.v_pool.at[:, new].set(self.v_pool[:, page])
+            self._refcount[new] = 1
+            pages[idx] = new
+            self._tables[slot, idx] = new
+            self._drop_ref(page)
+            copied += 1
+        if copied:
+            self._tables_dev = None
+            self.prefix_stats["cow_copies"] += copied
+        return copied
+
     def release(self, slot):
-        """Return the slot's pages to the free pool (request finished,
-        evicted, or failed)."""
+        """Return the slot's resources (request finished, evicted, or
+        failed).  Refcount-aware: shared prefix pages survive for their
+        other holders, and published pages this slot held alone are
+        retained for future hits instead of freed."""
         pages = self._pages_of.pop(slot, None)
         if pages is None:
             raise MXNetError("release of unallocated slot %r" % (slot,))
-        # keep free lists sorted (descending, pop() takes the end) so the
-        # lowest id is always reused first — allocation order stays
-        # deterministic no matter the order requests finished in
-        self._free_pages.extend(pages)
-        self._free_pages.sort(reverse=True)
-        self._free_slots.append(slot)
-        self._free_slots.sort(reverse=True)
+        for page in pages:
+            self._drop_ref(page)
+        self._enforce_retention_cap()
+        heapq.heappush(self._free_slots, slot)
         self._tables[slot, :] = self.trash_page
         self.lengths[slot] = 0
+        self._cached_len.pop(slot, None)
         self._tables_dev = None
 
     def truncate(self, slot, n_tokens):
         """Roll back the slot's last ``n_tokens`` KV rows (speculative-
         decode rejection).  Host-side O(1): only ``lengths`` shrinks —
-        the slot's page reservation is untouched (pages were reserved
-        worst-case at admission, so there is nothing to return to the
-        free pool) and the vacated rows are invalidated deterministically
-        by the length mask every executable applies: positions >= the
-        new length are never read, and the next append overwrites them.
-        The device page-table upload cache is deliberately NOT touched
-        (the invalidate-only-on-alloc/release contract holds): tables do
-        not change here, and lengths re-upload every step anyway."""
+        the slot's page mapping is untouched (vacated pages are reused
+        when the length catches up again) and the vacated rows are
+        invalidated deterministically by the length mask every
+        executable applies: positions >= the new length are never read,
+        and the next append overwrites them.  The device page-table
+        upload cache is deliberately NOT touched (the invalidate-only-
+        on-table-mutation contract holds): tables do not change here,
+        and lengths re-upload every step anyway."""
         if slot not in self._pages_of:
             raise MXNetError("truncate of unallocated slot %r" % (slot,))
         n = int(n_tokens)

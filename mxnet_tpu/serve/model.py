@@ -249,38 +249,90 @@ def full_forward(params, tokens, cfg, exact=None, block=None,
     return logits
 
 
-def prefill_forward(params, tokens, length, table_row, k_pool, v_pool,
-                    cfg, page_size, exact=None):
-    """Bucketed prefill: run the full forward over one padded prompt and
-    write its KV into the slot's reserved pages.
+def prefill_forward(params, tokens, length, offset, table_row, k_pool,
+                    v_pool, cfg, page_size, exact=None):
+    """Bucketed prefill over one suffix chunk: write the chunk's KV into
+    the slot's pages and attend each row over everything at or before
+    its absolute position — including KV the slot did NOT compute this
+    dispatch (prefix-cache hit pages, earlier chunks of a chunked or
+    resumed prefill).
 
-    tokens: (1, Tb) prompt padded to the bucket length (a multiple of
-    ``page_size``); length: () int32 true prompt length; table_row:
-    (max_pages,) int32 page ids — entries beyond the slot's reservation
-    point at the trash page, so padded-position garbage lands where no
-    reader looks.  Returns (first_token, last_logits, k_pool, v_pool);
-    the pools are donate-safe.
+    tokens: (1, Tb) chunk padded to the bucket length (a multiple of
+    ``page_size``); length: () int32 real tokens in THIS chunk;
+    offset: () int32 absolute position of the chunk's first token (a
+    ``page_size`` multiple — chunks are page-aligned; 0 reproduces the
+    classic whole-prompt prefill); table_row: (max_pages,) int32 page
+    ids — entries beyond the slot's mapped pages point at the trash
+    page.  Returns (first_token, last_logits, k_pool, v_pool) where
+    ``last_logits`` is the logits at chunk position ``length - 1``
+    (absolute position ``offset + length - 1``); the pools are
+    donate-safe.
+
+    The body is :func:`verify_step` for one slot: per-row absolute
+    positions, write-then-gather page scatter, and the shared
+    online-softmax kernel with per-row validity horizons
+    ``offset + j + 1`` — so row ``j`` reads the cached prefix plus
+    chunk rows ``<= j`` and nothing else.  The same M-invariant
+    transitivity that makes verify rows bit-identical to serial decode
+    makes an offset-0 dispatch of this function bit-identical to the
+    old whole-prompt flash prefill, and a suffix dispatch bit-identical
+    to having prefilled the whole prompt cold.  Rows whose absolute
+    page index runs past the table are routed to the trash page
+    *in-graph* (a clipped index would alias the slot's LAST real page
+    and corrupt it — bucket padding can overhang the mapped range when
+    ``offset > 0``); their positions exceed every row's horizon, so
+    nothing reads them.
     """
     import jax.numpy as jnp
 
     if exact is None:
         exact = exact_mode()
+    params = _resolve_params(params)
     _, t_b = tokens.shape
     if t_b % page_size:
         raise MXNetError("bucket length %d not a multiple of page size %d"
                          % (t_b, page_size))
-    logits, kvs = full_forward(params, tokens, cfg, exact=exact,
-                               block=page_size, return_kv=True)
-    n_pages = t_b // page_size
     h, d = cfg.num_heads, cfg.head_dim
-    for i, (k, v) in enumerate(kvs):
-        # (1, H, Tb, D) -> (Tb, H, D) -> page-major blocks
-        kp = k[0].transpose(1, 0, 2).reshape(n_pages, page_size, h, d)
-        vp = v[0].transpose(1, 0, 2).reshape(n_pages, page_size, h, d)
-        for j in range(n_pages):
-            page = table_row[j]
-            k_pool = k_pool.at[i, page].set(kp[j])
-            v_pool = v_pool.at[i, page].set(vp[j])
+    max_pages = table_row.shape[0]
+    trash = k_pool.shape[1] - 1  # pool row num_pages, static in-graph
+    offs = jnp.arange(t_b, dtype=jnp.int32)
+    abs_pos = offset + offs                               # (Tb,)
+    pos = jnp.clip(abs_pos, 0, cfg.max_len - 1)
+    x = jnp.take(params["tok_embed_weight"], tokens.astype(jnp.int32),
+                 axis=0)
+    x = x + jnp.take(params["pos_embed"][0], pos, axis=0)
+    row_valid = (abs_pos + 1).reshape(1, t_b)             # keys row j sees
+    idx = abs_pos // page_size
+    pages = jnp.where(idx < max_pages,
+                      table_row[jnp.clip(idx, 0, max_pages - 1)], trash)
+    offsets = abs_pos % page_size
+    for i in range(cfg.num_layers):
+        hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
+                          params["blk%d_ln1_beta" % i])
+        qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
+            + params["blk%d_attn_in_bias" % i]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # append the chunk's KV at its absolute rows (one vectorized
+        # scatter; only trash rows can collide, and nothing reads them)
+        k_pool = k_pool.at[i, pages, offsets].set(
+            k.reshape(t_b, h, d).astype(k_pool.dtype))
+        v_pool = v_pool.at[i, pages, offsets].set(
+            v.reshape(t_b, h, d).astype(v_pool.dtype))
+        ctx_k = k_pool[i][table_row].reshape(1, max_pages * page_size,
+                                             h, d).transpose(0, 2, 1, 3)
+        ctx_v = v_pool[i][table_row].reshape(1, max_pages * page_size,
+                                             h, d).transpose(0, 2, 1, 3)
+        att = decode_attention(
+            q.reshape(1, t_b, h, d).transpose(0, 2, 1, 3),
+            ctx_k, ctx_v, row_valid, block=page_size, mi=exact)
+        ctx = att.transpose(0, 2, 1, 3).reshape(1, t_b, cfg.d_model)
+        out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+            + params["blk%d_attn_out_bias" % i]
+        x = x + out
+        x = _block_mlp(params, i, x, exact)
+    x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
+    logits = _mm(x, params["lm_head_weight"], exact) \
+        + params["lm_head_bias"]
     last = jnp.take(logits[0], length - 1, axis=0)
     first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
     return first_token, last, k_pool, v_pool

@@ -27,12 +27,41 @@ tokens one at a time so EOS / ``max_new`` cut at exactly the token a
 non-speculative run would have stopped at (greedy acceptance is exact,
 so the streams are bit-identical).
 
+Preemption and resume (oversubscribed sessions,
+``session.config.oversub``): before every step the scheduler probes the
+session's page shortfall for the coming boundary; when shortfall plus
+the configured watermark exceeds the pool's reclaimable pages it
+preempts the *coldest* active request — least queue seniority, i.e.
+latest arrival (ties: highest rid) — releasing its pages (refcount-
+aware, so shared prefix pages survive for their other holders) and
+parking it.  Parked requests resume with top priority: their transcript
+(prompt + committed tokens) re-prefills through the chunked offset
+prefill, and because prefill is deterministic and decode M-invariant
+exact, the recomputed stream is bit-identical to a never-evicted one —
+the resume asserts it by checking the replayed token against the last
+committed one.  Oversubscription changes capacity, never content.
+
+SLO-aware admission (``session.config.ttft_slo_ms`` > 0): arrivals are
+admitted can-still-meet-the-TTFT-budget first (FIFO within each class),
+so a burst spends its slots on requests that still count toward
+goodput; :func:`summarize` reports ``goodput_rps`` and
+``slo_attainment`` when given the budget.
+
+Closed-loop driving: ``run(requests, followup=...)`` calls ``followup(
+finished_request, now_s)`` at every completion; returned requests join
+the arrival queue — that is how the bench holds concurrency constant
+instead of replaying a fixed open-loop trace.
+
 Fault sites (``testing/faults.py``): every admit / decode-step /
 response boundary crosses ``serve_queue`` plus a phase-specific site
 (``serve_admit`` / ``serve_decode`` — or ``serve_verify`` when
-speculation is on — / ``serve_respond``).  A fault fails *that request
-only*: its slot is released and surviving slots keep decoding — the
-chaos tests assert exactly this isolation.
+speculation is on — / ``serve_respond``), and the preemption machinery
+adds ``serve_evict`` (before a victim's pages are released) and
+``serve_resume`` (before a parked request re-prefills).  A fault fails
+*that request only*: its slot is released and surviving slots keep
+decoding — the chaos tests assert exactly this isolation, including
+that a faulted eviction/resume leaves shared prefix pages and the
+survivors' streams intact.
 """
 from __future__ import annotations
 
@@ -62,6 +91,7 @@ class Request:
     done_s: float = -1.0
     failed: bool = False
     error: str = ""
+    preemptions: int = 0  # times this request was evicted and parked
 
     @property
     def finished(self):
@@ -77,6 +107,10 @@ class Scheduler(object):
                              % (policy, ", ".join(_POLICIES)))
         self.session = session
         self.policy = policy
+        self.stats = {"preemptions": 0, "resumes": 0, "peak_active": 0}
+        self._followup = None
+        self._pending = None
+        self._queue = None
 
     # -- fault boundaries -------------------------------------------------
     def _boundary(self, req, slot, site):
@@ -103,21 +137,69 @@ class Scheduler(object):
                 pass
 
     # -- the run loop -----------------------------------------------------
-    def run(self, requests):
+    def run(self, requests, followup=None):
         """Replay ``requests`` (sorted by ``arrival_s``) to completion;
-        returns ``(requests, makespan_s)``."""
+        returns ``(requests, makespan_s)``.  ``followup(request,
+        now_s)``, when given, is called as each request finishes and may
+        return a new :class:`Request` (or list of them) to enqueue —
+        the closed-loop driving hook; generated requests are included
+        in the returned list."""
         sess = self.session
         queue = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
         pending = list(queue)
+        parked = []  # preempted requests, in eviction order
         active = {}  # slot -> Request
+        self.stats = {"preemptions": 0, "resumes": 0, "peak_active": 0}
+        self._followup = followup
+        self._pending = pending
+        self._queue = queue
         t0 = time.perf_counter()
 
         def now():
             return time.perf_counter() - t0
 
-        while pending or active:
+        slo_s = float(getattr(sess.config, "ttft_slo_ms", 0.0)) / 1000.0
+        oversub = bool(getattr(sess.config, "oversub", False))
+
+        while pending or parked or active:
+            # 0) resume parked requests first — they hold queue
+            # seniority over fresh arrivals, and their transcript pages
+            # often still sit in the prefix cache
+            for req in list(parked):
+                if not self._boundary(req, None, "serve_resume"):
+                    parked.remove(req)
+                    continue
+                seq = list(req.prompt) + req.tokens[:-1]
+                budget = req.max_new - len(req.tokens) + 1
+                slot = sess.try_alloc(len(seq), budget, tokens=seq,
+                                      resume=True)
+                if slot is None:
+                    if not active and not pending:
+                        raise MXNetError(
+                            "parked request %d cannot resume into an "
+                            "idle session — pool smaller than one "
+                            "request's worst case" % req.rid)
+                    break
+                parked.remove(req)
+                first, _ = sess.prefill(slot, seq)
+                if first != req.tokens[-1]:
+                    raise MXNetError(
+                        "resume replay diverged for request %d: "
+                        "re-prefill produced token %d, committed stream "
+                        "holds %d — determinism bug"
+                        % (req.rid, first, req.tokens[-1]))
+                active[slot] = req
+                self.stats["resumes"] += 1
+
             # 1) admit whatever the policy allows right now
             arrived = [r for r in pending if r.arrival_s <= now()]
+            if slo_s > 0:
+                # requests that can still meet the TTFT budget first
+                # (FIFO within each class): a burst spends its slots on
+                # goodput, not on arrivals that already blew the budget
+                t = now()
+                arrived.sort(key=lambda r: ((t - r.arrival_s) > slo_s,
+                                            r.arrival_s, r.rid))
             if self.policy == "serial":
                 admit_cap = 1 if not active else 0
             elif self.policy == "static":
@@ -128,7 +210,8 @@ class Scheduler(object):
                 if not self._boundary(req, None, "serve_admit"):
                     pending.remove(req)
                     continue
-                slot = sess.try_alloc(len(req.prompt), req.max_new)
+                slot = sess.try_alloc(len(req.prompt), req.max_new,
+                                      tokens=req.prompt)
                 if slot is None:
                     break  # pool full: stays queued for a later boundary
                 pending.remove(req)
@@ -138,9 +221,11 @@ class Scheduler(object):
                 active[slot] = req
                 if len(req.tokens) >= req.max_new or first == req.eos_id:
                     self._finish(req, slot, active, now)
+            self.stats["peak_active"] = max(self.stats["peak_active"],
+                                            len(active))
 
             if not active:
-                if pending:
+                if pending and not parked:
                     # idle until the next arrival (open-loop replay)
                     wait = min(r.arrival_s for r in pending) - now()
                     if wait > 0:
@@ -154,6 +239,33 @@ class Scheduler(object):
                 req = active[slot]
                 if not self._boundary(req, slot, site):
                     del active[slot]
+
+            if not active:
+                continue
+
+            # 2b) watermark preemption: if the coming step's page
+            # growth would drain the pool below the watermark, evict
+            # the coldest request(s) — latest arrival, ties highest rid
+            # — park them, and let the survivors step.  The last active
+            # request is never evicted (it can always finish: one
+            # request's worst case fits the pool by construction).
+            if oversub:
+                rows = sess.config.spec_window if spec else 1
+                wm = max(int(getattr(sess.config, "watermark", 0)), 0)
+                while (len(active) > 1
+                       and sess.pages_short(rows) + wm
+                       > sess.cache.reclaimable_pages):
+                    victim_slot = max(
+                        active, key=lambda s: (active[s].arrival_s,
+                                               active[s].rid))
+                    victim = active.pop(victim_slot)
+                    if not self._boundary(victim, victim_slot,
+                                          "serve_evict"):
+                        continue  # fault: failed + slot released
+                    sess.release(victim_slot)  # shared pages survive
+                    victim.preemptions += 1
+                    parked.append(victim)
+                    self.stats["preemptions"] += 1
 
             if not active:
                 continue
@@ -191,6 +303,12 @@ class Scheduler(object):
         if self._boundary(req, slot, "serve_respond"):
             req.done_s = now()
             self.session.release(slot)
+        if self._followup is not None:
+            nxt = self._followup(req, now())
+            if nxt is not None:
+                for r in (nxt if isinstance(nxt, (list, tuple)) else [nxt]):
+                    self._pending.append(r)
+                    self._queue.append(r)
 
 
 def _percentile(values, pct):
@@ -201,8 +319,12 @@ def _percentile(values, pct):
     return float(vals[idx])
 
 
-def summarize(requests, makespan_s):
-    """Latency/throughput rollup the bench emits per policy."""
+def summarize(requests, makespan_s, ttft_slo_ms=0.0):
+    """Latency/throughput rollup the bench emits per policy.  With a
+    TTFT budget (``ttft_slo_ms`` > 0) it additionally reports
+    ``goodput_rps`` — completed requests that met the budget, per
+    second — and ``slo_attainment``, the met-budget fraction of
+    completions (the closed-loop bench's primary metric)."""
     done = [r for r in requests if r.done_s >= 0.0 and not r.failed]
     failed = [r for r in requests if r.failed]
     ttfts = [r.ttft_s for r in done if r.ttft_s >= 0.0]
@@ -213,7 +335,7 @@ def summarize(requests, makespan_s):
         if len(r.tokens) > 1 and r.ttft_s >= 0.0:
             decode_span = (r.done_s - r.arrival_s) - r.ttft_s
             per_token.append(decode_span / (len(r.tokens) - 1))
-    return {
+    out = {
         "completed": len(done),
         "failed": len(failed),
         "total_tokens": total_tokens,
@@ -225,3 +347,10 @@ def summarize(requests, makespan_s):
         "per_token_p50_s": _percentile(per_token, 50),
         "per_token_p99_s": _percentile(per_token, 99),
     }
+    if ttft_slo_ms > 0:
+        slo_s = float(ttft_slo_ms) / 1000.0
+        good = sum(1 for r in done if 0.0 <= r.ttft_s <= slo_s)
+        out["ttft_slo_ms"] = float(ttft_slo_ms)
+        out["goodput_rps"] = (good / makespan_s) if makespan_s > 0 else 0.0
+        out["slo_attainment"] = (good / float(len(done))) if done else 0.0
+    return out

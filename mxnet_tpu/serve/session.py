@@ -49,10 +49,30 @@ speculative decoding composes unchanged).  Quantized and full-precision
 sessions never alias recompile guards: the guard prefix grows a
 ``-q<mode>`` tag.
 
+Prefix caching (``prefix_pages`` / ``MXNET_SERVE_PREFIX_PAGES``): after
+every prefill the slot's full prompt pages are published into the KV
+cache's token-hash index; a later admission whose prompt chain hits the
+index maps those pages read-only (reference-counted, copy-on-write) and
+prefills only the uncached suffix.  The suffix runs through the SAME
+per-bucket prefill executables — each takes a position ``offset``
+argument, so a suffix chunk is just a dispatch at a non-zero offset and
+the executable count stays frozen.  The offset also enables *chunked*
+prefill (sequences longer than the largest bucket run as page-aligned
+max-bucket chunks), which is what lets a preempted request re-prefill
+its whole transcript on resume.
+
+Oversubscription (``oversub`` / ``MXNET_SERVE_OVERSUB``): admission
+reserves only the prompt's pages; every decode/verify boundary grows
+active slots on demand (:meth:`InferenceSession.pages_short` is the
+scheduler's shortfall probe, and the scheduler preempts requests when
+the pool runs below its watermark before the growth would fail).
+
 Env knobs (see docs/env_vars.md): ``MXNET_SERVE_SLOTS``,
 ``MXNET_SERVE_PAGE``, ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_NEW``,
 ``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``, ``MXNET_SERVE_SPEC_K``,
-``MXNET_SERVE_DRAFT``, ``MXNET_SERVE_QUANT``.
+``MXNET_SERVE_DRAFT``, ``MXNET_SERVE_QUANT``,
+``MXNET_SERVE_PREFIX_PAGES``, ``MXNET_SERVE_OVERSUB``,
+``MXNET_SERVE_WATERMARK``, ``MXNET_SERVE_TTFT_SLO_MS``.
 """
 from __future__ import annotations
 
@@ -102,6 +122,10 @@ class ServeConfig:
     spec_k: int = 0  # 0 = speculative decoding off
     draft: str = ""  # "", "ngram", "layers:N", or a checkpoint dir
     quant: str = ""  # "", "int8", or "fp8" weight-only quantization
+    prefix_pages: int = 0  # 0 = prefix cache off; -1 = unbounded retention
+    oversub: bool = False  # admit by current need, grow on demand
+    watermark: int = 0  # free-pool floor that triggers preemption
+    ttft_slo_ms: float = 0.0  # 0 = no TTFT budget (SLO admission off)
 
     @classmethod
     def from_env(cls, **overrides):
@@ -116,6 +140,10 @@ class ServeConfig:
             spec_k=get_env("MXNET_SERVE_SPEC_K", 0, int),
             draft=get_env("MXNET_SERVE_DRAFT", "", str),
             quant=get_env("MXNET_SERVE_QUANT", "", str),
+            prefix_pages=get_env("MXNET_SERVE_PREFIX_PAGES", 0, int),
+            oversub=get_env("MXNET_SERVE_OVERSUB", False, bool),
+            watermark=get_env("MXNET_SERVE_WATERMARK", 0, int),
+            ttft_slo_ms=get_env("MXNET_SERVE_TTFT_SLO_MS", 0.0, float),
         )
         vals.update(overrides)
         return cls(**vals)
@@ -128,6 +156,12 @@ class ServeConfig:
                              "be >= 1")
         if self.spec_k < 0:
             raise MXNetError("ServeConfig: spec_k must be >= 0")
+        if self.prefix_pages < -1:
+            raise MXNetError("ServeConfig: prefix_pages must be >= -1")
+        if self.watermark < 0:
+            raise MXNetError("ServeConfig: watermark must be >= 0")
+        if self.ttft_slo_ms < 0:
+            raise MXNetError("ServeConfig: ttft_slo_ms must be >= 0")
         for b in self.buckets:
             if b % self.page_size:
                 raise MXNetError(
@@ -231,7 +265,8 @@ class InferenceSession(object):
             num_pages=cfg.pool_pages,
             slots=cfg.slots,
             max_pages_per_slot=cfg.max_pages_per_slot,
-            table_pad=cfg.spec_pad_pages)
+            table_pad=cfg.spec_pad_pages,
+            prefix_pages=cfg.prefix_pages)
         self._slot_tokens = {}  # slot -> next token to feed the decoder
         self._slot_history = {}  # slot -> prompt + committed tokens
         self._spec_stats = {"verify_steps": 0, "slot_steps": 0,
@@ -331,7 +366,8 @@ class InferenceSession(object):
             num_pages=cfg.pool_pages,
             slots=cfg.slots,
             max_pages_per_slot=cfg.max_pages_per_slot,
-            table_pad=cfg.spec_pad_pages)
+            table_pad=cfg.spec_pad_pages,
+            prefix_pages=cfg.prefix_pages)
 
     # -- compilation ------------------------------------------------------
     def _aot(self, name, fn, avals, donate_argnums):
@@ -410,17 +446,18 @@ class InferenceSession(object):
             donate_argnums=(4, 5))
 
         for bucket in cfg.buckets:
-            def prefill_fn(params, tokens, length, table_row, k_pool,
-                           v_pool):
-                return prefill_forward(params, tokens, length, table_row,
-                                       k_pool, v_pool, model, psize,
-                                       exact=exact)
+            def prefill_fn(params, tokens, length, offset, table_row,
+                           k_pool, v_pool):
+                return prefill_forward(params, tokens, length, offset,
+                                       table_row, k_pool, v_pool, model,
+                                       psize, exact=exact)
 
             self._aot(
                 "prefill_%d" % bucket, prefill_fn,
                 (param_avals, sds((1, bucket), i32), sds((), i32),
-                 sds((max_pages,), i32), pool_aval, pool_aval),
-                donate_argnums=(4, 5))
+                 sds((), i32), sds((max_pages,), i32), pool_aval,
+                 pool_aval),
+                donate_argnums=(5, 6))
 
         if cfg.spec_k:
             w = cfg.spec_window
@@ -503,51 +540,111 @@ class InferenceSession(object):
             "prompt of %d tokens exceeds the largest prefill bucket %d"
             % (prompt_len, max(self.config.buckets)))
 
-    def try_alloc(self, prompt_len, max_new=None):
+    def try_alloc(self, prompt_len, max_new=None, tokens=None,
+                  resume=False):
         """Reserve a slot for a request, or return ``None`` when the
-        cache can't admit it right now."""
+        cache can't admit it right now.
+
+        ``tokens`` (the prompt's token ids) enables the prefix-cache
+        lookup: published pages whose chain matches are mapped into the
+        slot and :meth:`PagedKVCache.cached_len` reports the positions
+        prefill may skip.  ``resume=True`` lifts the bucket-length check
+        (a preempted request's re-prefill sequence — prompt plus already
+        committed tokens — may exceed the largest bucket; chunked
+        prefill covers it, and page capacity is still enforced because
+        the resumed worst case equals the original one)."""
         if prompt_len < 1:
             raise MXNetError("empty prompt")
-        self.bucket_for(prompt_len)  # validates length
+        if not resume:
+            self.bucket_for(prompt_len)  # validates length
         max_new = self.config.max_new if max_new is None else int(max_new)
         if max_new > self.config.max_new:
             raise MXNetError("max_new %d exceeds the session cap %d"
                              % (max_new, self.config.max_new))
-        slot = self.cache.alloc(prompt_len, max_new)
-        if slot is not None and self.draft_cache is not None:
-            # identical geometry + identical alloc/release sequences keep
-            # the two caches' deterministic free lists in lockstep
-            dslot = self.draft_cache.alloc(prompt_len, max_new)
-            if dslot != slot:
+        toks = None
+        if tokens is not None:
+            toks = [int(t) for t in tokens]
+            if len(toks) != int(prompt_len):
                 raise MXNetError(
-                    "draft cache desync: target slot %r vs draft slot %r"
-                    % (slot, dslot))
+                    "try_alloc: tokens length %d != prompt_len %d"
+                    % (len(toks), prompt_len))
+        oversub = self.config.oversub
+        slot = self.cache.alloc(prompt_len, max_new, tokens=toks,
+                                oversub=oversub)
+        if slot is not None and self.draft_cache is not None:
+            # identical geometry + identical alloc/release/publish
+            # sequences keep the two caches' deterministic free lists
+            # AND prefix indexes in lockstep (the draft's hit pages hold
+            # draft-model KV for the same token chain)
+            dslot = self.draft_cache.alloc(prompt_len, max_new,
+                                           tokens=toks, oversub=oversub)
+            if dslot != slot or (self.draft_cache.cached_len(dslot)
+                                 != self.cache.cached_len(slot)):
+                raise MXNetError(
+                    "draft cache desync: target slot %r (cached %d) vs "
+                    "draft slot %r (cached %d)"
+                    % (slot, self.cache.cached_len(slot), dslot,
+                       self.draft_cache.cached_len(dslot)
+                       if dslot is not None else -1))
         return slot
+
+    def _chunk_bucket(self, remaining):
+        """Bucket for one prefill chunk: the smallest that fits, else
+        the largest (a further chunk follows — max buckets are page
+        multiples, so the next offset stays page-aligned)."""
+        for b in self.config.buckets:
+            if remaining <= b:
+                return b
+        return max(self.config.buckets)
 
     def prefill(self, slot, prompt_tokens):
         """Run the bucketed prefill for ``slot``; returns
-        ``(first_token, last_logits)``."""
+        ``(first_token, last_logits)``.
+
+        Only the *uncached suffix* is computed: prompt positions covered
+        by prefix-cache hit pages (``cache.cached_len``) are skipped,
+        and the rest runs in page-aligned chunks through the per-bucket
+        offset-taking executables — one chunk for a classic in-bucket
+        prompt, several max-bucket chunks for a resumed transcript
+        longer than the largest bucket.  Afterwards the slot's full
+        prompt pages are published into the prefix index for future
+        admissions."""
         import numpy as np
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         p = int(prompt.shape[0])
-        bucket = self.bucket_for(p)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :p] = prompt
-        args = (self.params, jnp.asarray(toks), jnp.asarray(p, jnp.int32),
-                self.cache.table_row(slot), self.cache.k_pool,
-                self.cache.v_pool)
-        first, last_logits, k_pool, v_pool = self._dispatch(
-            "prefill_%d" % bucket, args)
-        self.cache.k_pool = k_pool
-        self.cache.v_pool = v_pool
-        self.cache.lengths[slot] = p
+        cached = self.cache.cached_len(slot)
+        if not 0 <= cached < p:
+            raise MXNetError("prefill: cached prefix %d outside prompt "
+                             "of %d tokens" % (cached, p))
+        first = last_logits = None
+        off = cached
+        while off < p:
+            bucket = self._chunk_bucket(p - off)
+            n = min(p - off, bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = prompt[off:off + n]
+            self.cache.ensure_writable(slot, off, n)
+            args = (self.params, jnp.asarray(toks),
+                    jnp.asarray(n, jnp.int32),
+                    jnp.asarray(off, jnp.int32),
+                    self.cache.table_row(slot), self.cache.k_pool,
+                    self.cache.v_pool)
+            first, last_logits, k_pool, v_pool = self._dispatch(
+                "prefill_%d" % bucket, args)
+            self.cache.k_pool = k_pool
+            self.cache.v_pool = v_pool
+            off += n
+            self.cache.lengths[slot] = off
         first = int(first)
         self._slot_tokens[slot] = first
         self._slot_history[slot] = [int(t) for t in prompt] + [first]
+        prompt_list = [int(t) for t in prompt]
+        self.cache.register_prefix(slot, prompt_list)
         if self._draft_mode == "model":
             self._draft_ingest(slot, prompt)
+            self.draft_cache.register_prefix(slot, prompt_list)
         return first, np.asarray(last_logits)
 
     def _draft_ingest(self, slot, prompt):
@@ -566,7 +663,12 @@ class InferenceSession(object):
         cfg = self.config
         w = cfg.spec_window
         p = int(prompt.shape[0])
-        for off in range(0, p, w):
+        # prefix hits skip ingestion too: the draft's hit pages already
+        # hold draft-model KV for the cached positions (same chain, same
+        # lockstep publication), and alloc left lengths at cached_len
+        cached = self.draft_cache.cached_len(slot)
+        self.draft_cache.ensure_writable(slot, cached, p - cached)
+        for off in range(cached, p, w):
             chunk = prompt[off:off + w]
             toks = np.zeros((cfg.slots, w), np.int32)
             toks[slot, :len(chunk)] = chunk
@@ -590,6 +692,7 @@ class InferenceSession(object):
         import jax.numpy as jnp
 
         cfg = self.config
+        self._pre_dispatch(1)
         tokens = np.zeros((cfg.slots,), np.int32)
         for slot, tok in self._slot_tokens.items():
             tokens[slot] = tok
@@ -641,6 +744,7 @@ class InferenceSession(object):
             return out
         w, k = cfg.spec_window, cfg.spec_k
         active = sorted(self._slot_tokens)
+        self._pre_dispatch(w)
         tokens = np.zeros((cfg.slots, w), np.int32)
         for slot, tok in self._slot_tokens.items():
             tokens[slot, 0] = tok
@@ -725,6 +829,40 @@ class InferenceSession(object):
             rep["committed"] / float(rep["slot_steps"])
             if rep["slot_steps"] else 0.0)
         return rep
+
+    def _pre_dispatch(self, rows):
+        """Per-boundary page upkeep before a decode/verify/draft
+        dispatch writes ``rows`` KV rows per active slot: grow
+        oversubscribed slots to cover their next rows (a no-op under
+        reservation admission — the pages are already mapped) and cross
+        the copy-on-write guard so no write can land in a shared or
+        published page.  The scheduler preempts on the watermark BEFORE
+        stepping, so growth here never finds an empty pool."""
+        cfg = self.config
+        for slot in sorted(self._slot_tokens):
+            n = int(self.cache.lengths[slot])
+            if cfg.oversub:
+                self.cache.append_pages(slot, n + rows)
+            self.cache.ensure_writable(slot, n, rows)
+            if self.draft_cache is not None:
+                dn = int(self.draft_cache.lengths[slot])
+                if cfg.oversub:
+                    self.draft_cache.append_pages(slot, dn + rows)
+                self.draft_cache.ensure_writable(slot, dn, rows)
+
+    def pages_short(self, rows=None):
+        """Fresh pages the next decode boundary must obtain across all
+        active slots — the scheduler compares this (plus its watermark)
+        against :attr:`PagedKVCache.reclaimable_pages` to decide whether
+        to preempt.  ``rows`` defaults to the step width (1, or the
+        speculative window)."""
+        if rows is None:
+            rows = self.config.spec_window if self.config.spec_k else 1
+        short = 0
+        for slot in self._slot_tokens:
+            short += self.cache.pages_short(
+                slot, int(self.cache.lengths[slot]) + rows)
+        return short
 
     def release(self, slot):
         self._slot_tokens.pop(slot, None)

@@ -71,9 +71,14 @@ crossed at *every* request boundary) plus its phase-specific companions
 ``serve_respond`` (admission, per-request decode-step, per-request
 speculative propose/verify-step, and response boundaries; a fault fails
 that one request and releases its slot — surviving slots keep decoding,
-the isolation the serve chaos tests assert).  The serve sites fire in
-deterministic slot order each step, so ``after=N`` picks a specific
-request.  ``data_decode`` fires inside each data-service decode task
+the isolation the serve chaos tests assert), and the oversubscription
+machinery's ``serve_evict`` (watermark preemption, before the victim's
+pages are released — a fault fails the victim alone; its release is
+refcount-aware, so shared prefix pages stay intact for other holders)
+and ``serve_resume`` (parked-request resume, before the re-prefill — a
+fault fails the parked request alone and survivors keep decoding).
+The serve sites fire in deterministic slot order each step, so
+``after=N`` picks a specific request.  ``data_decode`` fires inside each data-service decode task
 (in the worker *process* with ``num_workers > 0`` — hits are counted
 per process — or inline on the consumer thread with 0): ``raise``
 surfaces as a typed error at the consumer's ``next()``, ``kill``
@@ -124,6 +129,10 @@ SITES = {
     "serve_verify": "serving scheduler per-request speculative "
                     "propose/verify step",
     "serve_respond": "serving scheduler response boundary",
+    "serve_evict": "serving scheduler watermark preemption, before the "
+                   "victim's pages are released",
+    "serve_resume": "serving scheduler parked-request resume, before "
+                    "the re-prefill",
     "data_decode": "inside each data-service decode task (worker "
                    "process, or inline with num_workers=0)",
     "data_service": "data-service consumer next()",

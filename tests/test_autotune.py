@@ -204,7 +204,8 @@ def test_apply_serve_folds_record_into_session(monkeypatch, tmp_path):
     from mxnet_tpu import compile_cache, quantize
 
     params = serve_model.init_params(CFG, seed=3)
-    _seed_serve_record(params, {"quant": "int8", "buckets": [8, 16]},
+    _seed_serve_record(params, {"quant": "int8", "buckets": [8, 16],
+                                "prefix_pages": -1, "watermark": 2},
                        tmp_path)
     monkeypatch.setenv("MXNET_AUTOTUNE", "1")
     monkeypatch.setenv("MXNET_SERVE_PAGE", "8")
@@ -212,6 +213,8 @@ def test_apply_serve_folds_record_into_session(monkeypatch, tmp_path):
     sess = serve.InferenceSession(params, num_heads=CFG.num_heads)
     assert sess.config.quant == "int8"
     assert sess.config.buckets == (8, 16)
+    assert sess.config.prefix_pages == -1
+    assert sess.config.watermark == 2
     assert quantize.is_quantized(sess.params["blk0_ffn1_weight"])
     prov = compile_cache.report()["autotune"]
     assert prov and prov[-1]["where"] == "InferenceSession"

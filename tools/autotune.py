@@ -13,8 +13,10 @@ so it runs anywhere the store directory survives.
 
 ``--search`` imports mxnet_tpu and runs a measured greedy search on a
 small built-in model: ``serve`` sweeps {quant mode, prefill-bucket
-ladder} against decode tokens/s (``bench_serve.py``-style timing, with
-``memory_analysis`` temp bytes as the tie-breaker); ``train`` sweeps
+ladder, prefix-cache retention pages, eviction watermark} against end
+to-end tokens/s on an oversubscribed shared-preamble scheduler run
+(``bench_serve.py``-style rig, with ``memory_analysis`` temp bytes as
+the tie-breaker); ``train`` sweeps
 {attn block, grad bucket MB} against fused-step steps/s
 (``bench_fit.py``-style).  Results land in the store; any later build
 with ``MXNET_AUTOTUNE=1`` and a matching fingerprint applies them with
@@ -93,7 +95,11 @@ def print_records(directory):
 
 
 def search_serve(directory, budget):
-    """Measured serve-knob search on the built-in small LM."""
+    """Measured serve-knob search on the built-in small LM.  The rig is
+    an oversubscribed, prefix-heavy scheduler run — a 20-page pool
+    under 12 shared-preamble requests on 8 slots — so the eviction
+    watermark and prefix-cache retention knobs move the metric (end to
+    end tokens/s) alongside quant mode and the bucket ladder."""
     from mxnet_tpu import autotune, serve
     from mxnet_tpu.serve import model as serve_model
 
@@ -106,34 +112,44 @@ def search_serve(directory, budget):
 
         sconf = serve.ServeConfig(
             slots=8, page_size=16, max_new=16, exact=True,
-            buckets=tuple(knobs["buckets"]), quant=knobs["quant"])
+            buckets=tuple(knobs["buckets"]), quant=knobs["quant"],
+            prefix_pages=int(knobs["prefix_pages"]),
+            oversub=True, watermark=int(knobs["watermark"]),
+            num_pages=20)
         sess = serve.InferenceSession(params, num_heads=cfg.num_heads,
                                       config=sconf)
         rs = np.random.RandomState(11)
-        slots = []
-        for _ in range(sconf.slots):
-            slot = sess.try_alloc(9, sconf.max_new)
-            sess.prefill(slot, rs.randint(1, 127, size=9).tolist())
-            slots.append(slot)
-        for _ in range(2):
-            sess.step()
-        steps = 10
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            sess.step()
-        dt = time.perf_counter() - t0
-        for slot in slots:
-            sess.release(slot)
+        preamble = rs.randint(1, 127, size=32).tolist()
+
+        def trace():
+            return [serve.Request(
+                rid=i,
+                prompt=preamble + rs.randint(1, 127, size=7).tolist(),
+                max_new=sconf.max_new, arrival_s=0.0)
+                for i in range(12)]
+
+        serve.Scheduler(sess, policy="continuous").run(trace())  # warmup
+        sched = serve.Scheduler(sess, policy="continuous")
+        done, makespan = sched.run(trace())
+        summary = serve.summarize(done, makespan)
+        if summary["failed"]:
+            raise RuntimeError("%d requests failed" % summary["failed"])
         mem = sess.memory_analysis("decode")
-        return {"metric": sconf.slots * steps / dt,
+        pstats = sess.cache.prefix_stats
+        return {"metric": summary["tokens_per_sec"],
                 "aux": {"temp_bytes": mem.get("temp_size_in_bytes"),
                         "argument_bytes":
                             mem.get("argument_size_in_bytes"),
-                        "at_rest_bytes": sess.params_bytes_at_rest()}}
+                        "at_rest_bytes": sess.params_bytes_at_rest(),
+                        "preemptions": sched.stats["preemptions"],
+                        "prefix_hits": pstats["hits"],
+                        "prefix_hit_tokens": pstats["hit_tokens"]}}
 
     space = [
         autotune.Knob("quant", ("", "int8", "fp8")),
         autotune.Knob("buckets", ((16, 32, 64), (16, 64), (64,))),
+        autotune.Knob("prefix_pages", (0, -1, 8)),
+        autotune.Knob("watermark", (0, 1, 4)),
     ]
     key = autotune.Key("serve", autotune.fingerprint(params))
     rec = autotune.search(measure, space, key,
