@@ -320,6 +320,21 @@ def main():
         except Exception as exc:  # keep the primary metric robust
             result["zero_ab_error"] = str(exc)[:200]
         _emit_partial()
+    # composed-plan A/B row: pure DP vs tp(2) x zero3 vs pipe(2) —
+    # per-replica params/opt-state bytes, step ratios and gather
+    # traffic under ONE ParallelPlan declaration
+    # (bench_fit.measure_plan_ab; skipped below 4 devices)
+    if not fp32 and "--resnet-only" not in sys.argv:
+        try:
+            import bench_fit
+
+            psym = bench_fit.build_sym(512, 1024, 10)
+            prow = bench_fit.measure_plan_ab(psym, 64, 512)
+            for k, v in prow.items():
+                result[k] = v
+        except Exception as exc:  # mxlint: disable=MX008 — the one-JSON-line contract survives a failed A/B row
+            result["plan_ab_error"] = str(exc)[:200]
+        _emit_partial()
     # data-plane summary row: multiprocess decode pool vs the GIL-bound
     # thread pool over real JPEGs (bench_fit.measure_decode_ab has the
     # full A/B; small config here — the claim under test is decode

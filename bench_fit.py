@@ -148,6 +148,104 @@ def measure_zero_ab(sym, batch, feat, iters=30):
     return out
 
 
+def measure_plan_ab(sym, batch, feat, iters=20):
+    """Composed-plan A/B over the local devices: pure DP (replicated)
+    vs tp(2) x zero3 vs pipe(2) x stage-sharding.  Reports per-replica
+    at-rest params/opt-state bytes (the composition's memory claim:
+    tp x zero3 must land well under 1/model of pure DP), the step-rate
+    ratios, and the per-step gather traffic.  Adam, so the state is
+    real.  Skipped below 4 devices — the composed mesh needs a
+    nontrivial (data, model) grid."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.fused import TrainStep
+    from mxnet_tpu.parallel import (ParallelPlan, PipelineTrainStep,
+                                    create_mesh, mesh_scope)
+
+    ndev = len(jax.devices())
+    if ndev < 4 or ndev % 2 or batch % ndev:
+        return {}
+    shapes = {"data": (batch, feat), "softmax_label": (batch,)}
+    rng = jax.random.PRNGKey(0)
+    bd = {"data": jax.random.normal(rng, shapes["data"], "float32"),
+          "softmax_label": jax.numpy.zeros(shapes["softmax_label"],
+                                           "float32")}
+    out = {"plan_ndev": ndev}
+    rates = {}
+    plans = {
+        "dp": ParallelPlan(data=ndev, zero="off"),
+        "tp_zero3": ParallelPlan(data=ndev // 2, model=2, zero="3"),
+    }
+    for tag, plan in plans.items():
+        step = TrainStep(sym, optimizer="adam",
+                         optimizer_params={"learning_rate": 0.125,
+                                           "rescale_grad": 1.0 / batch},
+                         plan=plan)
+        params, aux, states = step.init_state(shapes)
+        params, aux, states, out_ = step(params, aux, states, bd, rng)
+        float(np.asarray(out_[0][0, 0]))  # compile + force
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, aux, states, out_ = step(params, aux, states, bd,
+                                             rng)
+        float(np.asarray(out_[0][0, 0]))
+        rates[tag] = batch * iters / (time.perf_counter() - t0)
+        rep = step.memory_report(params, states)
+        out["plan_%s" % tag] = plan.fingerprint(step.mesh)
+        out["params_bytes_per_replica_%s" % tag] = \
+            int(rep["params_bytes_per_replica"])
+        out["opt_state_bytes_%s" % tag] = int(rep["opt_state_bytes"])
+        out["gather_bytes_per_step_%s" % tag] = \
+            int(rep["gather_bytes_per_step"])
+        out["%s_images_per_sec" % tag] = round(rates[tag], 2)
+    # pipeline row: stage-sharded packed buffers over a 2-way 'pipe'
+    # mesh — each replica holds 1/pipe of params AND opt state (the
+    # stage assignment is the sharding), the zero-1-like column
+    mesh = create_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    with mesh_scope(mesh):
+        pstep = PipelineTrainStep(
+            sym, optimizer="adam",
+            optimizer_params={"learning_rate": 0.125,
+                              "rescale_grad": 1.0 / batch},
+            mesh=mesh, n_microbatches=4)
+        params, aux, states = pstep.init_state(shapes)
+        params, aux, states_, _ = pstep(params, aux, states, bd, rng)
+        jax.block_until_ready(pstep._packed_params)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _, _, _, out_ = pstep(None, None, None, bd, rng)
+        jax.block_until_ready(out_)
+        rates["pp"] = batch * iters / (time.perf_counter() - t0)
+        packed_bytes = 0
+        for buf in (pstep._packed_params, pstep._packed_states):
+            if buf is None:
+                continue
+            shard = next(iter(buf.addressable_shards))
+            packed_bytes += int(shard.data.size * shard.data.itemsize)
+        out["pp_zero1_images_per_sec"] = round(rates["pp"], 2)
+        out["params_opt_bytes_per_replica_pp_zero1"] = packed_bytes
+        # each stage row pads to the LARGEST stage, so a param-lopsided
+        # split (compute-balanced cuts) erodes the 1/pipe claim — the
+        # balance ratio says how much of the resident bytes is padding
+        totals = [pk.total for pk in pstep._param_packers]
+        out["pp_stage_param_balance"] = round(
+            min(totals) / max(1, max(totals)), 4)
+    dp_total = (out["params_bytes_per_replica_dp"]
+                + out["opt_state_bytes_dp"])
+    tp_total = (out["params_bytes_per_replica_tp_zero3"]
+                + out["opt_state_bytes_tp_zero3"])
+    out["plan_tp_zero3_step_ratio"] = round(rates["tp_zero3"]
+                                            / rates["dp"], 4)
+    out["plan_pp_step_ratio"] = round(rates["pp"] / rates["dp"], 4)
+    out["plan_tp_zero3_state_shrink"] = round(dp_total / max(1, tp_total),
+                                              3)
+    out["plan_pp_state_shrink"] = round(
+        dp_total / max(1, out["params_opt_bytes_per_replica_pp_zero1"]),
+        3)
+    return out
+
+
 def make_host_work_iter(base, repeats):
     """Wrap a DataIter with a fixed slab of numpy work per batch — the
     stand-in for decode/augment cost.  Runs on whatever thread consumes
@@ -443,6 +541,11 @@ def main():
     # ZeRO sharded update A/B: state bytes must shrink ~1/N at >=95%
     # of the replicated step rate
     result.update(measure_zero_ab(sym, batch, feat))
+    # composed-plan A/B: pure DP vs tp x zero3 vs pipe x stage-sharding
+    try:
+        result.update(measure_plan_ab(sym, batch, feat))
+    except Exception as exc:  # mxlint: disable=MX008 — the one-JSON-line contract survives a failed A/B row
+        result["plan_ab_error"] = str(exc)[:200]
     # compile_s/step_s split + cache counters (fit's AOT warmup and the
     # pure-step AOT compile both record through profiler.compile_event)
     result.update(bench_util.compile_summary())

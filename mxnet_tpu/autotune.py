@@ -396,16 +396,28 @@ def apply_serve(config, params, store=None):
     return dataclasses.replace(config, **updates)
 
 
-def apply_train_env(symbol, mesh, store=None):
+def train_key_topology(mesh, plan=None):
+    """The Key ``mesh`` field for a train record: the plan fingerprint
+    (its own namespace) when a composed plan drives the step — tuned
+    knobs for a tp x zero3 plan must not leak onto pure-DP runs of the
+    same symbol on the same mesh — else the plain mesh description."""
+    if plan is not None:
+        return "plan:%s" % plan.fingerprint(mesh)
+    return mesh_desc(mesh)
+
+
+def apply_train_env(symbol, mesh, store=None, plan=None):
     """Arm cached train knobs (:data:`TRAIN_KNOB_ENV`) in the
     environment before a ``TrainStep`` traces — the ops read them at
     trace time.  A knob the user already set (either env prefix) is
-    never overridden.  Returns the record applied, or None."""
+    never overridden.  Records are keyed by topology —
+    :func:`train_key_topology` — so a composed plan's knobs stay scoped
+    to that plan.  Returns the record applied, or None."""
     if not autotune_enabled():
         return None
     store = store or AutotuneStore()
     rec = store.get(Key("train", fingerprint_symbol(symbol),
-                        mesh_desc(mesh)))
+                        train_key_topology(mesh, plan)))
     if not rec:
         return None
     knobs = rec.get("knobs") or {}

@@ -305,7 +305,7 @@ class CheckpointManager:
     # -- save -----------------------------------------------------------
     def save(self, module=None, epoch=0, nbatch=0, symbol=None,
              arg_params=None, aux_params=None, zero_states=None,
-             zero_params=None, num_update=None):
+             zero_params=None, num_update=None, plan=None):
         """Write one checkpoint.  Pass a bound ``module`` (params, aux,
         symbol and optimizer states are pulled from it) or explicit
         ``symbol``/``arg_params``/``aux_params``.  ``epoch`` counts
@@ -352,11 +352,17 @@ class CheckpointManager:
             return self._save_v1(module, epoch, nbatch, symbol,
                                  arg_params, aux_params)
 
+        if plan is None and module is not None:
+            # the composed ParallelPlan the module's step trains under:
+            # recorded in the manifest so a restore knows what topology
+            # wrote the tiles (assembly itself is shape-agnostic — any
+            # plan restores onto any other plan or unsharded)
+            plan = getattr(getattr(module, "_fused", None), "plan", None)
         os.makedirs(self.directory, exist_ok=True)
         snap = self._snapshot(module, epoch, nbatch, symbol, arg_params,
                               aux_params, zero_states=zero_states,
                               zero_params=zero_params,
-                              num_update=num_update)
+                              num_update=num_update, plan=plan)
         if self.async_writes and self._async_eligible():
             self._join_writer()  # depth-1 bound: one write in flight
             t = threading.Thread(target=self._commit_guarded, args=(snap,),
@@ -386,7 +392,7 @@ class CheckpointManager:
 
     def _snapshot(self, module, epoch, nbatch, symbol, arg_params,
                   aux_params, zero_states=None, zero_params=None,
-                  num_update=None):
+                  num_update=None, plan=None):
         """Device→host snapshot, on the calling thread: after this
         returns, the training loop may mutate params freely."""
         rank = self._rank()
@@ -426,6 +432,12 @@ class CheckpointManager:
                     "canonical_shape": [int(s)
                                         for s in ent["canonical_shape"]],
                 }
+                if ent.get("tp"):
+                    # plan-composed TP entry: the flat tile is
+                    # shard-major with per-shard padding — the restore
+                    # trim inverts per shard (zero.unflatten_tiles)
+                    zparams_meta[name]["tp"] = {
+                        k: int(v) for k, v in ent["tp"].items()}
                 if ent.get("quant"):
                     # weight-only quantized tiles (quantize.quantize_export):
                     # codes ride the pieces, mode + per-channel scales ride
@@ -457,6 +469,9 @@ class CheckpointManager:
                     "canonical_shape": [int(s)
                                         for s in ent["canonical_shape"]],
                 }
+                if ent.get("tp"):
+                    zero_meta[name]["tp"] = {
+                        k: int(v) for k, v in ent["tp"].items()}
                 for j, leaf in enumerate(ent["leaves"]):
                     _add("opt:%s/%d" % (name, j), leaf)
         states = None
@@ -468,6 +483,10 @@ class CheckpointManager:
             if module is not None else None
         if num_update is None:
             num_update = int(getattr(opt, "num_update", 0) or 0)
+        plan_meta = None
+        if plan is not None:
+            plan_meta = (plan.describe() if hasattr(plan, "describe")
+                         else dict(plan))
         return {"epoch": epoch, "nbatch": int(nbatch),
                 "num_update": int(num_update),
                 "symbol_json": symbol.tojson() if symbol is not None
@@ -475,7 +494,8 @@ class CheckpointManager:
                 "rank": rank, "nproc": self._num_workers(),
                 "params_meta": params_meta, "pieces": pieces,
                 "piece_map": piece_map, "states": states,
-                "zero_meta": zero_meta, "zparams_meta": zparams_meta}
+                "zero_meta": zero_meta, "zparams_meta": zparams_meta,
+                "plan": plan_meta}
 
     def _states_blob(self, module):
         """Optimizer states as bytes (the module API writes files, so
@@ -573,7 +593,8 @@ class CheckpointManager:
                 "shards": self._merge_sidecars(epoch, snap["nproc"]),
                 "states": states_entry,
                 "zero_states": snap.get("zero_meta"),
-                "zero_params": snap.get("zparams_meta")}
+                "zero_params": snap.get("zparams_meta"),
+                "plan": snap.get("plan")}
             atomic_replace(self._manifest_path(epoch),
                            lambda tmp: _write_json(tmp, manifest))
             self._gc()
@@ -766,9 +787,12 @@ class CheckpointManager:
         for name, ent in zparams.items():
             key = "arg:%s" % name
             if key in arrays:
-                arrays[key] = arrays[key].reshape(-1)[
-                    :int(ent["logical"])].reshape(
-                    [int(s) for s in ent["canonical_shape"]])
+                from .parallel.zero import unflatten_tiles
+
+                arrays[key] = unflatten_tiles(
+                    arrays[key].reshape(-1), int(ent["logical"]),
+                    [int(s) for s in ent["canonical_shape"]],
+                    ent.get("tp"))
                 if ent.get("quant"):
                     # quantized tile save: expand the codes back to
                     # float32 with the manifest scales, so every restore
@@ -825,8 +849,10 @@ class CheckpointManager:
             for j in range(int(ent["num_leaves"])):
                 arr = arrays.pop("opt:%s/%d" % (name, j))
                 if ent["flat"][j]:
-                    arr = arr.reshape(-1)[:int(ent["logical"])] \
-                        .reshape([int(s) for s in ent["canonical_shape"]])
+                    arr = _zero.unflatten_tiles(
+                        arr.reshape(-1), int(ent["logical"]),
+                        [int(s) for s in ent["canonical_shape"]],
+                        ent.get("tp"))
                 leaves.append(arr)
             opt_states[name] = _zero.state_unflatten(
                 ent["structure"], leaves)

@@ -148,10 +148,11 @@ class TrainStep:
                  label_names=("softmax_label",), dtype="float32",
                  batch_sharding_axis="data", compute_dtype=None,
                  remat=None, fixed_param_names=(), param_sharding=None,
-                 steps_per_call=1, health=None, zero=None):
+                 steps_per_call=1, health=None, zero=None, plan=None):
         import jax
         import jax.numpy as jnp
 
+        from .base import get_env
         from .executor import _trace_fn
         from . import optimizer as opt_mod
         from .compile_cache import ensure_initialized, registry
@@ -161,11 +162,42 @@ class TrainStep:
         # before anything lowers, so this process's compiles are
         # reusable by the next one
         ensure_initialized()
+        # composed parallel plan (parallel/plan.py): ONE declaration of
+        # the (data, model, pipe, seq) split replacing the per-dimension
+        # mesh/param_sharding/zero kwargs.  MXNET_PLAN is the env
+        # surface, same "data=4,model=2,zero=3" grammar.
+        from .parallel.plan import ParallelPlan
+
+        if plan is None:
+            env_plan = get_env("MXNET_PLAN", "", str).strip()
+            plan = env_plan or None
+        if plan is not None:
+            plan = ParallelPlan.parse(plan)
+            if plan.pipe > 1:
+                raise MXNetError(
+                    "plan has a %d-stage pipe axis: pipeline schedules "
+                    "run through parallel.pipeline.PipelineTrainStep "
+                    "(Module.init_optimizer routes there when given a "
+                    "pipe plan)" % plan.pipe)
+            if param_sharding not in (None, "replicated"):
+                raise MXNetError(
+                    "plan=%r owns parameter placement; drop "
+                    "param_sharding=%r" % (plan, param_sharding))
+            if mesh is None:
+                mesh = plan.mesh()
+            else:
+                plan.validate_mesh(mesh)
+            if zero is None:
+                zero = plan.zero
+        self.plan = plan
+        self._plan_tp = plan is not None and plan.model_size(mesh) > 1
+        plan_tp = self._plan_tp
         # cached autotune knobs (MXNET_AUTOTUNE=1) arm their env vars
         # BEFORE anything traces — the ops read them at trace time
         from . import autotune as _autotune
 
-        self._autotune_applied = _autotune.apply_train_env(symbol, mesh)
+        self._autotune_applied = _autotune.apply_train_env(symbol, mesh,
+                                                           plan=plan)
         self.symbol = symbol
         self._fwd_fn, self._arg_names, self._aux_names = _trace_fn(
             symbol, is_train=True)
@@ -240,8 +272,18 @@ class TrainStep:
         # decline warnings scope to THIS step: a rebuilt TrainStep with a
         # different config re-reports its own decline reasons
         self._overlap_warner = warner = _overlap.DeclineWarner()
-        ddp_ax = _overlap.ddp_axis(mesh, batch_sharding_axis,
-                                   param_sharding, warner=warner)
+        if plan_tp:
+            # composed TP plan: gradient reduction belongs to GSPMD —
+            # per-group psum_scatter over the data axis for tiled grads,
+            # the model-axis all-reduce where the TP math needs it.  The
+            # explicit shard_map DDP path cannot express the joint
+            # (model, data) layout, so it stands down without a decline
+            # warning (this is the designed path, not a fallback).
+            ddp_ax = None
+        else:
+            ddp_ax = _overlap.ddp_axis(mesh, batch_sharding_axis,
+                                       param_sharding, warner=warner,
+                                       param_names=self.param_names)
         ddp_bucket = _overlap.grad_bucket_bytes()
         # reverse graph-construction order approximates the order
         # backward produces gradients in
@@ -257,7 +299,8 @@ class TrainStep:
         # rematerialized backward), with no trailing full all-gather.
         zmode = _zero.zero_mode(zero)
         zax = _zero.zero_axis(mesh, batch_sharding_axis, param_sharding,
-                              mode=zmode, warn=warner.warn)
+                              mode=zmode, warn=warner.warn,
+                              param_names=self.param_names)
         self.zero_axis = zax
         zero_n = int(mesh.shape[zax]) if zax is not None else 0
         zero_min = _zero.min_param_bytes()
@@ -271,7 +314,8 @@ class TrainStep:
         # them would mis-tile — every later caller reads the cache
         self._zero_lay = None
         z3_bucket = _zero.gather_bucket_bytes()
-        if z3_mode and ddp_ax is None and _overlap.overlap_mode() != "off":
+        if z3_mode and ddp_ax is None and not plan_tp \
+                and _overlap.overlap_mode() != "off":
             warner.warn(
                 "zero3-gather",
                 "zero=3: the bucketed gather prefetch needs the explicit "
@@ -373,7 +417,7 @@ class TrainStep:
                                                     zax)
                     elif z3 and tuple(grads[k].shape) == (ent.padded,):
                         grads[k] = jax.lax.with_sharding_constraint(
-                            grads[k], _zero._axis_sharding(mesh, zax))
+                            grads[k], _zero.flat_sharding(mesh, zax, ent))
             live = [k for k in sorted(grads) if k not in frozen]
             if scaler is not None:
                 inv = 1.0 / hstate["loss_scale"]
@@ -543,10 +587,12 @@ class TrainStep:
             # FSDP's largest-dim rule needs concrete parameter SHAPES, so
             # the jitted step is built lazily on the first call
             self._jit_step = None
-        elif zax is not None:
+        elif zax is not None or plan_tp:
             # ZeRO state shardings resolve against the optimizer-state
-            # pytree structure: lazily from the first call's concrete
-            # states, or from compile()'s abstract ones
+            # pytree structure — lazily from the first call's concrete
+            # states, or from compile()'s abstract ones.  A zero-off TP
+            # plan likewise resolves its per-parameter specs against
+            # concrete shapes (the divisibility fallback needs them).
             self._jit_step = None
         elif mesh is not None:
             self._jit_step = self._build_jit()
@@ -622,10 +668,13 @@ class TrainStep:
         from .parallel.sharding import (apply_rules, param_sharding_rules,
                                         replicated)
 
-        rules = self._param_sharding
-        if isinstance(rules, str):
-            rules = param_sharding_rules(rules)
-        pshard = apply_rules(self.mesh, params, rules)
+        if self._plan_tp and self._param_sharding in (None, "replicated"):
+            pshard = self.plan.param_shardings(self.mesh, params)
+        else:
+            rules = self._param_sharding
+            if isinstance(rules, str):
+                rules = param_sharding_rules(rules)
+            pshard = apply_rules(self.mesh, params, rules)
         repl = replicated(self.mesh)
         sshard = {
             n: jax.tree.map(
@@ -640,24 +689,48 @@ class TrainStep:
 
     def _build_zero_jit(self, params, states):
         """jit with the ZeRO state layout resolved: flat ``(padded,)``
-        state leaves tile ``P(axis)`` over the data axis, scalars and
-        unsharded params' states replicate.  Stage 1 keeps the params
-        replicated (the all-gather lives inside the program); stage 3
-        pins the at-rest flat params ``P(axis)`` in AND out — fresh
-        tiles leave the step still sharded."""
-        from .parallel import zero as _zero
-        from .parallel.sharding import replicated
+        state leaves tile over the data axis (group-locally
+        ``P((model, data))`` for a composed plan's TP entries), scalars
+        and unsharded params' states replicate.  Stage 1 keeps the
+        params at their canonical placement (replicated, or the plan's
+        TP specs — the all-gather lives inside the program); stage 3
+        pins the at-rest flat params to their tile sharding in AND out —
+        fresh tiles leave the step still sharded.  Under a plan, a
+        parameter too small for tiling stays at its canonical TP
+        sharding, weight-shaped state leaves included."""
+        import jax
 
+        from .parallel import zero as _zero
+        from .parallel.sharding import named_sharding, replicated
+
+        mesh = self.mesh
+        zax = self.zero_axis
         lay = self.zero_layout(params)
-        sshard = {n: _zero.state_sharding(states[n], lay[n], self.mesh,
-                                          self.zero_axis)
-                  for n in states}
+        repl = replicated(mesh)
+        canon = None
+        if self._plan_tp:
+            canon = {n: named_sharding(
+                        mesh, *self.plan.param_spec(n, lay[n].shape, mesh))
+                     for n in lay}
+
+        def state_shard(n):
+            if canon is not None and not lay[n].sharded:
+                # canonical TP placement: moments follow the weight
+                return jax.tree.map(
+                    lambda leaf, _n=n: canon[_n]
+                    if tuple(getattr(leaf, "shape", ())) == lay[_n].shape
+                    else repl, states[n])
+            return _zero.state_sharding(states[n], lay[n], mesh, zax)
+
+        sshard = {n: state_shard(n) for n in states}
         pshard = None
         if self.zero3:
-            tiled = _zero._axis_sharding(self.mesh, self.zero_axis)
-            repl = replicated(self.mesh)
-            pshard = {n: (tiled if lay[n].sharded else repl)
+            pshard = {n: (_zero.flat_sharding(mesh, zax, lay[n])
+                          if lay[n].sharded
+                          else (canon[n] if canon is not None else repl))
                       for n in params}
+        elif canon is not None:
+            pshard = dict(canon)
         self._in_pshard = (pshard if pshard is not None
                            else replicated(self.mesh))
         self._in_sshard = sshard
@@ -691,8 +764,17 @@ class TrainStep:
             return self._zero_lay
         from .parallel import zero as _zero
 
-        self._zero_lay = _zero.layout(params, self._zero_n,
-                                      self._zero_min_bytes, self._frozen)
+        if self._plan_tp:
+            # composed plan: TP params get group-local shard-major
+            # tiles, everything else the classic data-axis tiling
+            self._zero_lay = _zero.plan_layout(
+                params, self.mesh, self.zero_axis,
+                self.plan.param_specs(params, self.mesh),
+                min_bytes=self._zero_min_bytes, frozen=self._frozen)
+        else:
+            self._zero_lay = _zero.layout(params, self._zero_n,
+                                          self._zero_min_bytes,
+                                          self._frozen)
         return self._zero_lay
 
     def pack_params(self, params):
@@ -837,9 +919,13 @@ class TrainStep:
                 % (self._param_sharding,))
         args = self._abstract_inputs(shapes, dtype=dtype)
         if self._jit_step is None:
-            # ZeRO: the abstract states carry the flat layout, which is
-            # all the sharding resolution needs
-            self._jit_step = self._build_zero_jit(args[0], args[2])
+            if self.zero_axis is not None:
+                # ZeRO: the abstract states carry the flat layout, which
+                # is all the sharding resolution needs
+                self._jit_step = self._build_zero_jit(args[0], args[2])
+            else:
+                # zero-off TP plan: specs resolve from abstract shapes
+                self._jit_step = self._build_sharded_jit(args[0], args[2])
         hits_before = cache_stats()["hits"]
         t0 = time.perf_counter()
         lowered = self._jit_step.lower(*args)

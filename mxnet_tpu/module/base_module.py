@@ -193,7 +193,7 @@ class BaseModule:
             metric_sync_period=None, steps_per_call=None,
             checkpoint=None, checkpoint_period=1, resume_from=None,
             health=None, loss_scale=None, step_timeout_s=None,
-            zero=None):
+            zero=None, plan=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``), pipelined: by default the train iterator
         is wrapped in :class:`~mxnet_tpu.io.DevicePrefetchIter` so batch
@@ -258,6 +258,11 @@ class BaseModule:
           at rest as flat 1/N tiles, re-gathered bucket by bucket
           inside each step (``MXNET_ZERO``; see
           ``docs/performance.md``).
+        * ``plan`` — a :class:`~mxnet_tpu.parallel.ParallelPlan` or its
+          spec string (``"data=4,model=2,zero=3"``): ONE declaration
+          composing TP x PP x DP/ZeRO over a named mesh
+          (``MXNET_PLAN``; see ``docs/performance.md`` "Composing
+          parallelisms").
         """
         from ..base import get_env
         from ..initializer import Uniform
@@ -319,6 +324,8 @@ class BaseModule:
             opt_kwargs["loss_scale"] = loss_scale
         if zero is not None:
             opt_kwargs["zero"] = zero
+        if plan is not None:
+            opt_kwargs["plan"] = plan
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, **opt_kwargs)
         # env-driven activation (MXNET_HEALTH_MONITOR=1) happens inside

@@ -253,7 +253,8 @@ class Module(BaseModule):
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False, param_sharding=None,
                        compute_dtype=None, steps_per_call=None,
-                       health=None, loss_scale=None, zero=None):
+                       health=None, loss_scale=None, zero=None,
+                       plan=None):
         """``param_sharding``: 'replicated' (default), 'fsdp', 'tp', or a
         rule list (see ``parallel.sharding.param_sharding_rules``) —
         applied to the fused step's parameter/optimizer-state layouts
@@ -278,7 +279,14 @@ class Module(BaseModule):
 
         ``zero``: 'auto' (default) | 'on' | 'off' — ZeRO-style sharding
         of the optimizer state and the weight update across the data
-        axis (``MXNET_ZERO``; see docs/performance.md)."""
+        axis (``MXNET_ZERO``; see docs/performance.md).
+
+        ``plan``: a :class:`~mxnet_tpu.parallel.ParallelPlan` (or its
+        ``"data=4,model=2,zero=3"`` spec string, also via
+        ``MXNET_PLAN``) — ONE declaration composing TP x PP x DP/ZeRO;
+        it replaces ``param_sharding``/``zero`` and, for ``pipe>1``
+        plans, routes training through ``PipelineTrainStep`` (see
+        docs/performance.md "Composing parallelisms")."""
         from ..base import get_env
         from ..health import DynamicLossScaler, resolve_monitor
         from ..parallel import zero as _zero_mod
@@ -286,6 +294,24 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        if plan is None:
+            plan = get_env("MXNET_PLAN", "", str).strip() or None
+        if plan is not None:
+            from ..parallel.plan import ParallelPlan
+
+            plan = ParallelPlan.parse(plan)
+            if plan.pipe > 1:
+                if self._pipeline_stages and \
+                        self._pipeline_stages != plan.pipe:
+                    raise MXNetError(
+                        "plan pipe=%d conflicts with Module("
+                        "pipeline_stages=%d)"
+                        % (plan.pipe, self._pipeline_stages))
+                self._pipeline_stages = plan.pipe
+                self._pipeline_schedule = plan.schedule
+                if plan.n_microbatches:
+                    self._pipeline_microbatches = plan.n_microbatches
+        self._plan = plan
         self._health_monitor = resolve_monitor(health)
         if loss_scale is None:
             loss_scale = get_env("MXNET_LOSS_SCALE", "", str) or None
@@ -303,7 +329,12 @@ class Module(BaseModule):
         if compute_dtype is None:
             compute_dtype = get_env("MXNET_COMPUTE_DTYPE", "", str) or None
         self._compute_dtype = compute_dtype
-        # normalized to auto|on|off (explicit arg wins over MXNET_ZERO)
+        # normalized to auto|on|off (explicit arg wins over MXNET_ZERO);
+        # a plan that pins zero owns the mode when the arg is unset —
+        # without this the plan's zero=3 would silently degrade to the
+        # MXNET_ZERO default on the Module path
+        if zero is None and plan is not None and plan.zero is not None:
+            zero = plan.zero
         self._zero = _zero_mod.zero_mode(zero)
         kvstore_inst, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._exec.arg_dict)
@@ -357,15 +388,24 @@ class Module(BaseModule):
         selects the comm layer, ``src/kvstore/kvstore.cc:34-62``; here
         'device'/'dist*' types select SPMD over a ``jax.sharding.Mesh``
         and XLA inserts the gradient all-reduce over ICI)."""
-        if kvstore_inst is None:
-            return None
-        if not ("dist" in kvstore_inst.type or "device" in kvstore_inst.type):
-            return None
+        plan = getattr(self, "_plan", None)
+        if plan is None:
+            if kvstore_inst is None:
+                return None
+            if not ("dist" in kvstore_inst.type
+                    or "device" in kvstore_inst.type):
+                return None
         import jax
 
         from ..parallel import current_mesh, create_mesh
 
         mesh = current_mesh()
+        if mesh is None and plan is not None:
+            # the plan declares its own topology over this host's devices
+            # (a plan needs no kvstore: GSPMD owns every collective)
+            mesh = plan.mesh()
+        elif mesh is not None and plan is not None:
+            plan.validate_mesh(mesh)
         if mesh is None:
             # meshes stay process-LOCAL: in-jit collectives ride ICI
             # within this host's slice; cross-process traffic goes
@@ -380,11 +420,16 @@ class Module(BaseModule):
         axis = mesh.shape.get("data", 1)
         batch = self._data_shapes[0].shape[0]
         if axis > 1 and batch % axis != 0:
+            if plan is not None:
+                raise MXNetError(
+                    "batch size %d not divisible by the plan's data axis "
+                    "%d (plan=%r)" % (batch, axis, plan))
             self.logger.warning(
                 "batch size %d not divisible by mesh data axis %d; "
                 "running replicated", batch, axis)
             return None
-        kvstore_inst._mesh = mesh
+        if kvstore_inst is not None:
+            kvstore_inst._mesh = mesh
         return mesh
 
     def _maybe_compile_fused(self):
@@ -430,6 +475,12 @@ class Module(BaseModule):
                 raise MXNetError(
                     "zero=%s was requested but the fused step is "
                     "unavailable: %s" % (self._zero, reason))
+            # likewise a composed plan: the split path has no TP/ZeRO
+            # composition, so training replicated would silently ignore it
+            if getattr(self, "_plan", None) is not None:
+                raise MXNetError(
+                    "plan=%r was requested but the fused step is "
+                    "unavailable: %s" % (self._plan, reason))
 
         if self._pipeline_stages > 1:
             if getattr(self, "_steps_per_call", 1) > 1:
@@ -475,7 +526,8 @@ class Module(BaseModule):
                 data_names=self._data_names,
                 label_names=self._label_names,
                 schedule=self._pipeline_schedule,
-                fixed_param_names=self._fixed_param_names)
+                fixed_param_names=self._fixed_param_names,
+                plan=getattr(self, "_plan", None))
             return
         if not get_env("MXNET_FUSED_STEP", True, bool):
             _bail("MXNET_FUSED_STEP=0")
@@ -532,7 +584,8 @@ class Module(BaseModule):
                 compute_dtype=getattr(self, "_compute_dtype", None),
                 steps_per_call=getattr(self, "_steps_per_call", 1),
                 health=step_health,
-                zero=getattr(self, "_zero", None))
+                zero=getattr(self, "_zero", None),
+                plan=getattr(self, "_plan", None))
             # the sharded-update dispatch attaches the kvstore's peer
             # diagnosis to bounded-collective timeouts
             self._fused._kvstore = self._kvstore
@@ -563,6 +616,10 @@ class Module(BaseModule):
                 raise MXNetError(
                     "zero=%s was requested but the fused step could not "
                     "be built: %s" % (self._zero, e)) from e
+            if getattr(self, "_plan", None) is not None:
+                raise MXNetError(
+                    "plan=%r was requested but the fused step could not "
+                    "be built: %s" % (self._plan, e)) from e
             self.logger.debug("fused step unavailable: %s", e)
             self._fused = None
         if self._fused is None and self._mesh is not None and \

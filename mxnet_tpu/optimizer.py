@@ -232,7 +232,7 @@ def sharded_fused_update_at_rest(optimizer, flat_weight, flat_grad, state,
 
     from .parallel import zero as _zero
 
-    shard = _zero._axis_sharding(mesh, axis)
+    shard = _zero.flat_sharding(mesh, axis, entry)
     wflat = jax.lax.with_sharding_constraint(flat_weight, shard)
     new_flat, new_state = optimizer.fused_update(
         wflat, flat_grad, state, lr, wd, t, rng)

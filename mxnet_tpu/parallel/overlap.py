@@ -164,18 +164,35 @@ def arm_latency_hiding():
     return True
 
 
-def ddp_axis(mesh, batch_axis, param_sharding=None, warner=None):
+def ddp_axis(mesh, batch_axis, param_sharding=None, warner=None,
+             param_names=()):
     """The mesh axis the explicit DDP reduction runs over, or None.
 
     Eligible: a live mesh whose only non-trivial axis is the batch axis
     (pure data parallelism) with replicated parameters — sharded-param
     styles (fsdp) already reduce-scatter through GSPMD and have their
-    own overlap story.  ``warner``: per-consumer decline reporter.
+    own overlap story.  ``warner``: per-consumer decline reporter;
+    ``param_names`` lets a forced-on decline name the specific blocking
+    parameter.  A style whose every resolved spec is trivial on this
+    mesh is effectively pure DP and stays eligible.
     """
     if overlap_mode() == "off":
         return None
     if param_sharding not in (None, "replicated"):
-        return None
+        from .zero import _blocking_param
+
+        blocking = _blocking_param(mesh, param_sharding, param_names)
+        if blocking is not None:
+            if overlap_mode() == "on":
+                name, spec = blocking
+                _warn_once(
+                    "params",
+                    "MXNET_GRAD_OVERLAP=on but param_sharding=%r places "
+                    "%s as PartitionSpec%r — sharded grads reduce "
+                    "through GSPMD (compose the layouts with a "
+                    "ParallelPlan instead)"
+                    % (param_sharding, name, tuple(spec)), warner)
+            return None
     if mesh is None or batch_axis not in mesh.shape:
         return None
     if int(mesh.shape[batch_axis]) < 2:

@@ -481,9 +481,28 @@ class PipelineTrainStep:
                  mesh=None, n_microbatches=None,
                  data_names=("data",), label_names=("softmax_label",),
                  axis="pipe", schedule="1f1b", grad_scale=None,
-                 fixed_param_names=()):
+                 fixed_param_names=(), plan=None):
         from .. import optimizer as opt_mod
 
+        if plan is not None:
+            # a composed ParallelPlan carries the pipe topology: the
+            # mesh, the schedule and the microbatch count come from ONE
+            # declaration (Module routes pipe>1 plans here)
+            from .plan import ParallelPlan
+
+            plan = ParallelPlan.parse(plan)
+            if plan.pipe < 2:
+                raise MXNetError(
+                    "PipelineTrainStep got a plan without a >=2-stage "
+                    "pipe axis: %r (use fused.TrainStep)" % (plan,))
+            if mesh is None:
+                mesh = plan.mesh()
+            else:
+                plan.validate_mesh(mesh)
+            schedule = plan.schedule
+            if n_microbatches is None:
+                n_microbatches = plan.n_microbatches
+        self.plan = plan
         mesh = mesh if mesh is not None else current_mesh()
         if mesh is None or axis not in mesh.shape:
             raise MXNetError(

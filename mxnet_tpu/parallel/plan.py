@@ -1,0 +1,282 @@
+"""Unified parallel plan: TP x PP x DP/ZeRO composed as ONE sharding pass.
+
+The reference framework had exactly one parallelism (device lists +
+``kvstore``); this repo grew the modern dimensions one at a time —
+Megatron tensor parallelism (``sharding.py``), ring sequence parallelism
+(``sequence.py``), pipeline schedules (``pipeline.py``), bucketed DDP
+overlap (``overlap.py``) and ZeRO-1/3 weight-update sharding
+(``zero.py``, arXiv 2004.13336).  Until now they were mutually exclusive
+islands: ``zero_axis`` declined on tp/fsdp layouts and the pipeline
+composed with neither.
+
+:class:`ParallelPlan` is the single composition point.  It owns the mesh
+axis sizes (data, model, pipe, seq) and assigns every parameter,
+optimizer-state leaf, gradient and activation a placement exactly once:
+
+* **model** — Megatron column/row specs (``tp_rules_for_transformer``:
+  FullyConnected stacks, attention QKV/O head sharding) on the canonical
+  parameter shapes.
+* **data** — ZeRO flat tiles taken over the data axis *within* each
+  model group (``zero.plan_layout``): a TP-sharded parameter's at-rest
+  ZeRO-3 tile is a shard-major flat array laid out ``P((model, data))``
+  so the forward gather is an all-gather over the data axis scoped to
+  the model group — never a global collective.
+* **pipe** — stage assignment via ``split_symbol``/``PipelineTrainStep``
+  (``fused.TrainStep`` refuses pipe plans and points there).
+* **seq** — the ring-attention axis; the batch/heads dims of the ring
+  shard_map compose with the data/model axes (``sequence.py``).
+
+``fused.TrainStep(symbol, plan=...)`` is the composed entry point: the
+plan replaces the per-dimension ``mesh``/``param_sharding``/``zero``
+kwargs.  Everything stays ONE jitted XLA program (arXiv 2301.13062
+discipline): health/loss-scale/clip-global-norm are ordinary jnp
+reductions over sharded arrays, which GSPMD lowers to partial norms plus
+a scalar psum across all axes — exact by construction.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+from ..base import MXNetError
+from .mesh import AXIS_ORDER, create_mesh
+
+__all__ = ["ParallelPlan", "tp_rules_for_transformer"]
+
+_ZERO_MODES = {"off": "off", "0": "off", "1": "on", "on": "on",
+               "3": "3", "auto": "auto"}
+
+
+def tp_rules_for_transformer():
+    """Megatron tensor-parallel rules for the transformer family on top
+    of the MLP pairing: attention QKV projection column-parallel (head
+    sharding — the fused (3C, C) in_weight splits its output dim over
+    'model', so each group member computes its heads' Q/K/V locally),
+    output projection row-parallel (the once-per-block all-reduce), and
+    the FFN pair column-then-row.  Embeddings / LayerNorm / biases of
+    row-parallel layers stay replicated; ZeRO tiles (``zero.plan_layout``)
+    shard those over the data axis within each model group instead."""
+    from .sharding import tp_rules_for_mlp
+
+    return [
+        (re.compile(r".*_attn_in_weight$"), ("model", None)),
+        (re.compile(r".*_attn_in_bias$"), ("model",)),
+        (re.compile(r".*_attn_out_weight$"), (None, "model")),
+        (re.compile(r".*_ffn1_weight$"), ("model", None)),
+        (re.compile(r".*_ffn1_bias$"), ("model",)),
+        (re.compile(r".*_ffn2_weight$"), (None, "model")),
+    ] + tp_rules_for_mlp()
+
+
+class ParallelPlan:
+    """One declaration of how a training run spreads over the mesh.
+
+    ``data``/``model``/``pipe``/``seq`` are mesh axis sizes (1 = axis
+    unused); ``zero`` is the weight-update sharding mode over the data
+    axis within each (model, pipe) group: ``None`` defers to MXNET_ZERO,
+    ``"off"``/``"on"``/``"3"`` force it (``"1"`` is accepted as an alias
+    of ``"on"``).  ``data=-1`` absorbs whatever devices the other axes
+    leave (``create_mesh`` wildcard).
+    """
+
+    __slots__ = ("data", "model", "pipe", "seq", "zero", "schedule",
+                 "n_microbatches")
+
+    def __init__(self, data=-1, model=1, pipe=1, seq=1, zero=None,
+                 schedule="1f1b", n_microbatches=None):
+        self.data = int(data)
+        self.model = int(model)
+        self.pipe = int(pipe)
+        self.seq = int(seq)
+        for ax in ("model", "pipe", "seq"):
+            if getattr(self, ax) < 1:
+                raise MXNetError("ParallelPlan %s size must be >= 1, got "
+                                 "%d" % (ax, getattr(self, ax)))
+        if self.data < 1 and self.data != -1:
+            raise MXNetError("ParallelPlan data size must be >= 1 or the "
+                             "-1 wildcard, got %d" % self.data)
+        if zero is not None:
+            zero = str(zero).lower()
+            if zero not in _ZERO_MODES:
+                raise MXNetError("ParallelPlan zero must be one of %s, "
+                                 "got %r" % (sorted(set(_ZERO_MODES)),
+                                             zero))
+            zero = _ZERO_MODES[zero]
+        self.zero = zero
+        if schedule not in ("1f1b", "gpipe"):
+            raise MXNetError("ParallelPlan schedule must be '1f1b' or "
+                             "'gpipe', got %r" % (schedule,))
+        self.schedule = schedule
+        self.n_microbatches = (None if n_microbatches is None
+                               else int(n_microbatches))
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def parse(cls, spec):
+        """Parse ``"data=4,model=2,zero=3"`` (the MXNET_PLAN / CLI
+        surface).  Keys: data, model, pipe, seq, zero, schedule,
+        microbatches."""
+        if isinstance(spec, ParallelPlan):
+            return spec
+        kwargs = {}
+        for tok in str(spec).replace(";", ",").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if "=" not in tok:
+                raise MXNetError("bad plan token %r in %r (want key=value)"
+                                 % (tok, spec))
+            key, val = (t.strip() for t in tok.split("=", 1))
+            if key in ("data", "model", "pipe", "seq"):
+                kwargs[key] = cls._int(key, val, spec)
+            elif key == "zero":
+                kwargs["zero"] = val
+            elif key == "schedule":
+                kwargs["schedule"] = val
+            elif key in ("microbatches", "n_microbatches"):
+                kwargs["n_microbatches"] = cls._int(key, val, spec)
+            else:
+                raise MXNetError("unknown plan key %r in %r" % (key, spec))
+        return cls(**kwargs)
+
+    @staticmethod
+    def _int(key, val, spec):
+        try:
+            return int(val)
+        except ValueError:
+            raise MXNetError("plan key %r wants an integer, got %r in %r"
+                             % (key, val, spec)) from None
+
+    # -- mesh -------------------------------------------------------------
+    def axes(self):
+        """Mesh axis sizes in canonical ``AXIS_ORDER``, size-1 axes
+        dropped (a trivial axis is pure noise in every PartitionSpec) —
+        except 'data', which is always present so the batch has a home
+        even on a 1-way mesh."""
+        sizes = {"data": self.data, "seq": self.seq, "pipe": self.pipe,
+                 "model": self.model}
+        return {ax: sizes[ax] for ax in AXIS_ORDER if ax in sizes
+                and (sizes[ax] != 1 or ax == "data")}
+
+    def mesh(self, devices=None):
+        """Build the plan's mesh over ``devices`` (default: the first
+        ``prod(axes)`` local devices — a ``data=2,model=2`` plan on an
+        8-device host deliberately uses 4; elastic restores depend on
+        a plan meaning the SAME topology on any host big enough)."""
+        if devices is None and self.data != -1:
+            import jax
+
+            want = 1
+            for n in self.axes().values():
+                want *= n
+            have = jax.devices()
+            if want < len(have):
+                devices = have[:want]
+        return create_mesh(self.axes(), devices)
+
+    def validate_mesh(self, mesh):
+        """Check an externally-built mesh carries the plan's axes at the
+        plan's sizes (the -1 data wildcard matches any size)."""
+        shape = dict(mesh.shape)
+        for ax, n in self.axes().items():
+            have = int(shape.get(ax, 1))
+            if ax == "data" and n == -1:
+                continue
+            if have != n:
+                raise MXNetError(
+                    "mesh axis %r is %d-way but the plan wants %d "
+                    "(plan %s, mesh %s)" % (ax, have, n,
+                                            self.describe(), dict(shape)))
+
+    def model_size(self, mesh=None):
+        if mesh is not None:
+            return int(dict(mesh.shape).get("model", 1))
+        return self.model
+
+    # -- identity ---------------------------------------------------------
+    def describe(self):
+        """JSON-able identity dict (checkpoint manifests, bench rows)."""
+        out = {"data": self.data, "model": self.model, "pipe": self.pipe,
+               "seq": self.seq, "zero": self.zero}
+        if self.pipe > 1:
+            out["schedule"] = self.schedule
+            if self.n_microbatches:
+                out["n_microbatches"] = self.n_microbatches
+        return out
+
+    def fingerprint(self, mesh=None):
+        """Stable slug keying autotune records and audit artifacts:
+        tuned knobs for a tp x zero3 plan must not leak onto pure-DP
+        runs of the same symbol.  Pass the resolved mesh so the ``-1``
+        data wildcard fingerprints as its concrete size."""
+        data = self.data
+        if mesh is not None and data == -1:
+            data = int(dict(mesh.shape).get("data", data))
+        parts = ["%s%d" % (ax, n) for ax, n in
+                 (("data", data), ("model", self.model),
+                  ("pipe", self.pipe), ("seq", self.seq)) if n != 1]
+        if not parts:
+            parts = ["data%d" % data]
+        if self.zero is not None:
+            parts.append("z%s" % self.zero)
+        return "-".join(parts)
+
+    def __repr__(self):
+        return "ParallelPlan(%s)" % json.dumps(self.describe(),
+                                               sort_keys=True)
+
+    def __eq__(self, other):
+        return isinstance(other, ParallelPlan) and \
+            self.describe() == other.describe()
+
+    def __hash__(self):
+        return hash(json.dumps(self.describe(), sort_keys=True))
+
+    # -- parameter placement ----------------------------------------------
+    def tp_rules(self):
+        """Pattern -> PartitionSpec rules for the model axis."""
+        return tp_rules_for_transformer()
+
+    def param_spec(self, name, shape, mesh=None):
+        """The canonical-shape PartitionSpec TUPLE for one parameter
+        under this plan's model axis, with the divisibility fallback of
+        ``sharding_from_spec``: a dim the model size does not divide
+        replicates on that dim instead of erroring.  Pure-DP plans (and
+        seq>1 plans, where the ring owns the attention layout) return
+        the empty spec for everything."""
+        model_n = self.model
+        if model_n <= 1 or self.seq > 1:
+            return ()
+        if mesh is not None:
+            model_n = self.model_size(mesh)
+            if model_n <= 1:
+                return ()
+        spec = ()
+        for pat, s in self.tp_rules():
+            if pat.match(name):
+                spec = s
+                break
+        out = []
+        for i, entry in enumerate(tuple(spec)[:len(shape)]):
+            if entry == "model" and int(shape[i]) % model_n == 0:
+                out.append("model")
+            else:
+                out.append(None)
+        while out and out[-1] is None:
+            out.pop()
+        return tuple(out)
+
+    def param_specs(self, params, mesh=None):
+        """{name: PartitionSpec tuple} over a {name: array-like} dict."""
+        return {name: self.param_spec(name, tuple(arr.shape), mesh)
+                for name, arr in params.items()}
+
+    def param_shardings(self, mesh, params):
+        """{name: NamedSharding} for the canonical (full-shape)
+        parameters — what a zero-off plan jit uses as in/out shardings,
+        and what ``zero.gather_param`` re-lays a gathered TP param onto."""
+        from .sharding import named_sharding
+
+        return {name: named_sharding(mesh, *self.param_spec(
+                    name, tuple(arr.shape), mesh))
+                for name, arr in params.items()}

@@ -105,8 +105,11 @@ def sequence_parallel_attention(q, k, v, causal=False, mesh=None,
 
     q/k/v: (B, H, T, D) with T divisible by the mesh's ``axis`` size.
     Shards T over the mesh and runs :func:`ring_attention` under
-    ``shard_map``; batch/heads stay replicated unless the caller already
-    sharded them (composable with data parallelism via ``pjit``).
+    ``shard_map``.  The batch and heads dims COMPOSE with the other plan
+    axes: B additionally shards over the data (and fsdp) axes and H over
+    the 'model' axis whenever the sizes divide — attention is
+    independent across batch and heads, so the ring stays the only
+    cross-device exchange and each (data, model) group runs its own.
     """
     mesh = mesh or current_mesh()
     if mesh is None or axis not in mesh.shape:
@@ -118,15 +121,23 @@ def sequence_parallel_attention(q, k, v, causal=False, mesh=None,
     if t % n != 0:
         raise MXNetError("sequence length %d not divisible by %s=%d"
                          % (t, axis, n))
-    return _sp_attention_fn(mesh, axis, causal)(q, k, v)
+    shape = dict(mesh.shape)
+    batch_axes = tuple(ax for ax in ("data", "fsdp")
+                       if int(shape.get(ax, 1)) > 1
+                       and int(q.shape[0]) % int(shape[ax]) == 0)
+    heads_axis = ("model" if int(shape.get("model", 1)) > 1
+                  and int(q.shape[1]) % int(shape["model"]) == 0
+                  else None)
+    return _sp_attention_fn(mesh, axis, causal, batch_axes,
+                            heads_axis)(q, k, v)
 
 
 @track_lru("parallel._sp_attention_fn")
 @functools.lru_cache(maxsize=32)
-def _sp_attention_fn(mesh, axis, causal):
-    """Cached jitted shard_map program per (mesh, axis, causal): jit
-    caches by function identity, so rebuilding per call would re-trace
-    and recompile every step."""
+def _sp_attention_fn(mesh, axis, causal, batch_axes=(), heads_axis=None):
+    """Cached jitted shard_map program per (mesh, axis, causal,
+    batch/heads placement): jit caches by function identity, so
+    rebuilding per call would re-trace and recompile every step."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -135,7 +146,7 @@ def _sp_attention_fn(mesh, axis, causal):
     except AttributeError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    spec = P(None, None, axis, None)
+    spec = P(batch_axes or None, heads_axis, axis, None)
     body = functools.partial(ring_attention, axis_name=axis,
                              causal=causal)
     try:

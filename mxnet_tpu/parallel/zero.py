@@ -60,11 +60,13 @@ import math
 from ..base import MXNetError, get_env
 
 __all__ = ["zero_mode", "min_param_bytes", "zero_axis", "ZeroParam",
-           "layout", "put", "shard_flat", "gather_param", "gather_bucket",
+           "layout", "plan_layout", "put", "shard_flat", "gather_param",
+           "gather_bucket", "flat_sharding",
            "init_state", "pack_params", "unpack_param", "unpack_params",
            "shard_state", "unshard_state", "state_structure",
            "state_leaves", "state_unflatten", "export_states",
-           "export_params", "bounded_dispatch", "state_bytes_per_replica",
+           "export_params", "tp_meta", "unflatten_tiles",
+           "bounded_dispatch", "state_bytes_per_replica",
            "params_bytes_per_replica", "update_gather_bytes",
            "zero3_gather_bytes", "gather_bucket_bytes"]
 
@@ -113,13 +115,67 @@ def gather_bucket_bytes():
 gather_bucket_bytes.__doc__ %= DEFAULT_GATHER_BUCKET_MB
 
 
+def _blocking_param(mesh, style, param_names):
+    """First parameter an explicit ``param_sharding`` style actually
+    shards on THIS mesh, as ``(name, spec_tuple)`` — or None when every
+    resolved spec is trivial (all named axes absent or size 1), which
+    makes the layout effectively pure DP.  Feeds the decline message so
+    it names the specific blocking placement instead of the generic
+    fsdp/tp sentence."""
+    shape = dict(getattr(mesh, "shape", {}) or {})
+
+    def _nontrivial(axes):
+        return any(int(shape.get(a, 1)) > 1 for a in axes)
+
+    try:
+        from .sharding import param_sharding_rules
+
+        rules = (param_sharding_rules(style) if isinstance(style, str)
+                 else list(style))
+    except (MXNetError, TypeError, ValueError):
+        # diagnostics-only helper: an unparseable style still deserves
+        # a decline message, just without the per-param attribution
+        return ("<params>", (str(style),))
+    for name in param_names or ():
+        spec = ()
+        for pat, s in rules:
+            if pat.match(name):
+                spec = s
+                break
+        for entry in spec:
+            if entry is None:
+                continue
+            if entry == "__largest__":
+                axes = ["fsdp" if "fsdp" in shape else "data"]
+            else:
+                axes = [entry] if isinstance(entry, str) else list(entry)
+            if _nontrivial(axes):
+                return (name, tuple(spec))
+    if not param_names:
+        # no names to resolve against: only the known styles can be
+        # cleared without them
+        if style == "tp" and not _nontrivial(["model"]):
+            return None
+        if style == "replicated":
+            return None
+        return ("<params>", (str(style),))
+    return None
+
+
 def zero_axis(mesh, batch_axis, param_sharding=None, mode=None,
-              warn=None):
+              warn=None, param_names=()):
     """The mesh axis the sharded update tiles over, or None (declined).
 
     ``warn``: optional ``warn(key, msg)`` callable (the per-TrainStep
     decline reporter) — called only when the user forced ``on`` and the
-    step cannot honor it."""
+    step cannot honor it.  ``param_names``: the step's parameter names,
+    so a decline over an explicit ``param_sharding`` can name the
+    specific blocking parameter and its PartitionSpec.  A style whose
+    every resolved spec is trivial on this mesh (e.g. ``"tp"`` with a
+    size-1 or absent model axis) is pure DP and just runs — no decline,
+    no warning.  Composed tp x zero layouts go through
+    :class:`~mxnet_tpu.parallel.plan.ParallelPlan` /
+    :func:`plan_layout` instead of this gate."""
     mode = zero_mode(mode)
     if mode == "off":
         return None
@@ -130,11 +186,19 @@ def zero_axis(mesh, batch_axis, param_sharding=None, mode=None,
         return None
 
     if param_sharding not in (None, "replicated"):
-        return _decline(
-            "zero-params",
-            "MXNET_ZERO=%s but param_sharding=%r already shards the "
-            "parameters (fsdp/tp carry their own state layout); using "
-            "the replicated update" % (mode, param_sharding))
+        blocking = _blocking_param(mesh, param_sharding, param_names)
+        if blocking is not None:
+            name, spec = blocking
+            return _decline(
+                "zero-params",
+                "MXNET_ZERO=%s but param_sharding=%r places %s as "
+                "PartitionSpec%r — that layout carries its own state "
+                "sharding, and double-tiling it over the data axis "
+                "would corrupt the update; using the replicated update "
+                "(compose the two with a ParallelPlan: "
+                "TrainStep(..., plan=ParallelPlan(model=..., zero=...)))"
+                % (mode, param_sharding, name, tuple(spec)))
+        # every spec is trivial on this mesh: effectively pure DP
     if mesh is None or batch_axis not in getattr(mesh, "shape", {}):
         return _decline(
             "zero-mesh",
@@ -151,22 +215,46 @@ def zero_axis(mesh, batch_axis, param_sharding=None, mode=None,
 class ZeroParam:
     """Per-parameter tiling decision: ``sharded`` params carry their
     grad/weight/state as flat ``(padded,)`` arrays tiled over the data
-    axis; unsharded ones keep the replicated update."""
+    axis; unsharded ones keep the replicated update.
 
-    __slots__ = ("name", "shape", "dtype", "logical", "padded", "sharded")
+    Under a composed plan (:func:`plan_layout`) a tensor-parallel
+    parameter additionally records its model-axis split: ``model_n``
+    group count, the canonical dim ``tp_dim`` the model axis shards, and
+    ``shard_padded`` — the per-group flat tile length.  Its flat layout
+    is SHARD-MAJOR with per-shard padding
+    (``padded = model_n * shard_padded``), laid out
+    ``P((model, data))`` so group ``m``'s tile occupies one contiguous
+    run and the forward gather is an all-gather over the data axis
+    scoped to the model group."""
 
-    def __init__(self, name, shape, dtype, logical, padded, sharded):
+    __slots__ = ("name", "shape", "dtype", "logical", "padded", "sharded",
+                 "tp_dim", "model_axis", "model_n", "shard_padded")
+
+    def __init__(self, name, shape, dtype, logical, padded, sharded,
+                 tp_dim=None, model_axis=None, model_n=1,
+                 shard_padded=None):
         self.name = name
         self.shape = tuple(int(s) for s in shape)
         self.dtype = dtype
         self.logical = int(logical)
         self.padded = int(padded)
         self.sharded = bool(sharded)
+        self.tp_dim = None if tp_dim is None else int(tp_dim)
+        self.model_axis = model_axis
+        self.model_n = int(model_n)
+        self.shard_padded = int(self.padded if shard_padded is None
+                                else shard_padded)
+
+    @property
+    def tp(self):
+        return self.model_n > 1
 
     def __repr__(self):
+        tp = ("" if not self.tp else ", tp_dim=%d, model_n=%d"
+              % (self.tp_dim, self.model_n))
         return ("ZeroParam(%s, shape=%r, logical=%d, padded=%d, "
-                "sharded=%r)" % (self.name, self.shape, self.logical,
-                                 self.padded, self.sharded))
+                "sharded=%r%s)" % (self.name, self.shape, self.logical,
+                                   self.padded, self.sharded, tp))
 
 
 def layout(params, ndev, min_bytes=None, frozen=frozenset()):
@@ -188,6 +276,66 @@ def layout(params, ndev, min_bytes=None, frozen=frozenset()):
                    and logical * dtype.itemsize >= min_bytes)
         out[name] = ZeroParam(name, shape, dtype, logical, padded, sharded)
     return out
+
+
+def plan_layout(params, mesh, axis, specs, model_axis="model",
+                min_bytes=None, frozen=frozenset()):
+    """{name: :class:`ZeroParam`} for a composed plan: parameters whose
+    canonical spec (``specs``: {name: PartitionSpec tuple}) carries the
+    model axis get group-local shard-major tiles — the flat footprint is
+    ``model_n * shard_padded`` with ``shard_padded`` a multiple of the
+    data-axis size, so every (model, data) device holds one contiguous
+    even tile of its OWN group's shard and no collective ever crosses
+    groups.  Everything else keeps the classic :func:`layout` tiling
+    over the data axis (replicated across model groups, so each group
+    redundantly holds the same 1/N tiles — 'tiles within each group').
+    Deterministic in shapes/dtypes/specs only, like :func:`layout`."""
+    import numpy as np
+
+    if min_bytes is None:
+        min_bytes = min_param_bytes()
+    shape_map = dict(getattr(mesh, "shape", {}) or {})
+    ndata = int(shape_map.get(axis, 1))
+    nmodel = int(shape_map.get(model_axis, 1))
+    out = {}
+    for name, arr in params.items():
+        pshape = tuple(int(s) for s in arr.shape)
+        dtype = np.dtype(arr.dtype)
+        logical = int(math.prod(pshape)) if pshape else 1
+        spec = tuple((specs or {}).get(name) or ())
+        tp_dim = None
+        for i, s in enumerate(spec[:len(pshape)]):
+            names = [s] if isinstance(s, str) else list(s or ())
+            if model_axis in names:
+                tp_dim = i
+                break
+        sharded = (name not in frozen and ndata > 1
+                   and logical * dtype.itemsize >= min_bytes)
+        if tp_dim is not None and nmodel > 1 and \
+                pshape[tp_dim] % nmodel == 0:
+            shard_logical = logical // nmodel
+            shard_padded = max(1, -(-shard_logical // ndata)) * ndata
+            out[name] = ZeroParam(
+                name, pshape, dtype, logical, nmodel * shard_padded,
+                sharded, tp_dim=tp_dim, model_axis=model_axis,
+                model_n=nmodel, shard_padded=shard_padded)
+        else:
+            padded = max(1, -(-logical // ndata)) * ndata
+            out[name] = ZeroParam(name, pshape, dtype, logical, padded,
+                                  sharded)
+    return out
+
+
+def flat_sharding(mesh, axis, entry=None):
+    """NamedSharding of one flat ``(padded,)`` tile: ``P(axis)`` for the
+    classic layout, ``P((model, data))`` for a plan-composed TP entry —
+    device (m, d) holds shard m's d-th tile, contiguously."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if entry is not None and getattr(entry, "model_n", 1) > 1:
+        return NamedSharding(mesh,
+                             PartitionSpec((entry.model_axis, axis)))
+    return NamedSharding(mesh, PartitionSpec(axis))
 
 
 def _axis_sharding(mesh, axis):
@@ -228,9 +376,22 @@ def put(x, sharding):
 
 def flat_pad(x, entry):
     """Flatten ``x`` to 1-D and zero-pad to ``entry.padded`` elements
-    (pure reshape/pad; traceable)."""
+    (pure reshape/pad/concat; traceable).  TP entries flatten
+    SHARD-MAJOR: the canonical array splits ``model_n``-ways along
+    ``tp_dim`` and each shard flattens + pads independently, so the flat
+    tile laid out ``P((model, data))`` puts every group's shard on its
+    own devices."""
     import jax.numpy as jnp
 
+    if getattr(entry, "model_n", 1) > 1:
+        shard_logical = entry.logical // entry.model_n
+        parts = jnp.split(jnp.asarray(x), entry.model_n,
+                          axis=entry.tp_dim)
+        flats = [jnp.reshape(p, (-1,)) for p in parts]
+        if entry.shard_padded > shard_logical:
+            pad = entry.shard_padded - shard_logical
+            flats = [jnp.pad(f, (0, pad)) for f in flats]
+        return jnp.concatenate(flats)
     flat = jnp.reshape(x, (-1,))
     if entry.padded > entry.logical:
         flat = jnp.pad(flat, (0, entry.padded - entry.logical))
@@ -238,21 +399,57 @@ def flat_pad(x, entry):
 
 
 def shard_flat(x, entry, mesh, axis):
-    """Flatten+pad ``x`` and constrain it onto ``P(axis)`` — under
+    """Flatten+pad ``x`` and constrain it onto its flat tiling — under
     GSPMD this is the reduce-scatter (for a pending-sum gradient) or a
-    local slice (for a replicated weight)."""
+    local slice (for a replicated weight).  For a plan-composed TP
+    entry the tiling is ``P((model, data))``: the gradient is already
+    model-sharded, so the lowering is a reduce-scatter over the data
+    axis WITHIN each model group — TP grads never join the cross-group
+    reductions."""
     import jax
 
     return jax.lax.with_sharding_constraint(
-        flat_pad(x, entry), _axis_sharding(mesh, axis))
+        flat_pad(x, entry), flat_sharding(mesh, axis, entry))
+
+
+def _gather_tp(flat, entry, mesh):
+    """Group-local gather of one TP entry: all-gather the data-axis
+    tiles WITHIN each model group (the ``P(model, None)`` row
+    constraint — the model dim stays put), trim per-shard padding,
+    rebuild the canonical shape, and land on the canonical TP sharding
+    (a local relayout: each device already holds its group's shard)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rows = jnp.reshape(flat, (entry.model_n, entry.shard_padded))
+    rows = jax.lax.with_sharding_constraint(
+        rows, NamedSharding(mesh, PartitionSpec(entry.model_axis, None)))
+    shard_logical = entry.logical // entry.model_n
+    shard_shape = list(entry.shape)
+    shard_shape[entry.tp_dim] //= entry.model_n
+    # Concatenating the m shard blocks along tp_dim == moving the shard
+    # index next to tp_dim and merging: one reshape+transpose the
+    # partitioner keeps group-local (per-i slice + concat confuses it).
+    blocks = jnp.reshape(rows[:, :shard_logical],
+                         [entry.model_n] + shard_shape)
+    full = jnp.reshape(jnp.moveaxis(blocks, 0, entry.tp_dim), entry.shape)
+    spec = [None] * len(entry.shape)
+    spec[entry.tp_dim] = entry.model_axis
+    return jax.lax.with_sharding_constraint(
+        full, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
 def gather_param(flat, entry, mesh):
-    """Replicate the updated flat shard (the all-gather), drop the
-    padding lanes, and restore the parameter's shape."""
+    """The updated flat tile back to the parameter's canonical form:
+    replicate (the all-gather) + trim padding for the classic layout;
+    group-local gather onto the canonical TP sharding for a
+    plan-composed TP entry."""
     import jax
     import jax.numpy as jnp
 
+    if getattr(entry, "model_n", 1) > 1:
+        return _gather_tp(flat, entry, mesh)
     full = jax.lax.with_sharding_constraint(flat, _replicated(mesh))
     return jnp.reshape(full[:entry.logical], entry.shape)
 
@@ -283,15 +480,22 @@ def gather_bucket(flats, entries, mesh, axis, scales=None):
         fulls = lax.all_gather(tuple(flats), ctx[0], axis=0, tiled=True)
     else:
         repl = _replicated(mesh)
-        fulls = tuple(jax.lax.with_sharding_constraint(f, repl)
-                      for f in flats)
+        # plan-composed TP tiles gather group-locally below; the
+        # replication constraint here would be the monolithic global
+        # gather the plan exists to avoid
+        fulls = tuple(
+            f if getattr(e, "model_n", 1) > 1
+            else jax.lax.with_sharding_constraint(f, repl)
+            for f, e in zip(flats, entries))
     if scales is not None:
         from .. import quantize as _quant
 
         fulls = tuple(
             f if s is None else _quant.dequant_flat(f, e, s)
             for f, e, s in zip(fulls, entries, scales))
-    return [jnp.reshape(f[:e.logical], e.shape)
+    return [_gather_tp(f, e, mesh)
+            if getattr(e, "model_n", 1) > 1
+            else jnp.reshape(f[:e.logical], e.shape)
             for f, e in zip(fulls, entries)]
 
 
@@ -303,15 +507,51 @@ def pack_params(params, lay, mesh, axis):
     bit-exact."""
     import jax.numpy as jnp
 
-    shard = _axis_sharding(mesh, axis)
     out = {}
     for name, v in params.items():
         ent = lay[name]
         if ent.sharded and tuple(getattr(v, "shape", ())) != (ent.padded,):
-            out[name] = put(flat_pad(jnp.asarray(v), ent), shard)
+            out[name] = put(flat_pad(jnp.asarray(v), ent),
+                            flat_sharding(mesh, axis, ent))
         else:
             out[name] = v
     return out
+
+
+def tp_meta(entry):
+    """JSON-able TP-layout descriptor of one entry, or None for the
+    classic layout — rides checkpoint manifests so any topology can
+    invert the shard-major flat order."""
+    if getattr(entry, "model_n", 1) <= 1:
+        return None
+    return {"model_n": int(entry.model_n),
+            "shard_padded": int(entry.shard_padded),
+            "tp_dim": int(entry.tp_dim)}
+
+
+def unflatten_tiles(flat, logical, canonical_shape, tp=None):
+    """Host-numpy inverse of :func:`flat_pad` for a FULL flat array:
+    trim padding and restore ``canonical_shape``.  ``tp`` is a
+    :func:`tp_meta` dict for shard-major TP tiles (a plain
+    ``reshape(-1)[:logical]`` would interleave the per-shard padding
+    into the data); None/classic trims the single tail pad.  This is
+    the checkpoint restore primitive: it only sees assembled host
+    arrays, so it works on any topology including unsharded."""
+    import numpy as np
+
+    arr = np.asarray(flat).reshape(-1)
+    shape = [int(s) for s in canonical_shape]
+    logical = int(logical)
+    if not tp or int(tp.get("model_n", 1)) <= 1:
+        return arr[:logical].reshape(shape)
+    m = int(tp["model_n"])
+    sp = int(tp["shard_padded"])
+    dim = int(tp["tp_dim"])
+    shard_logical = logical // m
+    sshape = list(shape)
+    sshape[dim] //= m
+    rows = arr.reshape(m, sp)[:, :shard_logical]
+    return np.concatenate([r.reshape(sshape) for r in rows], axis=dim)
 
 
 def unpack_param(flat, entry):
@@ -322,7 +562,8 @@ def unpack_param(flat, entry):
 
     arr = np.asarray(flat)
     if entry.sharded and arr.shape == (entry.padded,):
-        return arr[:entry.logical].reshape(entry.shape)
+        return unflatten_tiles(arr, entry.logical, entry.shape,
+                               tp_meta(entry))
     return arr
 
 
@@ -333,11 +574,12 @@ def unpack_params(params, lay):
 
 def state_sharding(states_tree, entry, mesh, axis):
     """Pytree of ``NamedSharding`` matching one parameter's fused state:
-    flat ``(padded,)`` leaves tile over ``axis``, everything else
-    (scalars, schedules) replicates."""
+    flat ``(padded,)`` leaves tile over ``axis`` (group-locally for a
+    plan-composed TP entry), everything else (scalars, schedules)
+    replicates."""
     import jax
 
-    shard = _axis_sharding(mesh, axis)
+    shard = flat_sharding(mesh, axis, entry)
     repl = _replicated(mesh)
 
     def _leaf(leaf):
@@ -376,7 +618,7 @@ def shard_state(state, entry, mesh, axis):
 
     if not entry.sharded:
         return jax.tree.map(jnp.asarray, state)
-    shard = _axis_sharding(mesh, axis)
+    shard = flat_sharding(mesh, axis, entry)
     repl = _replicated(mesh)
 
     def _leaf(leaf):
@@ -402,7 +644,8 @@ def unshard_state(state, entry):
     def _leaf(leaf):
         arr = np.asarray(leaf)
         if arr.shape == (entry.padded,):
-            return arr[:entry.logical].reshape(entry.shape)
+            return unflatten_tiles(arr, entry.logical, entry.shape,
+                                   tp_meta(entry))
         return arr
 
     return jax.tree.map(_leaf, state)
@@ -480,6 +723,9 @@ def export_states(states, lay):
             "logical": ent.logical,
             "canonical_shape": list(ent.shape),
         }
+        tp = tp_meta(ent)
+        if tp:
+            out[name]["tp"] = tp
     return out
 
 
@@ -501,6 +747,9 @@ def export_params(params, lay):
             "logical": ent.logical,
             "canonical_shape": list(ent.shape),
         }
+        tp = tp_meta(ent)
+        if tp:
+            out[name]["tp"] = tp
     return out
 
 
@@ -532,12 +781,21 @@ def params_bytes_per_replica(params):
     return state_bytes_per_replica(params)
 
 
+def _gathered_elems(e):
+    """Flat elements one device materializes when gathering one entry:
+    the whole padded footprint for the classic layout, one group's
+    shard for a plan-composed TP entry (the gather never crosses model
+    groups)."""
+    return e.shard_padded if getattr(e, "model_n", 1) > 1 else e.padded
+
+
 def update_gather_bytes(lay):
     """Bytes of fresh parameters the trailing all-gather moves per step
     under the stage-1 update (the padded flat size of every sharded
-    parameter).  Zero under ZeRO-3 — there is no trailing gather; see
-    :func:`zero3_gather_bytes`."""
-    return sum(e.padded * e.dtype.itemsize
+    parameter; group-local — one shard, not the whole footprint — for
+    plan-composed TP entries).  Zero under ZeRO-3 — there is no
+    trailing gather; see :func:`zero3_gather_bytes`."""
+    return sum(_gathered_elems(e) * e.dtype.itemsize
                for e in lay.values() if e.sharded)
 
 
@@ -555,9 +813,10 @@ def zero3_gather_bytes(lay, quant=None):
         if not e.sharded:
             continue
         itemsize = e.dtype.itemsize
-        if mode and _quant.eligible(e.shape, e.dtype):
+        if mode and not getattr(e, "model_n", 1) > 1 and \
+                _quant.eligible(e.shape, e.dtype):
             itemsize = _quant.quant_dtype(mode).itemsize
-        total += e.padded * itemsize
+        total += _gathered_elems(e) * itemsize
     return 2 * total
 
 

@@ -162,8 +162,15 @@ def search_serve(directory, budget):
     return 0
 
 
-def search_train(directory, budget):
-    """Measured train-knob search on a small fused-step transformer."""
+def search_train(directory, budget, plan=None):
+    """Measured train-knob search on a small fused-step transformer.
+
+    With ``--plan`` the step compiles as the COMPOSED program
+    (``TrainStep(plan=...)``) and the record keys by the plan
+    fingerprint (``autotune.train_key_topology``), so a tp x zero3
+    plan's knobs never leak onto pure-DP runs of the same symbol; the
+    ZeRO gather-bucket size joins the search space whenever the plan
+    shards the update."""
     import jax
     import numpy as np
 
@@ -171,7 +178,18 @@ def search_train(directory, budget):
     from mxnet_tpu.fused import TrainStep
     from mxnet_tpu.models import transformer
 
+    plan_obj = mesh = None
+    if plan:
+        from mxnet_tpu.parallel import ParallelPlan
+
+        plan_obj = ParallelPlan.parse(plan)
+        mesh = plan_obj.mesh()
+
     seq_len, batch = 32, 4
+    if mesh is not None:
+        data_n = int(dict(mesh.shape).get("data", 1))
+        if batch % data_n:
+            batch = data_n * max(1, batch // data_n)
     sym = transformer.get_symbol(vocab_size=128, num_layers=2,
                                  d_model=64, num_heads=2,
                                  seq_len=seq_len)
@@ -193,7 +211,8 @@ def search_train(directory, budget):
                 os.environ[env_name] = str(knobs[kname])
         try:
             step = TrainStep(sym, optimizer="sgd",
-                             optimizer_params={"learning_rate": 0.01})
+                             optimizer_params={"learning_rate": 0.01},
+                             plan=plan_obj)
             params, aux, states = step.init_state(shapes)
             rng = jax.random.PRNGKey(0)
             for _ in range(2):
@@ -219,7 +238,12 @@ def search_train(directory, budget):
         autotune.Knob("attn_block", (128, 64, 32)),
         autotune.Knob("grad_bucket_mb", (4, 1)),
     ]
-    key = autotune.Key("train", autotune.fingerprint_symbol(sym))
+    if plan_obj is not None and plan_obj.zero in ("on", "3", "auto"):
+        # the forward/backward bucket schedule's granularity — only a
+        # knob when the plan shards the update over the data axis
+        space.append(autotune.Knob("gather_bucket_mb", (8, 2, 0.5)))
+    key = autotune.Key("train", autotune.fingerprint_symbol(sym),
+                       autotune.train_key_topology(mesh, plan_obj))
     rec = autotune.search(measure, space, key,
                           store=autotune.AutotuneStore(directory),
                           budget=budget)
@@ -244,14 +268,21 @@ def main(argv=None):
     ap.add_argument("--budget-s", type=float, default=0.0,
                     help="wall-clock cap for measurement passes "
                          "(0 = unbounded)")
+    ap.add_argument("--plan", default=None,
+                    help="ParallelPlan spec (e.g. data=4,model=2,"
+                         "zero=3) for --search train: the step compiles "
+                         "composed and the record keys by the plan "
+                         "fingerprint")
     args = ap.parse_args(argv)
     directory = args.dir or _default_dir()
     if args.report:
         return 0 if print_records(directory) else 1
+    if args.plan and args.search != "train":
+        ap.error("--plan only applies to --search train")
     if args.search == "serve":
         return search_serve(directory, args.budget_s)
     if args.search == "train":
-        return search_train(directory, args.budget_s)
+        return search_train(directory, args.budget_s, plan=args.plan)
     print("nothing to do: pass --report or --search serve|train",
           file=sys.stderr)
     return 2

@@ -54,6 +54,26 @@ _SKIP_OPS = {"parameter", "constant", "get-tuple-element", "tuple",
              "bitcast", "after-all", "partition-id", "replica-id"}
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                 "all-to-all", "collective-permute")
+# `replica_groups={{0,2,4,6},{1,3,5,7}}` (literal) or the iota form
+# `replica_groups=[2,4]<=[4,2]T(1,0)` ([num_groups, group_size])
+_RG_LITERAL_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+_RG_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+def _replica_groups(line):
+    """(num_groups, group_size) of a collective's replica_groups clause,
+    or (None, None) when absent.  One group spanning everything = a
+    GLOBAL collective; several groups = group-scoped (the composed
+    plan's signature)."""
+    m = _RG_IOTA_RE.search(line)
+    if m:
+        return int(m.group(1)), int(m.group(2))
+    m = _RG_LITERAL_RE.search(line)
+    if not m:
+        return None, None
+    groups = m.group(1).split("},{")
+    size = len([t for t in groups[0].strip("{}").split(",") if t.strip()])
+    return len(groups), size
 
 
 def _collective_kind(op):
@@ -133,6 +153,76 @@ def expect_async(payload, path):
     return True
 
 
+def expect_plan(payload, path):
+    """Composed-plan collective roster check.
+
+    A ``--plan data=D,model=M`` dump must show GROUP-SCOPED collectives:
+    the ZeRO reduce-scatter/all-gather runs over the data axis *within*
+    each model group (M groups of D devices), and the Megatron
+    activation reductions run over the model axis within each data
+    group (D groups of M devices).  A single-group collective spanning
+    the whole mesh while moving the sharded-parameter footprint is the
+    monolithic global gather/reduce the plan exists to eliminate —
+    named offender, FAIL with the roster printed.  Scalar global psums
+    (loss, grad-norm) are exact-by-construction and legitimate.
+    Returns True on pass."""
+    plan = payload.get("plan") or {}
+    model_n = int(plan.get("model") or 1)
+    data_n = int(plan.get("data") or 1)
+    colls = [c for c in (payload.get("collectives") or [])
+             if not c["op"].endswith("-done")]
+    sized = [c for c in colls if c.get("groups")]
+    total = int(payload.get("zero_sharded_bytes") or 0)
+
+    def _is(c, kind):
+        return _collective_kind(c["op"]) == kind
+
+    failures = []
+    if model_n > 1:
+        # data-scoped ZeRO traffic: M groups (one per model shard)
+        if not any((_is(c, "reduce-scatter") or _is(c, "all-gather"))
+                   and c["groups"] == model_n for c in sized):
+            failures.append(
+                "no group-scoped reduce-scatter/all-gather with "
+                "%d replica groups (data-axis ZeRO traffic should be "
+                "scoped per model group)" % model_n)
+        # model-scoped TP reductions: D groups (one per data shard)
+        if not any(_is(c, "all-reduce") and c["groups"] == data_n
+                   for c in sized):
+            failures.append(
+                "no group-scoped all-reduce with %d replica groups "
+                "(Megatron activation reduction should be scoped per "
+                "data group)" % data_n)
+    offenders = []
+    for c in sized:
+        if c["groups"] != 1 or _collective_kind(c["op"]) not in (
+                "all-reduce", "all-gather", "reduce-scatter"):
+            continue
+        if total and int(c.get("bytes") or 0) >= total:
+            offenders.append(
+                "%s (%s, %s >= %s sharded footprint: global monolithic "
+                "collective across the whole mesh)"
+                % (c["name"], c["op"], _fmt_bytes(c["bytes"]),
+                   _fmt_bytes(total)))
+    if failures or offenders:
+        print("EXPECT-PLAN %s: FAIL (plan %s)" % (path, plan))
+        for f in failures:
+            print("    missing: %s" % f)
+        for o in offenders:
+            print("    offender: %s" % o)
+        print("    roster:")
+        for c in colls:
+            print("      %-40s %-20s groups=%-4s %s"
+                  % (c["name"], c["op"], c.get("groups"),
+                     _fmt_bytes(c["bytes"])))
+        return False
+    print("EXPECT-PLAN %s: PASS (plan %s: ZeRO traffic scoped to %d "
+          "model group(s), TP reductions scoped to %d data group(s), "
+          "no global monolithic collective)"
+          % (path, plan, model_n, data_n))
+    return True
+
+
 def _shape_bytes(dtype, dims):
     n = _BYTES.get(dtype, 4)
     for d in dims.split(","):
@@ -162,7 +252,9 @@ def parse_hlo(text):
             continue
         nbytes = _shape_bytes(dtype, dims)
         if any(op.startswith(c) for c in _COLLECTIVES):
-            collectives.append({"name": name, "op": op, "bytes": nbytes})
+            ngroups, gsize = _replica_groups(line)
+            collectives.append({"name": name, "op": op, "bytes": nbytes,
+                                "groups": ngroups, "group_size": gsize})
         if op in _SKIP_OPS or op.startswith("fusion"):
             continue
         producers.append({"name": name, "op": op,
@@ -187,7 +279,8 @@ def _fmt_bytes(n):
 
 
 def dump(out_path, model="transformer", batch=None, seq=None,
-         attn_impl=None, mesh=None, zero=None, check_async=False):
+         attn_impl=None, mesh=None, zero=None, check_async=False,
+         plan=None, check_plan=False):
     """Compile one fused train step AOT and write the audit artifact.
 
     ``mesh=N`` compiles over an N-way data mesh so the gradient
@@ -196,7 +289,10 @@ def dump(out_path, model="transformer", batch=None, seq=None,
     all-reduce turn into a reduce-scatter + all-gather pair.  A
     ``--zero 3`` dump against a ``--zero on`` one shows the trailing
     full-parameter all-gather replaced by the in-step bucket
-    gathers."""
+    gathers.  ``plan="data=4,model=2"`` compiles the COMPOSED step
+    (``TrainStep(plan=...)``) and records the plan identity so
+    ``--expect-plan`` can audit the roster: group-scoped collectives
+    only, no monolithic global gather/reduce."""
     if attn_impl:
         os.environ["MXNET_ATTN_IMPL"] = attn_impl
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -223,13 +319,26 @@ def dump(out_path, model="transformer", batch=None, seq=None,
                   "softmax_label": (b, cfg["seq_len"])}
 
     dev_mesh = None
-    if mesh:
+    plan_obj = None
+    if plan:
+        from mxnet_tpu.parallel import ParallelPlan
+
+        plan_obj = ParallelPlan.parse(plan)
+        if zero is not None and plan_obj.zero is None:
+            plan_obj = ParallelPlan(data=plan_obj.data,
+                                    model=plan_obj.model,
+                                    pipe=plan_obj.pipe, seq=plan_obj.seq,
+                                    zero=zero,
+                                    schedule=plan_obj.schedule,
+                                    n_microbatches=plan_obj.n_microbatches)
+    elif mesh:
         from mxnet_tpu.parallel import create_mesh
 
         dev_mesh = create_mesh({"data": int(mesh)})
     step = TrainStep(sym, optimizer="sgd",
                      optimizer_params={"learning_rate": 0.01},
-                     mesh=dev_mesh, zero=zero)
+                     mesh=dev_mesh, zero=None if plan_obj else zero,
+                     plan=plan_obj)
     step.compile(shapes)
     compiled = step._aot
     payload = {"kind": ARTIFACT_KIND, "pid": os.getpid(),
@@ -242,6 +351,13 @@ def dump(out_path, model="transformer", batch=None, seq=None,
                               else 1),
                "attn_impl": attn_impl or os.environ.get(
                    "MXNET_ATTN_IMPL", "auto")}
+    if plan_obj is not None:
+        payload["plan"] = plan_obj.describe()
+        shape = dict(step.mesh.shape)
+        # resolve the data=-1 wildcard: the audit reads group counts
+        payload["plan"]["data"] = int(shape.get("data", 1))
+        payload["mesh_axes"] = {k: int(v) for k, v in shape.items()}
+        payload["plan_fingerprint"] = plan_obj.fingerprint(step.mesh)
     lay = getattr(step, "_zero_lay", None)
     if lay:
         from mxnet_tpu.parallel import overlap as _ov
@@ -267,9 +383,12 @@ def dump(out_path, model="transformer", batch=None, seq=None,
         json.dump(payload, f)
     print("wrote %s" % out_path)
     print_report(out_path, payload)
+    rc = 0
     if check_async and not expect_async(payload, out_path):
-        return 1
-    return 0
+        rc = 1
+    if check_plan and not expect_plan(payload, out_path):
+        rc = 1
+    return rc
 
 
 def print_report(path, payload):
@@ -431,6 +550,10 @@ def main(argv=None):
     ap.add_argument("--mesh", type=int,
                     help="compile the dump over an N-way data mesh "
                          "(the gradient collectives only exist then)")
+    ap.add_argument("--plan",
+                    help="compile the COMPOSED step over a ParallelPlan "
+                         "spec (e.g. data=4,model=2,zero=3); replaces "
+                         "--mesh")
     ap.add_argument("--zero", choices=("auto", "on", "off", "3"),
                     help="MXNET_ZERO mode for the dump; diff a "
                          "--zero off dump against a --zero on one to "
@@ -444,6 +567,13 @@ def main(argv=None):
                          "named offender; on sync-only backends (CPU) "
                          "a structural check rejects a monolithic "
                          "full-parameter all-gather under zero=3")
+    ap.add_argument("--expect-plan", action="store_true",
+                    help="fail (exit 1) when a --plan dump's collective "
+                         "roster is not group-scoped: ZeRO traffic must "
+                         "run in per-model-group replica groups, TP "
+                         "reductions in per-data-group ones, and no "
+                         "global monolithic all-reduce/all-gather/"
+                         "reduce-scatter may span the whole mesh")
     ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                     help="compare two artifacts")
     args = ap.parse_args(argv)
@@ -451,7 +581,8 @@ def main(argv=None):
         return dump(args.dump, model=args.model, batch=args.batch,
                     seq=args.seq, attn_impl=args.attn_impl,
                     mesh=args.mesh, zero=args.zero,
-                    check_async=args.expect_async)
+                    check_async=args.expect_async,
+                    plan=args.plan, check_plan=args.expect_plan)
     if args.diff:
         return diff(*args.diff)
     if not args.paths:
@@ -459,12 +590,14 @@ def main(argv=None):
     ok, async_fail = 0, 0
     for path in args.paths:
         ok += report_file(path)
-        if args.expect_async:
+        if args.expect_async or args.expect_plan:
             try:
                 payload = _load(path)
             except (ValueError, SystemExit):
                 continue  # raw HLO text: no structural metadata
-            if not expect_async(payload, path):
+            if args.expect_async and not expect_async(payload, path):
+                async_fail += 1
+            if args.expect_plan and not expect_plan(payload, path):
                 async_fail += 1
     return 0 if ok and not async_fail else 1
 
