@@ -88,6 +88,90 @@ def measure_pure_step(sym, batch, feat, iters=60):
     return batch * iters / (time.perf_counter() - t0)
 
 
+def measure_fp8_ab(sym, batch, feat, steps=24, iters=40):
+    """fp8 training A/B (``MXNET_FP8``): bf16 vs bf16-with-fp8-matmuls
+    loss trajectories over identical batches and seeds, the max drift
+    asserted under an explicit bound (the delayed-scaling recipe must
+    TRACK the clean path, not just stay finite), plus the steady-state
+    step-rate ratio.  On CPU the fake-cast pairs are exposed arithmetic
+    next to small matmuls, so the ratio is the honesty row; on
+    fp8-native hardware XLA folds each pair into a real fp8 operand
+    (tools/fusion_audit.py --expect-fp8 checks the folds held)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from mxnet_tpu.fused import TrainStep
+
+    shapes = {"data": (batch, feat), "softmax_label": (batch,)}
+    rs = np.random.RandomState(3)
+    bd = {"data": rs.randn(*shapes["data"]).astype("float32"),
+          "softmax_label": rs.randint(
+              0, 10, size=shapes["softmax_label"]).astype("float32")}
+    lab = bd["softmax_label"].astype(int)
+
+    def run(fp8):
+        old = os.environ.get("MXNET_FP8")
+        os.environ["MXNET_FP8"] = "on" if fp8 else "off"
+        try:
+            step = TrainStep(sym, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.05,
+                                               "rescale_grad": 1.0 / batch},
+                             compute_dtype="bfloat16")
+            params, aux, states = step.init_state(shapes)
+            rng = jax.random.PRNGKey(0)
+            losses = []
+            for i in range(steps):
+                params, aux, states, out = step(
+                    params, aux, states, bd, jax.random.fold_in(rng, i))
+                p = np.asarray(out[0], dtype="float32")
+                losses.append(float(-np.log(np.maximum(
+                    p[np.arange(batch), lab], 1e-30)).mean()))
+            jax.block_until_ready(params)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                params, aux, states, out = step(params, aux, states, bd,
+                                                rng)
+            jax.block_until_ready(out[0])
+            rate = batch * iters / (time.perf_counter() - t0)
+            return losses, rate, step
+        finally:
+            if old is None:
+                os.environ.pop("MXNET_FP8", None)
+            else:
+                os.environ["MXNET_FP8"] = old
+
+    base_losses, base_rate, _ = run(False)
+    fp8_losses, fp8_rate, fstep = run(True)
+    drift = max(abs(a - b) for a, b in zip(base_losses, fp8_losses))
+    drift_bound = 0.25
+    out = {
+        "fp8_sites": fstep._fp8_sites,
+        "fp8_amax_history": int(np.asarray(
+            fstep._hstate["fp8_hist"]).shape[-1]),
+        "bf16_loss_first": round(base_losses[0], 5),
+        "bf16_loss_final": round(base_losses[-1], 5),
+        "fp8_loss_first": round(fp8_losses[0], 5),
+        "fp8_loss_final": round(fp8_losses[-1], 5),
+        "fp8_loss_drift_max": round(drift, 5),
+        "fp8_loss_drift_bound": drift_bound,
+        "bf16_images_per_sec": round(base_rate, 2),
+        "fp8_images_per_sec": round(fp8_rate, 2),
+        "fp8_step_ratio": round(fp8_rate / max(base_rate, 1e-9), 4),
+    }
+    assert fstep._fp8_sites and fstep._fp8_sites >= 3, \
+        "fp8 route claimed %r matmul sites (expected every FC layer)" \
+        % (fstep._fp8_sites,)
+    assert drift <= drift_bound, \
+        "fp8 loss trajectory drifted %.4f from bf16 (bound %.2f)" \
+        % (drift, drift_bound)
+    assert fp8_losses[-1] < fp8_losses[0], \
+        "fp8 loss not decreasing: %r -> %r" % (fp8_losses[0],
+                                               fp8_losses[-1])
+    return out
+
+
 def measure_zero_ab(sym, batch, feat, iters=30):
     """zero=off vs zero=on vs zero=3 A/B over the device mesh: step
     rate, the per-replica optimizer-state bytes (the ZeRO 1/N claim),
@@ -538,6 +622,9 @@ def main():
     result.update(measure_decode_ab())
     # checkpoint write cost on the training thread, sync vs async
     result.update(measure_ckpt_save(sym, X, y, batch))
+    # fp8 training A/B: loss-trajectory drift under the asserted bound
+    # plus the step-rate ratio, bf16 vs bf16-with-fp8-matmuls
+    result.update(measure_fp8_ab(sym, batch, feat))
     # ZeRO sharded update A/B: state bytes must shrink ~1/N at >=95%
     # of the replicated step rate
     result.update(measure_zero_ab(sym, batch, feat))

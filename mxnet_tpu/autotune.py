@@ -55,6 +55,9 @@ TRAIN_KNOB_ENV = {
     "attn_block": "MXNET_ATTN_BLOCK",
     "grad_bucket_mb": "MXNET_GRAD_BUCKET_MB",
     "gather_bucket_mb": "MXNET_ZERO_GATHER_BUCKET_MB",
+    # per-layer fp8 allow-list: a tuned comma list of layer names keeps
+    # drift-sensitive layers on bf16 while the rest take the fp8 route
+    "fp8_layers": "MXNET_FP8_LAYERS",
 }
 
 _APPLIED = []  # provenance of knob applications in this process
@@ -364,9 +367,10 @@ def apply_serve(config, params, store=None):
     """Fold a cached serve tuning record into an env-derived
     ``ServeConfig`` (called by ``InferenceSession`` only when the
     caller did NOT pass an explicit config).  Applies ``quant``,
-    ``buckets``, ``prefix_pages`` (prefix-cache retention size) and
-    ``watermark`` (preemption free-pool floor; inert until the caller
-    turns ``oversub`` on) knobs; anything the record doesn't carry
+    ``kv_quant`` (int8/fp8 KV-cache pages), ``buckets``,
+    ``prefix_pages`` (prefix-cache retention size) and ``watermark``
+    (preemption free-pool floor; inert until the caller turns
+    ``oversub`` on) knobs; anything the record doesn't carry
     keeps the env/default value.  No-op unless ``MXNET_AUTOTUNE`` is on
     and a record exists for this (model-fingerprint, backend)."""
     if not autotune_enabled():
@@ -383,6 +387,8 @@ def apply_serve(config, params, store=None):
     updates = {}
     if "quant" in knobs:
         updates["quant"] = quant_mode(knobs["quant"])
+    if "kv_quant" in knobs:
+        updates["kv_quant"] = quant_mode(knobs["kv_quant"])
     if "buckets" in knobs:
         updates["buckets"] = tuple(int(b) for b in knobs["buckets"])
     if "prefix_pages" in knobs:

@@ -48,6 +48,9 @@ def _trace_fn(sym, is_train, node_hook=None):
 
     # positions of aux-updating results: node -> list of (input var name)
     def fn(args, aux, rng):
+        from . import quantize as _quantize
+
+        fp8_label = _quantize.fp8_tracing()
         env = {}
         new_aux = dict(aux)
         rng_i = 0
@@ -62,6 +65,11 @@ def _trace_fn(sym, is_train, node_hook=None):
             attrs = dict(node.attrs)
             if node.op.uses_train_mode:
                 attrs["__is_train__"] = is_train
+            if fp8_label:
+                # label fp8 matmul sites by node so MXNET_FP8_LAYERS
+                # can name them; only under an active fp8 trace, so
+                # clean traces keep byte-identical attrs
+                attrs["__node_name__"] = node.name
             if node.op.needs_rng:
                 ins = [jax.random.fold_in(rng, rng_i)] + ins
                 rng_i += 1

@@ -256,6 +256,18 @@ class TrainStep:
         # reach the weights, whatever skip_nonfinite says
         skip_on_bad = health is not None and (
             health.skip_nonfinite or scaler is not None)
+        from . import quantize as _quantize
+
+        # fp8 training compute (MXNET_FP8): per-site amax histories ride
+        # the carried hstate exactly like the dynamic loss scaler, so an
+        # armed fp8 build uses the 8-arg/6-output step form even when no
+        # StepHealth is configured.  Site count is discovered lazily
+        # (first compile/call) from an abstract forward trace.
+        fp8_on = _quantize.fp8_enabled()
+        self._fp8 = fp8_on
+        self._fp8_sites = None
+        use_hstate = health is not None or fp8_on
+        self._use_hstate = use_hstate
         clip_gnorm = optimizer.clip_global_norm
         rescale = optimizer.rescale_grad
 
@@ -331,6 +343,13 @@ class TrainStep:
                 x.dtype, jnp.floating) else x
 
         def core_step(params, aux, states, batch, rng, lr, t, hstate):
+            # delayed scaling: realize this step's per-site (x, w) scales
+            # from the carried amax history before the forward traces
+            fp8_scales = None
+            if fp8_on and "fp8_hist" in hstate:
+                fp8_scales = _quantize.fp8_realize_scales(
+                    hstate["fp8_hist"])
+
             def loss_fn(p, b, r):
                 args = dict(p)
                 args.update(b)
@@ -338,10 +357,22 @@ class TrainStep:
                 if cdtype is not None:
                     args = {k: cast_compute(v) for k, v in args.items()}
                     a = {k: cast_compute(v) for k, v in aux.items()}
-                outs, new_aux = fwd_fn(args, a, r)
+                if fp8_scales is not None:
+                    with _quantize.fp8_trace(fp8_scales) as tr:
+                        outs, new_aux = fwd_fn(args, a, r)
+                    amax = jnp.stack(tr.amax) if tr.amax else None
+                else:
+                    outs, new_aux = fwd_fn(args, a, r)
+                    amax = None
                 if cdtype is not None:
                     new_aux = {k: v.astype(aux[k].dtype)
                                for k, v in new_aux.items()}
+                if amax is not None:
+                    # fresh amaxes leave the grad transform as an aux
+                    # output under a reserved key (a Python-side record
+                    # would leak tracers); popped right after the vag
+                    new_aux = dict(new_aux)
+                    new_aux["__fp8_amax__"] = amax
                 loss = _loss_from_outputs(outs)
                 if scaler is not None:
                     # scale the loss BEFORE the backward: gradients come
@@ -399,6 +430,10 @@ class TrainStep:
                     lambda p: loss_fn(p, batch, rng),
                     has_aux=True)(params)
             (loss, (outs, new_aux)), grads = vag
+            fp8_amax = None
+            if fp8_scales is not None:
+                new_aux = dict(new_aux)
+                fp8_amax = new_aux.pop("__fp8_amax__", None)
             if zlay is not None:
                 # normalize: sharded grads still at full shape came from
                 # the GSPMD fallback (or a declined DDP trace) — the
@@ -497,6 +532,13 @@ class TrainStep:
                 }
             else:
                 new_hstate = hstate
+            if fp8_amax is not None:
+                # roll the amax history forward even on skipped steps —
+                # but a nonfinite forward amax must not poison it
+                safe = jnp.where(jnp.isfinite(fp8_amax), fp8_amax, 0.0)
+                new_hstate = dict(new_hstate)
+                new_hstate["fp8_hist"] = _quantize.fp8_update_hist(
+                    hstate["fp8_hist"], safe)
             stats = {"loss": loss.astype("float32"), "grad_norm": gnorm,
                      "nonfinite": nonfinite}
             if scaler is not None:
@@ -505,7 +547,7 @@ class TrainStep:
             # a batch-sharded prefix sharding covers the whole tuple
             return new_params, new_aux, new_states, outs, new_hstate, stats
 
-        if health is not None:
+        if use_hstate:
             step = core_step
         else:
             # legacy 7-arg / 4-output form: the discarded loss value,
@@ -534,7 +576,7 @@ class TrainStep:
             # health stats likewise carry one (K,) entry per inner step.
             base_step = step
 
-            if health is not None:
+            if use_hstate:
                 def step(params, aux, states, batch, rng, lr, t, hstate):
                     def body(carry, xs):
                         p, a, s, tk, h = carry
@@ -647,9 +689,9 @@ class TrainStep:
         self._in_repl = repl
         in_sh = (pshard, repl, sshard, bdict, repl, None, None)
         out_sh = (pshard, repl, sshard, bshard)
-        if self._health is not None:
-            # + scaler state in, + scaler state / health stats out — all
-            # scalars, replicated everywhere
+        if self._use_hstate:
+            # + scaler/fp8 state in, + new state / health stats out —
+            # scalars and small histories, replicated everywhere
             in_sh = in_sh + (repl,)
             out_sh = out_sh + (repl, repl)
         return jax.jit(self._step_fn, in_shardings=in_sh,
@@ -890,7 +932,8 @@ class TrainStep:
             batch[n] = S(shp, jnp.dtype("float32"))
         args = (params, aux, states, batch, jax.random.PRNGKey(0),
                 float(self.lr), jnp.asarray(1, "int32"))
-        if self._health is not None:
+        if self._use_hstate:
+            self._fp8_site_count(params, aux, batch)
             args = args + (self._init_hstate(),)
         return args
 
@@ -1013,14 +1056,16 @@ class TrainStep:
             rng = _place(rng, repl)
             lr = _place(jnp.asarray(lr, "float32"), repl)
             t = _place(t, repl)
-            if self._health is not None and self._hstate is None:
+            if self._use_hstate and self._hstate is None:
+                self._fp8_site_count(params, aux, batch)
                 self._hstate = self._init_hstate()
             if self._hstate is not None:
                 self._hstate = _place(self._hstate, repl)
-        if self._health is None:
+        if not self._use_hstate:
             call_args = (params, aux, states, batch, rng, lr, t)
         else:
             if self._hstate is None:
+                self._fp8_site_count(params, aux, batch)
                 self._hstate = self._init_hstate()
             call_args = (params, aux, states, batch, rng, lr, t,
                          self._hstate)
@@ -1071,7 +1116,7 @@ class TrainStep:
                                          active=active, what=what)
         else:
             out = dispatch()
-        if self._health is None:
+        if not self._use_hstate:
             return out
         (params, aux, states, outs, self._hstate,
          self.last_health) = out
@@ -1081,10 +1126,57 @@ class TrainStep:
         import jax.numpy as jnp
 
         scaler = self._health.scaler if self._health is not None else None
-        if scaler is None:
-            return {}
-        return {"loss_scale": jnp.asarray(scaler.init_scale, "float32"),
-                "good_steps": jnp.asarray(0, "int32")}
+        h = {}
+        if scaler is not None:
+            h["loss_scale"] = jnp.asarray(scaler.init_scale, "float32")
+            h["good_steps"] = jnp.asarray(0, "int32")
+        if self._fp8 and self._fp8_sites:
+            from . import quantize as _quantize
+
+            h["fp8_hist"] = _quantize.fp8_hist_init(self._fp8_sites)
+        return h
+
+    def _fp8_site_count(self, params, aux, batch):
+        """Count the fp8 matmul sites one forward claims (once, via an
+        abstract trace) — the leading dim of the carried amax history.
+
+        Works from avals only, so live arrays and ShapeDtypeStructs both
+        serve.  Under ZeRO-3 the live params are flat at-rest tiles; the
+        cached layout recovers their canonical shapes.  The super-batch
+        leading K axis is stripped when ``steps_per_call > 1``."""
+        if not self._fp8 or self._fp8_sites is not None:
+            return self._fp8_sites
+        import jax
+        import jax.numpy as jnp
+
+        from . import quantize as _quantize
+
+        S = jax.ShapeDtypeStruct
+        lay = self._zero_lay if self.zero3 else None
+        cparams = {}
+        for n, v in dict(params).items():
+            shp, dt = tuple(v.shape), v.dtype
+            if lay is not None and n in lay and lay[n].sharded:
+                shp, dt = tuple(lay[n].shape), lay[n].dtype
+            cparams[n] = S(shp, jnp.dtype(dt))
+        K = self._steps_per_call
+        abatch = {n: S(tuple(v.shape)[1:] if K > 1 else tuple(v.shape),
+                       jnp.dtype(v.dtype))
+                  for n, v in dict(batch).items()}
+        aaux = {n: S(tuple(v.shape), jnp.dtype(v.dtype))
+                for n, v in dict(aux).items()}
+        fwd = self._fwd_fn
+        rng = jax.random.PRNGKey(0)
+
+        def probe(p, a, b):
+            args = dict(p)
+            args.update(b)
+            return fwd(args, a, rng)
+
+        with _quantize.fp8_trace() as tr:
+            jax.eval_shape(probe, cparams, aaux, abatch)
+        self._fp8_sites = len(tr.names)
+        return self._fp8_sites
 
     @property
     def loss_scale(self):

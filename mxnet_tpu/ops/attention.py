@@ -340,7 +340,7 @@ def flash_attention(q, k, v, causal=True, scale=None, block=None,
 # ---------------------------------------------------------------------------
 
 def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
-                     mi=False):
+                     mi=False, k_scale=None, v_scale=None):
     """One autoregressive decode step of attention over a paged KV
     context: the O(1)-per-token serving counterpart of
     :func:`flash_attention`, built from the same :func:`attend_block`
@@ -360,6 +360,13 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
     merge (correction 1, p 0), so visiting all ``Tcap/block`` blocks
     with the validity mask reproduces the reference forward's merge
     sequence bit-for-bit when ``mi=True``.
+
+    ``k_scale``/``v_scale``: optional (S, Tcap) float32 per-position
+    scales of a quantized KV context (``quantize.kv_quantize_rows``
+    rows).  Dequantization happens HERE, per block inside the scan —
+    an elementwise convert + multiply feeding the score/value matmuls
+    directly, so XLA fuses it into the attention kernel and the f32
+    context never materializes at (S, H, Tcap, D).
     """
     d = q.shape[-1]
     t_cap = k_ctx.shape[-2]
@@ -375,6 +382,15 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
     nblk = t_cap // block
     kb = _kv_blocks(k_ctx, t_cap, block)
     vb = _kv_blocks(v_ctx, t_cap, block)
+
+    def _scale_blocks(s):
+        # (S, Tcap) -> (nblk, S, 1, block, 1): broadcast-ready against
+        # the (nblk, S, H, block, D) code blocks
+        s = s.reshape(s.shape[0], nblk, block)
+        return jnp.moveaxis(s, 1, 0)[:, :, None, :, None]
+
+    ksb = _scale_blocks(k_scale) if k_scale is not None else None
+    vsb = _scale_blocks(v_scale) if v_scale is not None else None
     starts = jnp.arange(nblk) * block
     q32 = q.astype(jnp.float32) * scale
     acc0 = jnp.zeros(q.shape[:-1] + (v_ctx.shape[-1],), jnp.float32)
@@ -393,14 +409,23 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
 
     def body(carry, xs):
         acc, m, l = carry
-        kblk, vblk, start = xs
+        kblk, vblk, start, ks, vs = xs
+        if ks is not None:  # in-kernel dequant of quantized pages
+            kblk = kblk.astype(jnp.float32) * ks
+            vblk = vblk.astype(jnp.float32) * vs
         k_pos = start + jnp.arange(block)
         kv_valid = k_pos < valid_len
         acc, m, l = attend_block(q32, kblk, vblk, acc, m, l,
                                  kv_valid=kv_valid, mi=mi)
         return (acc, m, l), None
 
-    (acc, _, l), _ = lax.scan(body, (acc0, m0, l0), (kb, vb, starts))
+    if ksb is None:
+        (acc, _, l), _ = lax.scan(
+            lambda c, xs: body(c, xs + (None, None)),
+            (acc0, m0, l0), (kb, vb, starts))
+    else:
+        (acc, _, l), _ = lax.scan(body, (acc0, m0, l0),
+                                  (kb, vb, starts, ksb, vsb))
     return finalize_attention(acc, l).astype(q.dtype)
 
 

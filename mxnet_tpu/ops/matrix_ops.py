@@ -380,11 +380,16 @@ def _dot(attrs, a, b):
         am = am.reshape(-1, am.shape[-1])
     if bm.ndim > 2:
         bm = bm.reshape(bm.shape[0], -1)
-    return lax.dot_general(
-        am, bm, (((am.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.promote_types(a.dtype, jnp.float32)
-        if a.dtype == jnp.bfloat16 else None,
-    ).astype(a.dtype)
+    from ..quantize import fp8_apply_dot
+
+    out = fp8_apply_dot(am, bm, label=attrs.get("__node_name__"), w_dim=0)
+    if out is None:
+        out = lax.dot_general(
+            am, bm, (((am.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.promote_types(a.dtype, jnp.float32)
+            if a.dtype == jnp.bfloat16 else None,
+        )
+    return out.astype(a.dtype)
 
 
 @register("batch_dot")

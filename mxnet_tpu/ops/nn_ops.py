@@ -50,11 +50,17 @@ def _fully_connected(attrs, data, weight, *bias):
     """Reference ``src/operator/fully_connected.cc``: Y = X W^T + b."""
     if bool(attrs.get("flatten", True)) and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
-    # bf16 inputs produce bf16 outputs; the MXU accumulates in fp32
-    # internally, and an explicit preferred_element_type=f32 would break
-    # the conv/dot transpose rules (f32 cotangent vs bf16 operand)
-    out = lax.dot_general(
-        data, weight, (((data.ndim - 1,), (1,)), ((), ())))
+    from ..quantize import fp8_apply_dot
+
+    out = fp8_apply_dot(data, weight, label=attrs.get("__node_name__"),
+                        w_dim=1)
+    if out is None:
+        # bf16 inputs produce bf16 outputs; the MXU accumulates in fp32
+        # internally, and an explicit preferred_element_type=f32 would
+        # break the conv/dot transpose rules (f32 cotangent vs bf16
+        # operand)
+        out = lax.dot_general(
+            data, weight, (((data.ndim - 1,), (1,)), ((), ())))
     if bias:
         out = out + bias[0]
     return out
@@ -861,11 +867,17 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
     Sequence-parallel execution of the same contraction lives in
     ``parallel/sequence.py`` (ring attention, same per-block kernel).
     """
+    from ..quantize import fp8_apply_dot
+
     num_heads = int(attrs["num_heads"])
     causal = bool(attrs.get("causal", True))
     n, t, c = data.shape
     d = c // num_heads
-    qkv = jnp.einsum("ntc,fc->ntf", data, in_weight) + in_bias
+    qkv = fp8_apply_dot(data, in_weight, label=attrs.get("__node_name__"),
+                        w_dim=1)
+    if qkv is None:
+        qkv = jnp.einsum("ntc,fc->ntf", data, in_weight)
+    qkv = qkv + in_bias
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(x):
@@ -894,7 +906,11 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
                                     impl=attrs.get("attn_impl") or None,
                                     block=block)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
-    return jnp.einsum("ntc,oc->nto", ctx, out_weight) + out_bias
+    proj = fp8_apply_dot(ctx, out_weight, label=attrs.get("__node_name__"),
+                         w_dim=1)
+    if proj is None:
+        proj = jnp.einsum("ntc,oc->nto", ctx, out_weight)
+    return proj + out_bias
 
 
 @register("_contrib_MoE", aliases=("MoE",), num_outputs=2,

@@ -35,19 +35,26 @@ stay float32 — quantizing them saves nothing and costs accuracy.
 """
 from __future__ import annotations
 
+import contextlib
+import functools
 import math
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 
 __all__ = ["MODES", "quant_mode", "quant_dtype", "eligible",
            "quantize_array", "dequantize_array", "quantize_params",
            "dequantize_params", "is_quantized", "at_rest_bytes",
            "quantize_flat_leaf", "dequant_flat", "quantize_export",
-           "dequantize_with_meta"]
+           "dequantize_with_meta", "kv_quantize_rows", "kv_dequantize",
+           "fp8_mode", "fp8_enabled", "fp8_layer_allowed", "fp8_trace",
+           "fp8_tracing", "fp8_apply_dot", "fp8_hist_init",
+           "fp8_realize_scales", "fp8_update_hist"]
 
 MODES = ("int8", "fp8")
 INT8_MAX = 127.0
 FP8_MAX = 448.0  # float8_e4m3fn largest finite
+FP8_E5M2_MAX = 57344.0  # float8_e5m2 largest finite (gradient format)
+FP8_AMAX_HISTORY = 16  # delayed-scaling window (steps) per fp8 tensor
 MIN_QUANT_BYTES = 1024
 
 
@@ -314,3 +321,275 @@ def dequantize_with_meta(arr, qmeta):
     scales = np.asarray(qmeta["scales"], np.float32)
     scale = scales.reshape((scales.size,) + (1,) * (arr.ndim - 1))
     return np.asarray(arr).astype(np.float32) * scale
+
+
+# -- quantized KV-cache pages ----------------------------------------------
+#
+# The serving KV pools store int8/e4m3 codes with ONE float32 scale per
+# (layer, token) row, held in a parallel scale pool indexed by the same
+# (page, offset) the codes are.  Row granularity is what keeps the
+# per-precision bit-exactness oracle alive: a token's codes and scale
+# are a pure elementwise function of that token's k/v values, so the
+# prefill scatter, the serial decode append, and the batched verify
+# append produce byte-identical pages for the same token — and a
+# prefix-cache hit or a preempt/re-prefill replays them exactly.
+
+def kv_quantize_rows(x, mode):
+    """Traceable per-row KV quantization.
+
+    ``x``: (..., H, D) float k or v rows.  Returns ``(codes, scales)``
+    where ``codes`` has the storage dtype of ``mode`` and ``scales`` is
+    float32 with the leading shape of ``x`` (amax over the trailing
+    (H, D) axes; all-zero rows get scale 1.0).  Runs as jax ops so the
+    quantize fuses into the append executable.
+    """
+    import jax.numpy as jnp
+
+    mode = quant_mode(mode)
+    if not mode:
+        raise MXNetError("kv_quantize_rows: mode is off")
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=(-2, -1))
+    qmax = _qmax(mode)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0).astype(jnp.float32)
+    y = x32 / scale[..., None, None]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(y), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    else:
+        q = jnp.clip(y, -FP8_MAX, FP8_MAX).astype(quant_dtype(mode))
+    return q, scale
+
+
+def kv_dequantize(q, scale):
+    """Inverse of :func:`kv_quantize_rows` on gathered context rows:
+    ``q`` (..., T, H, D) codes, ``scale`` (..., T) float32.  Elementwise
+    convert + multiply, so XLA fuses it into the attention consumer."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+# -- fp8 training compute (delayed scaling) --------------------------------
+#
+# Forward matmul operands cast to e4m3, backward cotangents to e5m2, as
+# quantize-dequantize pairs in the compute dtype (portable across
+# backends; on fp8-native hardware XLA folds the pair into a real fp8
+# operand — tools/fusion_audit.py --expect-fp8 checks the converts
+# stayed fused either way).  Activation/weight scales are DELAYED: each
+# fp8 site keeps a per-tensor amax history that rides the TrainStep
+# hstate (carried scan state, exactly like the dynamic loss scaler) and
+# realizes its scale lazily as max(history)/FP8_MAX.  Gradient
+# cotangents use per-call current-tensor scaling instead — their amax
+# is consumed in the same custom-VJP backward that produces it, so no
+# history round-trip (and no side channel out of the transpose trace)
+# is needed, and e5m2's range makes the one-step lag moot.
+#
+# Sites are claimed in trace order from a trace-local context
+# (:func:`fp8_trace`); the op registry's deterministic execution order
+# makes the i-th claim the same tensor every trace, which is what lets
+# the history live as one stacked (n_sites, 2, HISTORY) array in
+# hstate.  New amaxes leave the trace as explicit aux outputs (never
+# via Python side effects, which would leak tracers out of the grad
+# transform).
+
+_FP8_TRACE = None
+
+
+class _Fp8Trace(object):
+    """Per-trace fp8 site registry: realized scales in, amaxes out."""
+
+    __slots__ = ("scales", "amax", "names")
+
+    def __init__(self, scales=None):
+        self.scales = scales  # (n_sites, 2) f32, or None (discovery)
+        self.amax = []        # per-site (2,) f32 amax, trace order
+        self.names = []       # site labels, trace order
+
+
+@contextlib.contextmanager
+def fp8_trace(scales=None):
+    """Activate the fp8 fast path for ops traced inside the block.
+
+    ``scales``: (n_sites, 2) float32 of realized (x, w) scales, or None
+    for discovery / first step (sites run with scale 1.0).  Yields the
+    context; read ``.amax`` (list of (2,) arrays, trace order) and
+    ``.names`` after the forward ran and return them as aux outputs.
+    """
+    global _FP8_TRACE
+    prev, _FP8_TRACE = _FP8_TRACE, _Fp8Trace(scales)
+    try:
+        yield _FP8_TRACE
+    finally:
+        _FP8_TRACE = prev
+
+
+def fp8_tracing():
+    """Whether an :func:`fp8_trace` context is active on this thread —
+    the executor uses this to decide whether to thread node names into
+    op attrs (clean traces keep their attrs, and jit cache keys,
+    byte-identical to an fp8-free build)."""
+    return _FP8_TRACE is not None
+
+
+def fp8_mode():
+    """Resolve ``MXNET_FP8`` to ``auto`` | ``on`` | ``off``."""
+    raw = str(get_env("MXNET_FP8", "off") or "off").strip().lower()
+    if raw in ("off", "0", "false", "no", ""):
+        return "off"
+    if raw in ("on", "1", "true", "yes"):
+        return "on"
+    if raw == "auto":
+        return "auto"
+    raise MXNetError("MXNET_FP8 must be auto|on|off (got %r)" % (raw,))
+
+
+def fp8_enabled():
+    """Whether the fp8 matmul route is armed for this trace: ``on``
+    forces, ``off`` disables, ``auto`` arms only on backends with
+    native fp8 matmul units (TPU/GPU) — CPU keeps the clean path."""
+    mode = fp8_mode()
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def fp8_layer_allowed(name):
+    """Per-layer opt-out: ``MXNET_FP8_LAYERS`` empty allows every
+    eligible site; a comma-separated list allows only sites whose label
+    matches an entry exactly or by prefix (how the autotuner pins
+    chosen layers to bf16 — see autotune.py's ``fp8_layers`` knob)."""
+    spec = str(get_env("MXNET_FP8_LAYERS", "") or "").strip()
+    if not spec:
+        return True
+    if not name:
+        return False
+    allowed = [t.strip() for t in spec.split(",") if t.strip()]
+    return any(name == a or name.startswith(a) for a in allowed)
+
+
+def fp8_hist_init(n_sites):
+    """Zero-filled (n_sites, 2, FP8_AMAX_HISTORY) float32 amax history
+    — the hstate leaf.  Zero history realizes scale 1.0 (the safe
+    first-step default; real amaxes take over from step 2)."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((int(n_sites), 2, FP8_AMAX_HISTORY), jnp.float32)
+
+
+def fp8_realize_scales(hist):
+    """Lazily realize per-tensor scales from the amax history:
+    ``max(history) / FP8_MAX`` per (site, operand), 1.0 where the
+    history is still empty."""
+    import jax.numpy as jnp
+
+    hmax = jnp.max(hist, axis=-1)
+    return jnp.where(hmax > 0, hmax / FP8_MAX, 1.0).astype(jnp.float32)
+
+
+def fp8_update_hist(hist, new_amax):
+    """Roll the history one step: the fresh (n_sites, 2) amaxes enter
+    at slot 0, the oldest falls off."""
+    import jax.numpy as jnp
+
+    new = jnp.asarray(new_amax, jnp.float32)[..., None]
+    return jnp.concatenate([new, hist[..., :-1]], axis=-1)
+
+
+def _fake_cast(x, scale, qmax, dtype):
+    """Quantize-dequantize ``x`` through ``dtype`` at ``scale``: the
+    numerics of an fp8 tensor without leaving float32."""
+    import jax.numpy as jnp
+
+    y = jnp.clip(x.astype(jnp.float32) / scale, -qmax, qmax)
+    return y.astype(dtype).astype(jnp.float32) * scale
+
+
+@functools.lru_cache(maxsize=None)
+def _fp8_dot_fn(w_dim):
+    """Custom-VJP fp8 contraction of ``x`` (..., C) with 2-D ``w``
+    along ``w``'s axis ``w_dim``.  Forward: both operands fake-cast to
+    e4m3 at the delayed scales.  Backward: the cotangent fake-casts to
+    e5m2 at its own current amax, then contracts against the SAVED
+    e4m3 operands (the standard fp8 training recipe).  Scale args get
+    zero cotangents — they are statistics, not parameters."""
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    e4m3 = quant_dtype("fp8")
+    e5m2 = ml_dtypes.float8_e5m2
+
+    def _cast_pair(x, w, sx, sw):
+        xq = _fake_cast(x, sx, FP8_MAX, e4m3).astype(x.dtype)
+        wq = _fake_cast(w, sw, FP8_MAX, e4m3).astype(w.dtype)
+        return xq, wq
+
+    def _contract(x, w):
+        return jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (w_dim,)), ((), ())))
+
+    @jax.custom_vjp
+    def fp8_dot(x, w, sx, sw):
+        xq, wq = _cast_pair(x, w, sx, sw)
+        return _contract(xq, wq)
+
+    def fwd(x, w, sx, sw):
+        xq, wq = _cast_pair(x, w, sx, sw)
+        return _contract(xq, wq), (xq, wq)
+
+    def bwd(res, g):
+        xq, wq = res
+        amax_g = jnp.max(jnp.abs(g.astype(jnp.float32)))
+        sg = jnp.where(amax_g > 0, amax_g / FP8_E5M2_MAX, 1.0)
+        gq = _fake_cast(g, sg, FP8_E5M2_MAX, e5m2).astype(g.dtype)
+        # dx: contract g's output axis with w's other axis
+        dx = jax.lax.dot_general(
+            gq, wq, (((gq.ndim - 1,), (1 - w_dim,)), ((), ()))
+        ).astype(xq.dtype)
+        g2 = gq.reshape(-1, gq.shape[-1])
+        x2 = xq.reshape(-1, xq.shape[-1])
+        dw = jax.lax.dot_general(g2, x2, (((0,), (0,)), ((), ())))
+        if w_dim == 0:  # w is (C, F): dw above is (F, C) — transpose
+            dw = dw.T
+        return dx, dw.astype(wq.dtype), jnp.zeros_like(sg), \
+            jnp.zeros_like(sg)
+
+    fp8_dot.defvjp(fwd, bwd)
+    return fp8_dot
+
+
+def fp8_apply_dot(x, w, label=None, w_dim=1):
+    """The fp8 matmul route for one op site, or ``None`` to keep the
+    full-precision path (fp8 inactive for this trace, the layer opted
+    out, or the shapes do not fit the 2-D weight contraction).
+
+    Claims the next site in trace order, records the operands' current
+    amaxes into the context (they leave the trace as aux outputs and
+    roll the hstate history), and contracts ``x`` (..., C) against the
+    2-D ``w`` along ``w_dim`` through the custom-VJP fp8 kernel.
+    """
+    t = _FP8_TRACE
+    if t is None:
+        return None
+    if not fp8_layer_allowed(label):
+        return None
+    if getattr(w, "ndim", 0) != 2 or getattr(x, "ndim", 0) < 1:
+        return None
+    if x.shape[-1] != w.shape[w_dim]:
+        return None
+    import jax.numpy as jnp
+
+    i = len(t.names)
+    t.names.append(label or ("site%d" % i))
+    ax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    aw = jnp.max(jnp.abs(w.astype(jnp.float32)))
+    t.amax.append(jnp.stack([ax, aw]))
+    if t.scales is None:
+        sx = sw = jnp.float32(1.0)
+    else:
+        sx, sw = t.scales[i, 0], t.scales[i, 1]
+    return _fp8_dot_fn(int(w_dim))(x, w, sx, sw)

@@ -83,9 +83,11 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, page_size,
                  num_pages, slots, max_pages_per_slot, dtype=None,
-                 table_pad=0, prefix_pages=0):
+                 table_pad=0, prefix_pages=0, kv_quant=""):
         import jax.numpy as jnp
         import numpy as np
+
+        from .. import quantize as _quantize
 
         if min(num_layers, num_heads, head_dim, page_size, num_pages,
                slots, max_pages_per_slot) < 1:
@@ -112,11 +114,26 @@ class PagedKVCache:
         # size is the real bound), > 0 caps retained pages LRU-first
         self.prefix_pages = int(prefix_pages)
         self.trash_page = self.num_pages  # reserved last pool row
-        dtype = dtype or jnp.float32
+        # quantized pages: pools store 1-byte int8/e4m3 codes and a
+        # parallel (L, pages + 1, page_size) float32 scale pool holds
+        # one scale per (layer, token) row — indexed by the SAME
+        # (page, offset) the codes are, so the page tables, COW, and
+        # preempt/resume machinery never know quantization exists
+        self.kv_quant = _quantize.quant_mode(kv_quant)
+        if self.kv_quant:
+            dtype = jnp.dtype(_quantize.quant_dtype(self.kv_quant))
+        else:
+            dtype = dtype or jnp.float32
         pool_shape = (self.num_layers, self.num_pages + 1, self.page_size,
                       self.num_heads, self.head_dim)
         self.k_pool = jnp.zeros(pool_shape, dtype)
         self.v_pool = jnp.zeros(pool_shape, dtype)
+        if self.kv_quant:
+            scale_shape = pool_shape[:3]
+            self.k_scale = jnp.ones(scale_shape, jnp.float32)
+            self.v_scale = jnp.ones(scale_shape, jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
         # min-heaps: heappop yields the lowest free id, preserving the
         # deterministic lowest-first reuse contract (a sorted range is
         # already a valid heap)
@@ -420,6 +437,11 @@ class PagedKVCache:
             # one and the stream stays exact
             self.k_pool = self.k_pool.at[:, new].set(self.k_pool[:, page])
             self.v_pool = self.v_pool.at[:, new].set(self.v_pool[:, page])
+            if self.kv_quant:  # scale rows travel with their codes
+                self.k_scale = self.k_scale.at[:, new].set(
+                    self.k_scale[:, page])
+                self.v_scale = self.v_scale.at[:, new].set(
+                    self.v_scale[:, page])
             self._refcount[new] = 1
             pages[idx] = new
             self._tables[slot, idx] = new
@@ -495,9 +517,29 @@ class PagedKVCache:
 
     # -- accounting -------------------------------------------------------
     def pool_bytes(self):
-        """Total device bytes held by the two pools — constant for the
-        session's lifetime, which IS the O(1) decode-memory story."""
-        return int(self.k_pool.nbytes) + int(self.v_pool.nbytes)
+        """Total device bytes held by the pools (scale pools included
+        for quantized caches) — constant for the session's lifetime,
+        which IS the O(1) decode-memory story."""
+        total = int(self.k_pool.nbytes) + int(self.v_pool.nbytes)
+        if self.kv_quant:
+            total += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return total
+
+    @classmethod
+    def page_bytes(cls, num_layers, num_heads, head_dim, page_size,
+                   kv_quant=""):
+        """Device bytes ONE page costs (k + v codes, plus scale rows
+        for quantized caches) — what the capacity-at-fixed-bytes A/B in
+        bench_serve.py divides a pool budget by."""
+        import numpy as np
+
+        from .. import quantize as _quantize
+
+        mode = _quantize.quant_mode(kv_quant)
+        itemsize = (np.dtype(_quantize.quant_dtype(mode)).itemsize
+                    if mode else 4)
+        per_row = num_heads * head_dim * itemsize + (4 if mode else 0)
+        return 2 * num_layers * page_size * per_row
 
     def utilization(self):
         used = self.num_pages - len(self._free_pages)

@@ -181,10 +181,50 @@ def _attn_heads(x, n, t, h, d):
     return x.reshape(n, t, h, d).transpose(0, 2, 1, 3)
 
 
-def _block_attention(params, i, x, cfg, exact, block):
+def _kv_append(pool, scale_pool, i, pages, offsets, rows, kv_quant):
+    """Scatter a batch of KV rows into layer ``i`` of the page pool.
+
+    ``rows`` is (N, H, D) — one row per token.  With ``kv_quant`` each
+    row quantizes independently (codes into the storage pool, one
+    float32 scale per row into the parallel scale pool), so a page's
+    bytes are a pure function of the tokens written to it — the property
+    that keeps prefill scatter, serial decode append, batched verify
+    append, prefix-hit replay and preempt/re-prefill byte-identical.
+    """
+    if kv_quant:
+        from .. import quantize as _q
+
+        codes, scales = _q.kv_quantize_rows(rows, kv_quant)
+        pool = pool.at[i, pages, offsets].set(codes)
+        scale_pool = scale_pool.at[i, pages, offsets].set(scales)
+        return pool, scale_pool
+    return pool.at[i, pages, offsets].set(rows.astype(pool.dtype)), scale_pool
+
+
+def _kv_fake_quant(k, v, kv_quant):
+    """Reference-side half of the per-precision bit-exactness oracle:
+    quantize-dequantize the (n, H, T, D) head tensors per token with the
+    exact helper the paged path scatters with, so a full-context forward
+    sees the same dequantized KV VALUES the paged kernels reconstruct
+    in-block (the dequant is elementwise, hence order-independent)."""
+    if not kv_quant:
+        return k, v
+    from .. import quantize as _q
+
+    def _fq(t):
+        rows = t.transpose(0, 2, 1, 3)          # (n, T, H, D): per-token rows
+        q, s = _q.kv_quantize_rows(rows, kv_quant)
+        return _q.kv_dequantize(q, s).transpose(0, 2, 1, 3)
+
+    return _fq(k), _fq(v)
+
+
+def _block_attention(params, i, x, cfg, exact, block, kv_quant=""):
     """One pre-norm attention sublayer on (n, T, C); returns the
     residual-added activations plus this layer's (k, v) heads —
-    (n, H, T, D) each, the page-writable prefill byproduct."""
+    (n, H, T, D) each, the page-writable prefill byproduct.  With
+    ``kv_quant`` the keys/values are fake-quantized per token before
+    attention, mirroring what a paged reader reconstructs."""
     n, t, c = x.shape
     h, d = cfg.num_heads, cfg.head_dim
     hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
@@ -196,6 +236,7 @@ def _block_attention(params, i, x, cfg, exact, block):
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_attn_heads(q, n, t, h, d), _attn_heads(k, n, t, h, d),
                _attn_heads(v, n, t, h, d))
+    k, v = _kv_fake_quant(k, v, kv_quant)
     ctx = flash_attention(q, k, v, causal=True, block=block, mi=exact)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
     out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
@@ -217,7 +258,7 @@ def _block_mlp(params, i, x, exact):
 
 
 def full_forward(params, tokens, cfg, exact=None, block=None,
-                 return_kv=False):
+                 return_kv=False, kv_quant=""):
     """Full-context forward: (n, T) int tokens -> (n, T, V) logits.
 
     The O(T²)-work reference every serve-path output is checked against,
@@ -238,7 +279,8 @@ def full_forward(params, tokens, cfg, exact=None, block=None,
     x = x + params["pos_embed"][:, :t]
     kvs = []
     for i in range(cfg.num_layers):
-        x, kv = _block_attention(params, i, x, cfg, exact, block)
+        x, kv = _block_attention(params, i, x, cfg, exact, block,
+                                 kv_quant=kv_quant)
         kvs.append(kv)
         x = _block_mlp(params, i, x, exact)
     x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
@@ -250,7 +292,8 @@ def full_forward(params, tokens, cfg, exact=None, block=None,
 
 
 def prefill_forward(params, tokens, length, offset, table_row, k_pool,
-                    v_pool, cfg, page_size, exact=None):
+                    v_pool, cfg, page_size, exact=None, k_scale=None,
+                    v_scale=None, kv_quant=""):
     """Bucketed prefill over one suffix chunk: write the chunk's KV into
     the slot's pages and attend each row over everything at or before
     its absolute position — including KV the slot did NOT compute this
@@ -314,17 +357,22 @@ def prefill_forward(params, tokens, length, offset, table_row, k_pool,
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # append the chunk's KV at its absolute rows (one vectorized
         # scatter; only trash rows can collide, and nothing reads them)
-        k_pool = k_pool.at[i, pages, offsets].set(
-            k.reshape(t_b, h, d).astype(k_pool.dtype))
-        v_pool = v_pool.at[i, pages, offsets].set(
-            v.reshape(t_b, h, d).astype(v_pool.dtype))
+        k_pool, k_scale = _kv_append(k_pool, k_scale, i, pages, offsets,
+                                     k.reshape(t_b, h, d), kv_quant)
+        v_pool, v_scale = _kv_append(v_pool, v_scale, i, pages, offsets,
+                                     v.reshape(t_b, h, d), kv_quant)
         ctx_k = k_pool[i][table_row].reshape(1, max_pages * page_size,
                                              h, d).transpose(0, 2, 1, 3)
         ctx_v = v_pool[i][table_row].reshape(1, max_pages * page_size,
                                              h, d).transpose(0, 2, 1, 3)
+        ks = vs = None
+        if kv_quant:
+            ks = k_scale[i][table_row].reshape(1, max_pages * page_size)
+            vs = v_scale[i][table_row].reshape(1, max_pages * page_size)
         att = decode_attention(
             q.reshape(1, t_b, h, d).transpose(0, 2, 1, 3),
-            ctx_k, ctx_v, row_valid, block=page_size, mi=exact)
+            ctx_k, ctx_v, row_valid, block=page_size, mi=exact,
+            k_scale=ks, v_scale=vs)
         ctx = att.transpose(0, 2, 1, 3).reshape(1, t_b, cfg.d_model)
         out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
             + params["blk%d_attn_out_bias" % i]
@@ -335,11 +383,14 @@ def prefill_forward(params, tokens, length, offset, table_row, k_pool,
         + params["lm_head_bias"]
     last = jnp.take(logits[0], length - 1, axis=0)
     first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    if kv_quant:
+        return first_token, last, k_pool, v_pool, k_scale, v_scale
     return first_token, last, k_pool, v_pool
 
 
 def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
-                page_size, exact=None):
+                page_size, exact=None, k_scale=None, v_scale=None,
+                kv_quant=""):
     """One continuous-batching decode step for every slot at once.
 
     tokens: (S,) int32 — each slot's previous output token; lengths:
@@ -378,18 +429,23 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # append this token's KV at (page, offset); inactive slots write
         # the trash page (their table rows are all-trash)
-        k_pool = k_pool.at[i, page, offset].set(
-            k.reshape(s, h, d).astype(k_pool.dtype))
-        v_pool = v_pool.at[i, page, offset].set(
-            v.reshape(s, h, d).astype(v_pool.dtype))
+        k_pool, k_scale = _kv_append(k_pool, k_scale, i, page, offset,
+                                     k.reshape(s, h, d), kv_quant)
+        v_pool, v_scale = _kv_append(v_pool, v_scale, i, page, offset,
+                                     v.reshape(s, h, d), kv_quant)
         # gather the slot's full page set: (S, P, page, H, D) ->
         # (S, H, P*page, D)
         ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
         ctx_v = v_pool[i][tables].reshape(s, max_pages * page_size, h, d)
         ctx_k = ctx_k.transpose(0, 2, 1, 3)
         ctx_v = ctx_v.transpose(0, 2, 1, 3)
+        ks = vs = None
+        if kv_quant:
+            ks = k_scale[i][tables].reshape(s, max_pages * page_size)
+            vs = v_scale[i][tables].reshape(s, max_pages * page_size)
         att = decode_attention(q.reshape(s, h, 1, d), ctx_k, ctx_v,
-                               lengths + 1, block=page_size, mi=exact)
+                               lengths + 1, block=page_size, mi=exact,
+                               k_scale=ks, v_scale=vs)
         ctx = att.transpose(0, 2, 1, 3).reshape(s, cfg.d_model)
         out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
             + params["blk%d_attn_out_bias" % i]
@@ -399,11 +455,14 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     logits = _mm(x, params["lm_head_weight"], exact) \
         + params["lm_head_bias"]
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if kv_quant:
+        return next_tokens, logits, k_pool, v_pool, k_scale, v_scale
     return next_tokens, logits, k_pool, v_pool
 
 
 def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
-                page_size, exact=None):
+                page_size, exact=None, k_scale=None, v_scale=None,
+                kv_quant=""):
     """Speculative-decoding verify: advance every slot ``W = K + 1``
     teacher-forced positions in ONE fixed-shape step.
 
@@ -460,17 +519,21 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
         # j only ever reads rows <= j of this very step plus committed
         # context, so write-then-attend reproduces the serial interleave
         for j in range(w):
-            k_pool = k_pool.at[i, pages[:, j], offsets[:, j]].set(
-                k[:, j].astype(k_pool.dtype))
-            v_pool = v_pool.at[i, pages[:, j], offsets[:, j]].set(
-                v[:, j].astype(v_pool.dtype))
+            k_pool, k_scale = _kv_append(k_pool, k_scale, i, pages[:, j],
+                                         offsets[:, j], k[:, j], kv_quant)
+            v_pool, v_scale = _kv_append(v_pool, v_scale, i, pages[:, j],
+                                         offsets[:, j], v[:, j], kv_quant)
         ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
         ctx_v = v_pool[i][tables].reshape(s, max_pages * page_size, h, d)
         ctx_k = ctx_k.transpose(0, 2, 1, 3)
         ctx_v = ctx_v.transpose(0, 2, 1, 3)
+        ks = vs = None
+        if kv_quant:
+            ks = k_scale[i][tables].reshape(s, max_pages * page_size)
+            vs = v_scale[i][tables].reshape(s, max_pages * page_size)
         att = decode_attention(q.reshape(s, w, h, d).transpose(0, 2, 1, 3),
                                ctx_k, ctx_v, row_valid, block=page_size,
-                               mi=exact)
+                               mi=exact, k_scale=ks, v_scale=vs)
         ctx = att.transpose(0, 2, 1, 3).reshape(s, w, cfg.d_model)
         out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
             + params["blk%d_attn_out_bias" % i]
@@ -480,11 +543,14 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     logits = _mm(x, params["lm_head_weight"], exact) \
         + params["lm_head_bias"]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if kv_quant:
+        return greedy, logits, k_pool, v_pool, k_scale, v_scale
     return greedy, logits, k_pool, v_pool
 
 
 def draft_propose(params, tokens, n_feed, lengths, tables, k_pool, v_pool,
-                  cfg, page_size, exact=None):
+                  cfg, page_size, exact=None, k_scale=None, v_scale=None,
+                  kv_quant=""):
     """Draft-model K+1-step scan: one dispatch that both *ingests*
     committed tokens and *proposes* speculative continuations.
 
@@ -509,38 +575,54 @@ def draft_propose(params, tokens, n_feed, lengths, tables, k_pool, v_pool,
     params = _resolve_params(params)
 
     def body(carry, xs):
-        prev, kp, vp = carry
+        prev, kp, vp, ks, vs = carry
         teach, j = xs
         tok = jnp.where(j < n_feed, teach, prev)
-        nxt, _, kp, vp = decode_step(params, tok, lengths + j, tables,
-                                     kp, vp, cfg, page_size, exact=exact)
-        return (nxt, kp, vp), nxt
+        out = decode_step(params, tok, lengths + j, tables, kp, vp, cfg,
+                          page_size, exact=exact, k_scale=ks, v_scale=vs,
+                          kv_quant=kv_quant)
+        if kv_quant:
+            nxt, _, kp, vp, ks, vs = out
+        else:
+            nxt, _, kp, vp = out
+        return (nxt, kp, vp, ks, vs), nxt
 
     w = tokens.shape[1]
     xs = (tokens.T, jnp.arange(w, dtype=lengths.dtype))
-    (_, k_pool, v_pool), outs = lax.scan(
-        body, (tokens[:, 0].astype(jnp.int32), k_pool, v_pool), xs)
+    carry0 = (tokens[:, 0].astype(jnp.int32), k_pool, v_pool,
+              k_scale, v_scale)
+    (_, k_pool, v_pool, k_scale, v_scale), outs = lax.scan(body, carry0, xs)
+    if kv_quant:
+        return outs.T, k_pool, v_pool, k_scale, v_scale
     return outs.T, k_pool, v_pool
 
 
 @functools.lru_cache(maxsize=None)
-def _reference_fn(cfg, page_size, exact):
+def _reference_fn(cfg, page_size, exact, kv_quant=""):
     import jax
 
     def fwd(params, tokens):
         return full_forward(params, tokens, cfg, exact=exact,
-                            block=page_size)
+                            block=page_size, kv_quant=kv_quant)
 
     return jax.jit(fwd)
 
 
-def reference_last_logits(params, seq, cfg, page_size, exact=None):
+def reference_last_logits(params, seq, cfg, page_size, exact=None,
+                          kv_quant=""):
     """Bit-exactness oracle for the serving path: full-context forward
     over ``seq`` padded to the next ``page_size`` multiple (the same
     attention-block geometry the prefill/decode executables run), logits
     at the last *real* position.  Jitted and cached per padded shape —
-    eager dispatch fuses differently and is NOT bit-comparable."""
+    eager dispatch fuses differently and is NOT bit-comparable.
+
+    ``kv_quant`` pins the oracle to a KV precision: the reference
+    fake-quantizes each token's K/V row with the same helper the paged
+    path scatters with, so it certifies the quantized serving path
+    bit-exactly *at that precision* (PR 13's per-precision pattern)."""
     import jax.numpy as jnp
+
+    from ..quantize import quant_mode
 
     exact = exact_mode() if exact is None else bool(exact)
     seq = [int(t) for t in seq]
@@ -548,5 +630,6 @@ def reference_last_logits(params, seq, cfg, page_size, exact=None):
         raise MXNetError("reference_last_logits: empty sequence")
     pad = (-len(seq)) % int(page_size)
     toks = jnp.asarray([seq + [0] * pad], jnp.int32)
-    logits = _reference_fn(cfg, int(page_size), exact)(params, toks)
+    logits = _reference_fn(cfg, int(page_size), exact,
+                           quant_mode(kv_quant))(params, toks)
     return logits[0, len(seq) - 1]

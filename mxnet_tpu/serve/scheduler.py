@@ -127,6 +127,23 @@ class Scheduler(object):
             self._fail(req, slot, exc)
             return False
 
+    def _prefill(self, req, slot, seq):
+        """Run one request's prefill with the same isolation as a
+        boundary crossing: a session-raised fault (e.g. the ``kv_quant``
+        chaos site, which fires before any of the request's quantized
+        pages/scales are written) fails THAT request, releases its slot,
+        and the run continues.  Returns the first token, or None when
+        the request failed."""
+        try:
+            first, _ = self.session.prefill(slot, seq)
+            return first
+        except faults.WorkerKilled as exc:
+            self._fail(req, slot, exc)
+            return None
+        except MXNetError as exc:
+            self._fail(req, slot, exc)
+            return None
+
     def _fail(self, req, slot, exc):
         req.failed = True
         req.error = "%s: %s" % (type(exc).__name__, exc)
@@ -181,7 +198,9 @@ class Scheduler(object):
                             "request's worst case" % req.rid)
                     break
                 parked.remove(req)
-                first, _ = sess.prefill(slot, seq)
+                first = self._prefill(req, slot, seq)
+                if first is None:
+                    continue
                 if first != req.tokens[-1]:
                     raise MXNetError(
                         "resume replay diverged for request %d: "
@@ -215,7 +234,9 @@ class Scheduler(object):
                 if slot is None:
                     break  # pool full: stays queued for a later boundary
                 pending.remove(req)
-                first, _ = sess.prefill(slot, req.prompt)
+                first = self._prefill(req, slot, req.prompt)
+                if first is None:
+                    continue
                 req.ttft_s = now() - req.arrival_s
                 req.tokens.append(first)
                 active[slot] = req

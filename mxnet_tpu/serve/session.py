@@ -49,6 +49,20 @@ speculative decoding composes unchanged).  Quantized and full-precision
 sessions never alias recompile guards: the guard prefix grows a
 ``-q<mode>`` tag.
 
+KV-cache page quantization (``ServeConfig.kv_quant`` /
+``MXNET_SERVE_KV_QUANT``, ``int8`` or ``e4m3``/``fp8``): the paged KV
+pools store 1-byte codes with one float32 scale per (layer, page, row)
+position kept in parallel scale pools.  Every executable quantizes on
+append (a token's codes+scale are a pure function of that token's K/V
+values, so prefill scatter, serial decode, batched verify, prefix-hit
+replay and preempt/re-prefill stay byte-identical) and dequantizes
+inside the attention kernel's block scan, where XLA fuses the convert.
+Pool bytes shrink ~4x — slot capacity at fixed pool bytes multiplies on
+top of oversubscription — the executable count stays frozen, and the
+bit-exactness oracle re-pins per precision
+(``reference_last_logits(..., kv_quant=...)`` fake-quantizes its
+reference KV the same way).  Guard prefixes grow a ``-kv<mode>`` tag.
+
 Prefix caching (``prefix_pages`` / ``MXNET_SERVE_PREFIX_PAGES``): after
 every prefill the slot's full prompt pages are published into the KV
 cache's token-hash index; a later admission whose prompt chain hits the
@@ -71,7 +85,8 @@ Env knobs (see docs/env_vars.md): ``MXNET_SERVE_SLOTS``,
 ``MXNET_SERVE_PAGE``, ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_NEW``,
 ``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``, ``MXNET_SERVE_SPEC_K``,
 ``MXNET_SERVE_DRAFT``, ``MXNET_SERVE_QUANT``,
-``MXNET_SERVE_PREFIX_PAGES``, ``MXNET_SERVE_OVERSUB``,
+``MXNET_SERVE_KV_QUANT``, ``MXNET_SERVE_PREFIX_PAGES``,
+``MXNET_SERVE_OVERSUB``,
 ``MXNET_SERVE_WATERMARK``, ``MXNET_SERVE_TTFT_SLO_MS``.
 """
 from __future__ import annotations
@@ -122,6 +137,7 @@ class ServeConfig:
     spec_k: int = 0  # 0 = speculative decoding off
     draft: str = ""  # "", "ngram", "layers:N", or a checkpoint dir
     quant: str = ""  # "", "int8", or "fp8" weight-only quantization
+    kv_quant: str = ""  # "", "int8", or "fp8" KV-cache page quantization
     prefix_pages: int = 0  # 0 = prefix cache off; -1 = unbounded retention
     oversub: bool = False  # admit by current need, grow on demand
     watermark: int = 0  # free-pool floor that triggers preemption
@@ -140,6 +156,7 @@ class ServeConfig:
             spec_k=get_env("MXNET_SERVE_SPEC_K", 0, int),
             draft=get_env("MXNET_SERVE_DRAFT", "", str),
             quant=get_env("MXNET_SERVE_QUANT", "", str),
+            kv_quant=get_env("MXNET_SERVE_KV_QUANT", "", str),
             prefix_pages=get_env("MXNET_SERVE_PREFIX_PAGES", 0, int),
             oversub=get_env("MXNET_SERVE_OVERSUB", False, bool),
             watermark=get_env("MXNET_SERVE_WATERMARK", 0, int),
@@ -151,6 +168,7 @@ class ServeConfig:
     def __post_init__(self):
         object.__setattr__(self, "buckets", _parse_buckets(self.buckets))
         object.__setattr__(self, "quant", quant_mode(self.quant))
+        object.__setattr__(self, "kv_quant", quant_mode(self.kv_quant))
         if self.slots < 1 or self.page_size < 1 or self.max_new < 1:
             raise MXNetError("ServeConfig: slots/page_size/max_new must "
                              "be >= 1")
@@ -266,7 +284,8 @@ class InferenceSession(object):
             slots=cfg.slots,
             max_pages_per_slot=cfg.max_pages_per_slot,
             table_pad=cfg.spec_pad_pages,
-            prefix_pages=cfg.prefix_pages)
+            prefix_pages=cfg.prefix_pages,
+            kv_quant=cfg.kv_quant)
         self._slot_tokens = {}  # slot -> next token to feed the decoder
         self._slot_history = {}  # slot -> prompt + committed tokens
         self._spec_stats = {"verify_steps": 0, "slot_steps": 0,
@@ -301,6 +320,10 @@ class InferenceSession(object):
             # quantized avals differ from full-precision ones, so the
             # sessions must never share a guard fingerprint
             self._guard_prefix += "-q%s" % cfg.quant
+        if cfg.kv_quant:
+            # quantized KV pools change every executable's pool avals
+            # (storage dtype + parallel scale arrays)
+            self._guard_prefix += "-kv%s" % cfg.kv_quant
         self._compile_all()
 
     def _resolve_draft(self, draft_params, draft_num_heads):
@@ -367,7 +390,8 @@ class InferenceSession(object):
             slots=cfg.slots,
             max_pages_per_slot=cfg.max_pages_per_slot,
             table_pad=cfg.spec_pad_pages,
-            prefix_pages=cfg.prefix_pages)
+            prefix_pages=cfg.prefix_pages,
+            kv_quant=cfg.kv_quant)
 
     # -- compilation ------------------------------------------------------
     def _aot(self, name, fn, avals, donate_argnums):
@@ -422,79 +446,106 @@ class InferenceSession(object):
         model = self.model
         exact = bool(cfg.exact)
         psize = cfg.page_size
-        f32 = jax.numpy.float32
+        kvq = cfg.kv_quant
         i32 = jax.numpy.int32
         sds = jax.ShapeDtypeStruct
         # tree.map sees through quantized {"q", "s"} records, so the
         # executables' arguments are the 1-byte codes themselves
         param_avals = jax.tree.map(lambda v: sds(v.shape, v.dtype),
                                    self.params)
-        pool_shape = self.cache.k_pool.shape
-        pool_aval = sds(pool_shape, f32)
+        # pool avals follow the cache's storage dtype (float32 clean,
+        # 1-byte codes under kv_quant); quantized sessions additionally
+        # pass the parallel per-row scale pools, appended LAST so the
+        # clean-path signatures are untouched
+        pool_aval = sds(self.cache.k_pool.shape, self.cache.k_pool.dtype)
+
+        def scale_avals(cache):
+            if not kvq:
+                return ()
+            a = sds(cache.k_scale.shape, cache.k_scale.dtype)
+            return (a, a)
+
+        extra = scale_avals(self.cache)
         # table width includes the speculative all-trash pad columns
         # (zero when spec_k == 0, so non-spec avals are unchanged)
         max_pages = self.cache.table_width
 
-        def decode_fn(params, tokens, lengths, tables, k_pool, v_pool):
+        def decode_fn(params, tokens, lengths, tables, k_pool, v_pool,
+                      *scales):
             return decode_step(params, tokens, lengths, tables, k_pool,
-                               v_pool, model, psize, exact=exact)
+                               v_pool, model, psize, exact=exact,
+                               k_scale=scales[0] if kvq else None,
+                               v_scale=scales[1] if kvq else None,
+                               kv_quant=kvq)
 
         self._aot(
             "decode", decode_fn,
             (param_avals, sds((cfg.slots,), i32), sds((cfg.slots,), i32),
-             sds((cfg.slots, max_pages), i32), pool_aval, pool_aval),
-            donate_argnums=(4, 5))
+             sds((cfg.slots, max_pages), i32), pool_aval, pool_aval)
+            + extra,
+            donate_argnums=(4, 5) + ((6, 7) if kvq else ()))
 
         for bucket in cfg.buckets:
             def prefill_fn(params, tokens, length, offset, table_row,
-                           k_pool, v_pool):
+                           k_pool, v_pool, *scales):
                 return prefill_forward(params, tokens, length, offset,
                                        table_row, k_pool, v_pool, model,
-                                       psize, exact=exact)
+                                       psize, exact=exact,
+                                       k_scale=scales[0] if kvq else None,
+                                       v_scale=scales[1] if kvq else None,
+                                       kv_quant=kvq)
 
             self._aot(
                 "prefill_%d" % bucket, prefill_fn,
                 (param_avals, sds((1, bucket), i32), sds((), i32),
                  sds((), i32), sds((max_pages,), i32), pool_aval,
-                 pool_aval),
-                donate_argnums=(5, 6))
+                 pool_aval) + extra,
+                donate_argnums=(5, 6) + ((7, 8) if kvq else ()))
 
         if cfg.spec_k:
             w = cfg.spec_window
 
             def verify_fn(params, tokens, lengths, tables, k_pool,
-                          v_pool):
+                          v_pool, *scales):
                 return verify_step(params, tokens, lengths, tables,
                                    k_pool, v_pool, model, psize,
-                                   exact=exact)
+                                   exact=exact,
+                                   k_scale=scales[0] if kvq else None,
+                                   v_scale=scales[1] if kvq else None,
+                                   kv_quant=kvq)
 
             self._aot(
                 "verify", verify_fn,
                 (param_avals, sds((cfg.slots, w), i32),
                  sds((cfg.slots,), i32), sds((cfg.slots, max_pages), i32),
-                 pool_aval, pool_aval),
-                donate_argnums=(4, 5))
+                 pool_aval, pool_aval) + extra,
+                donate_argnums=(4, 5) + ((6, 7) if kvq else ()))
 
         if self._draft_mode == "model":
             w = cfg.spec_window
             dmodel = self.draft_model
             draft_avals = jax.tree.map(lambda v: sds(v.shape, v.dtype),
                                        self.draft_params)
-            dpool_aval = sds(self.draft_cache.k_pool.shape, f32)
+            dpool_aval = sds(self.draft_cache.k_pool.shape,
+                             self.draft_cache.k_pool.dtype)
+            dextra = scale_avals(self.draft_cache)
 
             def draft_fn(params, tokens, n_feed, lengths, tables, k_pool,
-                         v_pool):
+                         v_pool, *scales):
                 return draft_propose(params, tokens, n_feed, lengths,
                                      tables, k_pool, v_pool, dmodel,
-                                     psize, exact=exact)
+                                     psize, exact=exact,
+                                     k_scale=scales[0] if kvq else None,
+                                     v_scale=scales[1] if kvq else None,
+                                     kv_quant=kvq)
 
             self._aot(
                 "draft", draft_fn,
                 (draft_avals, sds((cfg.slots, w), i32),
                  sds((cfg.slots,), i32), sds((cfg.slots,), i32),
                  sds((cfg.slots, max_pages), i32), dpool_aval,
-                 dpool_aval),
-                donate_argnums=(5, 6))
+                 dpool_aval) + dextra,
+                donate_argnums=(5, 6) + ((7, 8) if kvq else ()))
 
     @classmethod
     def from_checkpoint(cls, directory, prefix="model", epoch=None,
@@ -530,6 +581,20 @@ class InferenceSession(object):
             # falls back to the lazy jit rather than failing the request.
             rec.fallbacks += 1
             return rec.jitted(*args)
+
+    def _pool_args(self, cache):
+        """The pool arguments a dispatch appends: (k, v) pools, plus the
+        per-row scale pools under ``kv_quant``."""
+        if self.config.kv_quant:
+            return (cache.k_pool, cache.v_pool, cache.k_scale,
+                    cache.v_scale)
+        return (cache.k_pool, cache.v_pool)
+
+    def _store_pools(self, cache, pools):
+        """Re-adopt the (donated) pool outputs of a dispatch."""
+        cache.k_pool, cache.v_pool = pools[0], pools[1]
+        if self.config.kv_quant:
+            cache.k_scale, cache.v_scale = pools[2], pools[3]
 
     # -- request lifecycle ------------------------------------------------
     def bucket_for(self, prompt_len):
@@ -618,6 +683,13 @@ class InferenceSession(object):
         if not 0 <= cached < p:
             raise MXNetError("prefill: cached prefix %d outside prompt "
                              "of %d tokens" % (cached, p))
+        if self.config.kv_quant:
+            # chaos site: a fault here fails THIS request before any of
+            # its quantized pages/scales are written, so survivors'
+            # pages and scale rows stay consistent
+            from ..testing import faults
+
+            faults.inject("kv_quant")
         first = last_logits = None
         off = cached
         while off < p:
@@ -629,12 +701,10 @@ class InferenceSession(object):
             args = (self.params, jnp.asarray(toks),
                     jnp.asarray(n, jnp.int32),
                     jnp.asarray(off, jnp.int32),
-                    self.cache.table_row(slot), self.cache.k_pool,
-                    self.cache.v_pool)
-            first, last_logits, k_pool, v_pool = self._dispatch(
-                "prefill_%d" % bucket, args)
-            self.cache.k_pool = k_pool
-            self.cache.v_pool = v_pool
+                    self.cache.table_row(slot)) + self._pool_args(self.cache)
+            out = self._dispatch("prefill_%d" % bucket, args)
+            first, last_logits = out[0], out[1]
+            self._store_pools(self.cache, out[2:])
             off += n
             self.cache.lengths[slot] = off
         first = int(first)
@@ -676,11 +746,10 @@ class InferenceSession(object):
             n_feed[slot] = len(chunk)
             args = (self.draft_params, jnp.asarray(toks),
                     jnp.asarray(n_feed), self.draft_cache.device_lengths(),
-                    self.draft_cache.device_tables(),
-                    self.draft_cache.k_pool, self.draft_cache.v_pool)
-            _, dk_pool, dv_pool = self._dispatch("draft", args)
-            self.draft_cache.k_pool = dk_pool
-            self.draft_cache.v_pool = dv_pool
+                    self.draft_cache.device_tables()) \
+                + self._pool_args(self.draft_cache)
+            out = self._dispatch("draft", args)
+            self._store_pools(self.draft_cache, out[1:])
             self.draft_cache.lengths[slot] = off + len(chunk)
 
     def step(self):
@@ -697,11 +766,11 @@ class InferenceSession(object):
         for slot, tok in self._slot_tokens.items():
             tokens[slot] = tok
         args = (self.params, jnp.asarray(tokens),
-                self.cache.device_lengths(), self.cache.device_tables(),
-                self.cache.k_pool, self.cache.v_pool)
-        next_toks, logits, k_pool, v_pool = self._dispatch("decode", args)
-        self.cache.k_pool = k_pool
-        self.cache.v_pool = v_pool
+                self.cache.device_lengths(), self.cache.device_tables()) \
+            + self._pool_args(self.cache)
+        out = self._dispatch("decode", args)
+        next_toks, logits = out[0], out[1]
+        self._store_pools(self.cache, out[2:])
         next_np = np.asarray(next_toks)
         out = {}
         for slot in list(self._slot_tokens):
@@ -754,22 +823,20 @@ class InferenceSession(object):
             n_feed = np.ones((cfg.slots,), np.int32)
             args = (self.draft_params, jnp.asarray(dtoks),
                     jnp.asarray(n_feed), self.draft_cache.device_lengths(),
-                    self.draft_cache.device_tables(),
-                    self.draft_cache.k_pool, self.draft_cache.v_pool)
-            outs, dk_pool, dv_pool = self._dispatch("draft", args)
-            self.draft_cache.k_pool = dk_pool
-            self.draft_cache.v_pool = dv_pool
-            tokens[:, 1:] = np.asarray(outs)[:, :k]
+                    self.draft_cache.device_tables()) \
+                + self._pool_args(self.draft_cache)
+            res = self._dispatch("draft", args)
+            self._store_pools(self.draft_cache, res[1:])
+            tokens[:, 1:] = np.asarray(res[0])[:, :k]
         else:
             for slot in active:
                 tokens[slot, 1:] = self._ngram_propose(slot, k)
         args = (self.params, jnp.asarray(tokens),
-                self.cache.device_lengths(), self.cache.device_tables(),
-                self.cache.k_pool, self.cache.v_pool)
-        greedy, _, k_pool, v_pool = self._dispatch("verify", args)
-        self.cache.k_pool = k_pool
-        self.cache.v_pool = v_pool
-        greedy = np.asarray(greedy)
+                self.cache.device_lengths(), self.cache.device_tables()) \
+            + self._pool_args(self.cache)
+        res = self._dispatch("verify", args)
+        self._store_pools(self.cache, res[2:])
+        greedy = np.asarray(res[0])
         self._spec_stats["verify_steps"] += 1
         for slot in active:
             limit = w

@@ -133,6 +133,8 @@ SITES = {
                    "victim's pages are released",
     "serve_resume": "serving scheduler parked-request resume, before "
                     "the re-prefill",
+    "kv_quant": "quantized-KV prefill, before the request's pages/"
+                "scales are written",
     "data_decode": "inside each data-service decode task (worker "
                    "process, or inline with num_workers=0)",
     "data_service": "data-service consumer next()",

@@ -3,6 +3,13 @@ bounds per storage dtype, cross-process bit-stability, the ZeRO-3
 flat-tile interchange (topology-independent codes, gather-path
 dequantization, quantized elastic checkpoint restore), and quantized
 serving sessions with the per-precision bit-exactness contract.
+
+Also the fp8 TRAINING surface that module grew: delayed-scaling
+helpers (amax history, realized scales, the fp8_trace site registry),
+the custom-VJP fp8 matmul route through TrainStep (history rides the
+hstate like the dynamic loss scaler; MXNET_FP8 / MXNET_FP8_LAYERS
+gating), and int8/e4m3 quantized KV-cache pages in serving
+(per-precision oracle, spec-decode and prefix-cache composition).
 """
 import hashlib
 import os
@@ -410,3 +417,377 @@ def test_spec_decoding_composes_with_quant(params):
     assert spec_out == plain_out
     rep = spec.spec_report()
     assert rep["acceptance_rate"] == 1.0  # identity draft: all accepted
+
+
+# ---------------------------------------------------------------------------
+# fp8 training helpers: mode parsing, layer gating, delayed scaling
+# ---------------------------------------------------------------------------
+
+def test_fp8_mode_parsing_and_enabled(monkeypatch):
+    for raw, want in (("", "off"), ("off", "off"), ("0", "off"),
+                      ("no", "off"), ("on", "on"), ("1", "on"),
+                      ("TRUE", "on"), ("auto", "auto")):
+        monkeypatch.setenv("MXNET_FP8", raw)
+        assert quantize.fp8_mode() == want
+    monkeypatch.delenv("MXNET_FP8")
+    assert quantize.fp8_mode() == "off" and not quantize.fp8_enabled()
+    monkeypatch.setenv("MXNET_FP8", "on")
+    assert quantize.fp8_enabled()
+    monkeypatch.setenv("MXNET_FP8", "e4m3")
+    with pytest.raises(MXNetError):
+        quantize.fp8_mode()
+
+
+def test_fp8_layer_allowed(monkeypatch):
+    monkeypatch.delenv("MXNET_FP8_LAYERS", raising=False)
+    assert quantize.fp8_layer_allowed("blk0_attn")
+    assert quantize.fp8_layer_allowed(None)  # unnamed site, no spec
+    monkeypatch.setenv("MXNET_FP8_LAYERS", "blk, lm_head")
+    assert quantize.fp8_layer_allowed("blk1_ffn2")  # prefix match
+    assert quantize.fp8_layer_allowed("lm_head")    # exact match
+    assert not quantize.fp8_layer_allowed("embed")
+    assert not quantize.fp8_layer_allowed(None)  # unnamed, spec set
+
+
+def test_fp8_delayed_scaling_history():
+    hist = quantize.fp8_hist_init(2)
+    assert hist.shape == (2, 2, quantize.FP8_AMAX_HISTORY)
+    # empty history realizes unit scales: the safe first-step default
+    np.testing.assert_array_equal(
+        np.asarray(quantize.fp8_realize_scales(hist)),
+        np.ones((2, 2), np.float32))
+    new = np.array([[quantize.FP8_MAX, 2 * quantize.FP8_MAX],
+                    [7.0, 0.0]], np.float32)
+    hist = quantize.fp8_update_hist(hist, new)
+    s = np.asarray(quantize.fp8_realize_scales(hist))
+    assert s[0, 0] == pytest.approx(1.0)  # amax == FP8_MAX: unit scale
+    assert s[0, 1] == pytest.approx(2.0)  # 2x over range: scale doubles
+    assert s[1, 0] == pytest.approx(7.0 / quantize.FP8_MAX)
+    assert s[1, 1] == 1.0                 # operand never saw data
+    # the window really is a window: the spike falls out after HISTORY
+    for _ in range(quantize.FP8_AMAX_HISTORY):
+        hist = quantize.fp8_update_hist(hist,
+                                        np.zeros((2, 2), np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(quantize.fp8_realize_scales(hist)),
+        np.ones((2, 2), np.float32))
+
+
+def test_fp8_apply_dot_trace_contract():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 8), jnp.float32)
+    w = jnp.asarray(rs.randn(8, 5), jnp.float32)
+    # outside a trace the route declines and callers keep their path
+    assert not quantize.fp8_tracing()
+    assert quantize.fp8_apply_dot(x, w, label="fc") is None
+    with quantize.fp8_trace() as tr:
+        assert quantize.fp8_tracing()
+        out = quantize.fp8_apply_dot(x, w, label="fc", w_dim=0)
+        assert out is not None and out.shape == (4, 5)
+        # shape-ineligible operands decline inside the trace too
+        assert quantize.fp8_apply_dot(
+            x, jnp.zeros((3, 3), jnp.float32), w_dim=0) is None
+        assert tr.names == ["fc"] and len(tr.amax) == 1
+        assert tr.amax[0].shape == (2,)
+    assert not quantize.fp8_tracing()
+    # discovery scales are 1.0: output == the e4m3 fake-cast matmul
+    e4m3 = quantize.quant_dtype("fp8")
+    want = (np.asarray(x.astype(e4m3).astype(jnp.float32))
+            @ np.asarray(w.astype(e4m3).astype(jnp.float32)))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_fp8_apply_dot_respects_layer_optout(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_FP8_LAYERS", "fc1")
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((4, 3), jnp.float32)
+    with quantize.fp8_trace() as tr:
+        assert quantize.fp8_apply_dot(x, w, label="fc2",
+                                      w_dim=0) is None
+        assert quantize.fp8_apply_dot(x, w, label="fc1",
+                                      w_dim=0) is not None
+    assert tr.names == ["fc1"]  # opted-out sites never claim a slot
+
+
+def test_fp8_dot_grads_flow_scales_inert():
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(4, 8), jnp.float32)
+    w = jnp.asarray(rs.randn(8, 5), jnp.float32)
+
+    def loss(x, w):
+        with quantize.fp8_trace():
+            return jnp.sum(quantize.fp8_apply_dot(x, w, label="fc",
+                                                   w_dim=0) ** 2)
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+    assert gx.shape == x.shape and gw.shape == w.shape
+    assert np.isfinite(np.asarray(gx)).all()
+    assert np.isfinite(np.asarray(gw)).all()
+    # close to the full-precision analytic grads (e4m3 operands, e5m2
+    # cotangent: a few mantissa bits of rounding, nothing structural)
+    ref_gx, ref_gw = jax.grad(lambda x, w: jnp.sum((x @ w) ** 2),
+                              argnums=(0, 1))(x, w)
+    for got, ref in ((gx, ref_gx), (gw, ref_gw)):
+        err = np.max(np.abs(np.asarray(got) - np.asarray(ref)))
+        assert err <= 0.35 * float(np.max(np.abs(np.asarray(ref))))
+
+
+# ---------------------------------------------------------------------------
+# fp8 training through TrainStep: history rides hstate like the scaler
+# ---------------------------------------------------------------------------
+
+def _fp8_train_step(**kw):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.fused import TrainStep
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    kw.setdefault("optimizer_params", {"learning_rate": 0.1})
+    step = TrainStep(sym, optimizer="sgd", **kw)
+    params, aux, states = step.init_state(
+        {"data": (16, 8), "softmax_label": (16,)})
+    rng = jax.random.PRNGKey(0)
+    X = np.asarray(jax.random.normal(rng, (16, 8), "float32"))
+    batch = {"data": X,
+             "softmax_label": np.tile(np.arange(5.0, dtype="float32"),
+                                      4)[:16]}
+    return step, params, aux, states, batch, rng
+
+
+def _run_params(step, params, aux, states, batch, rng, n=5):
+    import jax
+
+    for _ in range(n):
+        params, aux, states, _ = step(params, aux, states, batch, rng)
+    return jax.tree.map(lambda v: np.asarray(jax.device_get(v)), params)
+
+
+def test_fp8_off_keeps_legacy_hstate_free_path(monkeypatch):
+    """MXNET_FP8=off is the clean path: no carried hstate (the jit
+    signature an fp8-free build compiles), and the trajectory is
+    deterministic."""
+    monkeypatch.setenv("MXNET_FP8", "off")
+    step, params, aux, states, batch, rng = _fp8_train_step()
+    assert not step._fp8 and not step._use_hstate
+    ref = _run_params(step, params, aux, states, batch, rng)
+    assert step._hstate is None  # nothing carried
+    step2, params2, aux2, states2, batch2, rng2 = _fp8_train_step()
+    again = _run_params(step2, params2, aux2, states2, batch2, rng2)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], again[k], err_msg=k)
+
+
+def test_fp8_on_trains_and_rolls_amax_history(monkeypatch):
+    """MXNET_FP8=on: both FC matmuls claim fp8 sites, the (sites, 2,
+    HISTORY) amax history advances every step, and the fp8 trajectory
+    lands near the full-precision one."""
+    monkeypatch.setenv("MXNET_FP8", "off")
+    step, params, aux, states, batch, rng = _fp8_train_step()
+    ref = _run_params(step, params, aux, states, batch, rng)
+
+    monkeypatch.setenv("MXNET_FP8", "on")
+    fstep, params, aux, states, batch, rng = _fp8_train_step()
+    assert fstep._fp8 and fstep._use_hstate
+    p0 = np.asarray(params["fc1_weight"]).copy()  # before donation
+    got = _run_params(fstep, params, aux, states, batch, rng)
+    assert fstep._fp8_sites == 2  # fc1 + fc2
+    hist = np.asarray(fstep._hstate["fp8_hist"])
+    assert hist.shape == (2, 2, quantize.FP8_AMAX_HISTORY)
+    assert (hist[:, :, :5] > 0).all()  # 5 steps: 5 fresh amax columns
+    assert (hist[:, :, 5:] == 0).all()  # older slots still virgin
+    for k in ref:
+        assert np.isfinite(got[k]).all(), k
+        drift = np.max(np.abs(got[k] - ref[k]))
+        assert drift <= 0.1, (k, drift)
+    assert not np.array_equal(got["fc1_weight"], p0)  # it really trained
+
+
+def test_fp8_layers_filters_sites(monkeypatch):
+    monkeypatch.setenv("MXNET_FP8", "on")
+    monkeypatch.setenv("MXNET_FP8_LAYERS", "fc1")
+    step, params, aux, states, batch, rng = _fp8_train_step()
+    got = _run_params(step, params, aux, states, batch, rng, n=2)
+    assert step._fp8_sites == 1  # fc2 opted out, never claims a slot
+    assert np.asarray(step._hstate["fp8_hist"]).shape == \
+        (1, 2, quantize.FP8_AMAX_HISTORY)
+    for k, v in got.items():
+        assert np.isfinite(v).all(), k
+
+
+def test_fp8_composes_with_scaler_and_scan(monkeypatch):
+    """fp8 history and the dynamic loss scaler share the one carried
+    hstate, and both survive the steps_per_call=K lax.scan: one call
+    advances the history K slots and the scale still grows."""
+    from mxnet_tpu.health import DynamicLossScaler, StepHealth
+
+    monkeypatch.setenv("MXNET_FP8", "on")
+    scaler = DynamicLossScaler(init_scale=8.0, growth=2.0,
+                               growth_interval=3, max_scale=64.0)
+    step, params, aux, states, batch, rng = _fp8_train_step(
+        health=StepHealth(scaler=scaler), steps_per_call=3)
+    kbatch = {k: np.stack([v] * 3) for k, v in batch.items()}
+    params, aux, states, _ = step(params, aux, states, kbatch, rng)
+    assert sorted(step._hstate) == ["fp8_hist", "good_steps",
+                                    "loss_scale"]
+    hist = np.asarray(step._hstate["fp8_hist"])
+    assert (hist[:, :, :3] > 0).all()  # K=3 inner steps, 3 slots
+    assert (hist[:, :, 3:] == 0).all()
+    assert step.loss_scale == 16.0  # 3 clean steps == one growth
+    for v in np.asarray(hist).ravel():
+        assert np.isfinite(v)
+
+
+# ---------------------------------------------------------------------------
+# quantized KV-cache pages: per-row codecs + serving composition
+# ---------------------------------------------------------------------------
+
+def test_kv_quantize_rows_roundtrip():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(9)
+    x = (rs.randn(5, 2, 4).astype(np.float32)
+         * np.logspace(-2, 2, 5).astype(np.float32)[:, None, None])
+    x[0] = 0.0  # all-zero row: unit scale, exact zeros back
+    q, scale = quantize.kv_quantize_rows(jnp.asarray(x), "int8")
+    scale = np.asarray(scale)
+    assert q.dtype == jnp.int8 and scale.shape == (5,)
+    assert scale[0] == 1.0
+    dq = np.asarray(quantize.kv_dequantize(q, jnp.asarray(scale)))
+    np.testing.assert_array_equal(dq[0], np.zeros((2, 4), np.float32))
+    # symmetric rounding: at most half a step per row
+    assert np.all(np.abs(x - dq) <= 0.5 * scale[:, None, None] + 1e-7)
+
+    qf, sf = quantize.kv_quantize_rows(jnp.asarray(x), "fp8")
+    assert qf.dtype == quantize.quant_dtype("fp8")
+    dqf = np.asarray(quantize.kv_dequantize(qf, sf))
+    sf = np.asarray(sf)
+    assert np.all(np.abs(x - dqf) <= np.abs(x) * 2.0 ** -4
+                  + sf[:, None, None] * 2.0 ** -9)
+    with pytest.raises(MXNetError):
+        quantize.kv_quantize_rows(jnp.asarray(x), "")
+
+
+def test_kv_quant_page_bytes_capacity_multiplier():
+    from mxnet_tpu.serve.kv_cache import PagedKVCache
+
+    f32 = PagedKVCache.page_bytes(CFG.num_layers, CFG.num_heads,
+                                  CFG.d_model // CFG.num_heads, PAGE)
+    for mode in ("int8", "fp8"):
+        q = PagedKVCache.page_bytes(CFG.num_layers, CFG.num_heads,
+                                    CFG.d_model // CFG.num_heads, PAGE,
+                                    kv_quant=mode)
+        # 1-byte codes + f32 per-row scales: >3x more tokens per byte
+        assert f32 / q >= 3.0
+
+
+def test_kv_quant_config_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_KV_QUANT", "e4m3")
+    assert serve.ServeConfig.from_env().kv_quant == "fp8"
+    monkeypatch.delenv("MXNET_SERVE_KV_QUANT")
+    assert serve.ServeConfig.from_env().kv_quant == ""
+    with pytest.raises(MXNetError):
+        serve.ServeConfig(kv_quant="int4")
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_kv_quant_session_bitexact_per_precision(params, mode,
+                                                 monkeypatch):
+    """Quantized KV pages keep the serving oracle: paged decode over
+    int8/e4m3 pages == the jitted full-context reference running the
+    SAME per-row fake quantization, the executable count stays frozen
+    under MXNET_RECOMPILE_ERROR=1, and the guard prefix carries the kv
+    tag so precisions never alias an f32 session's executables."""
+    monkeypatch.setenv("MXNET_RECOMPILE_ERROR", "1")
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=_sconf(kv_quant=mode))
+    assert "-kv%s" % mode in sess._guard_prefix
+
+    def ref_row(seq):
+        return np.asarray(serve_model.reference_last_logits(
+            sess.params, seq, CFG, PAGE, exact=True, kv_quant=mode))
+
+    probe = list(np.random.RandomState(6).randint(1, CFG.vocab_size,
+                                                  size=6))
+    slot = sess.try_alloc(len(probe), 6)
+    first, logits = sess.prefill(slot, probe)
+    np.testing.assert_array_equal(logits, ref_row(probe))
+    seq = list(probe) + [first]
+    for _ in range(5):
+        toks, step_logits = sess.step()
+        np.testing.assert_array_equal(step_logits[slot], ref_row(seq))
+        seq.append(toks[slot])
+    sess.release(slot)
+    assert len(sess.executables) == len(sess.config.buckets) + 1
+
+
+def test_spec_decoding_composes_with_kv_quant(params):
+    """Speculation over quantized KV pages cannot change any stream:
+    the verify step reads the same codes the serial decode writes, so
+    kv_quant+spec emits tokens identical to kv_quant-only decode."""
+    def reqs():
+        rs = np.random.RandomState(15)
+        return [serve.Request(
+            rid=i, prompt=rs.randint(1, CFG.vocab_size,
+                                     size=4 + i).tolist(),
+            max_new=8, arrival_s=0.0, eos_id=-1) for i in range(3)]
+
+    plain = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                   config=_sconf(kv_quant="int8"))
+    plain_out = {r.rid: list(r.tokens) for r in
+                 serve.Scheduler(plain, policy="continuous")
+                 .run(reqs())[0]}
+    spec = serve.InferenceSession(
+        params, num_heads=CFG.num_heads,
+        config=_sconf(kv_quant="int8", spec_k=3,
+                      draft="layers:%d" % CFG.num_layers))
+    spec_out = {r.rid: list(r.tokens) for r in
+                serve.Scheduler(spec, policy="continuous")
+                .run(reqs())[0]}
+    assert spec_out == plain_out
+    assert spec.spec_report()["acceptance_rate"] == 1.0
+
+
+def test_prefix_hit_bitexact_on_quantized_pages(params):
+    """A prefix hit that maps an already-quantized page prefills only
+    the suffix, and both streams stay bit-exact against the
+    per-precision reference — the mapped codes and scale rows ARE the
+    cold-miss ones, byte for byte."""
+    sess = serve.InferenceSession(
+        params, num_heads=CFG.num_heads,
+        config=_sconf(kv_quant="int8", prefix_pages=-1))
+
+    def ref_row(seq):
+        return np.asarray(serve_model.reference_last_logits(
+            sess.params, seq, CFG, PAGE, exact=True, kv_quant="int8"))
+
+    shared = [5, 9, 2, 11, 3, 7, 8, 4]  # one full page
+    p_cold = shared + [1, 6]
+    p_hit = shared + [2, 9, 14]
+    s_cold = sess.try_alloc(len(p_cold), 4, tokens=p_cold)
+    first_c, logits_c = sess.prefill(s_cold, p_cold)
+    s_hit = sess.try_alloc(len(p_hit), 4, tokens=p_hit)
+    assert sess.cache.cached_len(s_hit) == PAGE  # mapped, not recomputed
+    first_h, logits_h = sess.prefill(s_hit, p_hit)
+    np.testing.assert_array_equal(logits_c, ref_row(p_cold))
+    np.testing.assert_array_equal(logits_h, ref_row(p_hit))
+    seqs = {s_cold: p_cold + [first_c], s_hit: p_hit + [first_h]}
+    for _ in range(3):
+        toks, logits = sess.step()
+        for slot, seq in seqs.items():
+            np.testing.assert_array_equal(logits[slot], ref_row(seq))
+            seq.append(toks[slot])
+    sess.release(s_cold)
+    sess.release(s_hit)

@@ -312,6 +312,42 @@ def test_chaos_queue_fault_first_boundary(session, monkeypatch):
 
 
 @pytest.mark.chaos
+def test_chaos_kv_quant_fault_isolates_request(params, monkeypatch):
+    """A fault at the quantized-page append site fails only the request
+    whose prefill crossed it; the survivors' pages and scale rows stay
+    consistent — their token streams match a clean run of the same
+    precision, and the slot pool drains back to full."""
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "kv_quant:raise:after=2")
+    faults.reset()
+    sconf = serve.ServeConfig(slots=3, page_size=PAGE, buckets=(8, 16),
+                              max_new=8, exact=True, kv_quant="int8")
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    reqs = _trace(3, seed=21, max_new=4)
+    for r in reqs:
+        r.arrival_s = 0.0  # co-admitted: the 2nd prefill crossing fails
+    done, _ = serve.Scheduler(sess, policy="continuous").run(reqs)
+    failed = [r for r in done if r.failed]
+    ok = [r for r in done if not r.failed]
+    assert len(failed) == 1 and "FaultInjected" in failed[0].error
+    assert len(ok) == 2
+    assert all(len(r.tokens) == 4 for r in ok)
+    assert sess.cache.free_slots == sess.config.slots
+
+    # survivors' quantized pages/scales stayed coherent: same streams
+    # as a fault-free session at the same precision
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faults.reset()
+    clean = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                   config=sconf)
+    cdone, _ = serve.Scheduler(clean, policy="continuous").run(
+        _trace(3, seed=21, max_new=4))
+    want = {r.rid: list(r.tokens) for r in cdone}
+    for r in ok:
+        assert list(r.tokens) == want[r.rid]
+
+
+@pytest.mark.chaos
 def test_chaos_admit_delay_completes(session, monkeypatch):
     monkeypatch.setenv("MXNET_FAULT_INJECT",
                        "serve_admit:delay:seconds=0.02")

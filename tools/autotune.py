@@ -113,6 +113,7 @@ def search_serve(directory, budget):
         sconf = serve.ServeConfig(
             slots=8, page_size=16, max_new=16, exact=True,
             buckets=tuple(knobs["buckets"]), quant=knobs["quant"],
+            kv_quant=knobs.get("kv_quant", ""),
             prefix_pages=int(knobs["prefix_pages"]),
             oversub=True, watermark=int(knobs["watermark"]),
             num_pages=20)
@@ -147,6 +148,7 @@ def search_serve(directory, budget):
 
     space = [
         autotune.Knob("quant", ("", "int8", "fp8")),
+        autotune.Knob("kv_quant", ("", "int8", "fp8")),
         autotune.Knob("buckets", ((16, 32, 64), (16, 64), (64,))),
         autotune.Knob("prefix_pages", (0, -1, 8)),
         autotune.Knob("watermark", (0, 1, 4)),
@@ -238,6 +240,14 @@ def search_train(directory, budget, plan=None):
         autotune.Knob("attn_block", (128, 64, 32)),
         autotune.Knob("grad_bucket_mb", (4, 1)),
     ]
+    from mxnet_tpu import quantize as _quantize
+
+    if _quantize.fp8_enabled():
+        # which matmul sites keep the fp8 route (prefix match): every
+        # site, transformer blocks only (lm_head stays bf16), or blocks
+        # plus head — the drift/throughput trade
+        space.append(autotune.Knob(
+            "fp8_layers", ("", "blk", "blk,lm_head")))
     if plan_obj is not None and plan_obj.zero in ("on", "3", "auto"):
         # the forward/backward bucket schedule's granularity — only a
         # knob when the plan shards the update over the data axis

@@ -223,6 +223,58 @@ def expect_plan(payload, path):
     return True
 
 
+def expect_fp8(payload, path):
+    """fp8 lowering check for a ``--fp8`` dump.
+
+    The fp8 route emits each operand as a quantize-dequantize pair; XLA
+    must fold those into the surrounding fusions (into a real fp8
+    operand on native hardware).  A standalone ``convert`` among the
+    largest top-level producers is a pair that ESCAPED — a full
+    activation copy materialized per matmul operand — and a named
+    offender.  The temp-bytes watermark vs the bf16 baseline compiled
+    alongside (``baseline_memory``) is bounded at 1.25x on fp8-native
+    backends (TPU/GPU), where the saved matmul residuals really are
+    1-byte codes; on CPU the residuals stay f32 (fake-cast numerics
+    only), so the delta is reported but advisory there.  Returns True
+    on pass."""
+    if not payload.get("fp8"):
+        print("EXPECT-FP8 %s: FAIL (artifact was not dumped with --fp8; "
+              "nothing to audit)" % path)
+        return False
+    failures = []
+    offenders = ["%s (%s, %s)" % (p["name"], p["shape"],
+                                  _fmt_bytes(p["bytes"]))
+                 for p in payload.get("unfused_producers") or []
+                 if p["op"] == "convert"]
+    if offenders:
+        failures.append("standalone convert among the largest top-level "
+                        "producers (escaped quantize-dequantize pair)")
+    base = (payload.get("baseline_memory") or {}).get("temp_size")
+    cur = (payload.get("memory") or {}).get("temp_size")
+    native = payload.get("backend") in ("tpu", "gpu")
+    ratio = None
+    if base and cur:
+        ratio = float(cur) / float(base)
+        if ratio > 1.25 and native:
+            failures.append("temp bytes %s vs bf16 baseline %s "
+                            "(%.2fx > 1.25x)" % (_fmt_bytes(cur),
+                                                 _fmt_bytes(base), ratio))
+    if failures:
+        print("EXPECT-FP8 %s: FAIL" % path)
+        for f in failures:
+            print("    %s" % f)
+        for o in offenders:
+            print("    offender: %s" % o)
+        return False
+    note = "" if ratio is None else \
+        ", temp bytes %.2fx of bf16 baseline%s" % (
+            ratio, "" if native else " (advisory: f32 residuals on "
+            "this backend)")
+    print("EXPECT-FP8 %s: PASS (no standalone convert in the top "
+          "producers%s)" % (path, note))
+    return True
+
+
 def _shape_bytes(dtype, dims):
     n = _BYTES.get(dtype, 4)
     for d in dims.split(","):
@@ -280,7 +332,7 @@ def _fmt_bytes(n):
 
 def dump(out_path, model="transformer", batch=None, seq=None,
          attn_impl=None, mesh=None, zero=None, check_async=False,
-         plan=None, check_plan=False):
+         plan=None, check_plan=False, fp8=None, check_fp8=False):
     """Compile one fused train step AOT and write the audit artifact.
 
     ``mesh=N`` compiles over an N-way data mesh so the gradient
@@ -292,9 +344,15 @@ def dump(out_path, model="transformer", batch=None, seq=None,
     gathers.  ``plan="data=4,model=2"`` compiles the COMPOSED step
     (``TrainStep(plan=...)``) and records the plan identity so
     ``--expect-plan`` can audit the roster: group-scoped collectives
-    only, no monolithic global gather/reduce."""
+    only, no monolithic global gather/reduce.  ``fp8="on"`` compiles
+    under ``MXNET_FP8`` at bf16 compute and ALSO compiles the matching
+    bf16 step without fp8, recording its memory as
+    ``baseline_memory`` so ``--expect-fp8`` can bound the temp-bytes
+    delta."""
     if attn_impl:
         os.environ["MXNET_ATTN_IMPL"] = attn_impl
+    if fp8:
+        os.environ["MXNET_FP8"] = fp8
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import mxnet_tpu as mx
@@ -338,11 +396,15 @@ def dump(out_path, model="transformer", batch=None, seq=None,
     step = TrainStep(sym, optimizer="sgd",
                      optimizer_params={"learning_rate": 0.01},
                      mesh=dev_mesh, zero=None if plan_obj else zero,
-                     plan=plan_obj)
+                     plan=plan_obj,
+                     compute_dtype="bfloat16" if fp8 else None)
     step.compile(shapes)
     compiled = step._aot
+    import jax
+
     payload = {"kind": ARTIFACT_KIND, "pid": os.getpid(),
-               "time": time.time(), "model": model, "shapes":
+               "time": time.time(), "model": model,
+               "backend": jax.default_backend(), "shapes":
                {k: list(v) for k, v in shapes.items()},
                "mesh": int(mesh) if mesh else None,
                "zero": step.zero_axis is not None,
@@ -379,6 +441,29 @@ def dump(out_path, model="transformer", batch=None, seq=None,
     except Exception as e:  # backend without memory_analysis
         payload["memory"] = {"error": str(e)}
     payload.update(parse_hlo(compiled.as_text()))
+    if fp8:
+        # the matching bf16 step, fp8 off: its watermark is the
+        # --expect-fp8 temp-bytes reference
+        payload["fp8"] = fp8
+        os.environ["MXNET_FP8"] = "off"
+        try:
+            base = TrainStep(sym, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.01},
+                             mesh=dev_mesh,
+                             zero=None if plan_obj else zero,
+                             plan=plan_obj, compute_dtype="bfloat16")
+            base.compile(shapes)
+            bmem = base._aot.memory_analysis()
+            payload["baseline_memory"] = {
+                k: int(getattr(bmem, k + "_in_bytes", 0) or 0)
+                for k in ("temp_size", "argument_size", "output_size",
+                          "generated_code_size")}
+        except Exception as e:  # mxlint: disable=MX008
+            # best-effort reference: a baseline that cannot compile
+            # degrades --expect-fp8's temp-bytes bound to advisory
+            payload["baseline_memory"] = {"error": str(e)}
+        finally:
+            os.environ["MXNET_FP8"] = fp8
     with open(out_path, "w") as f:
         json.dump(payload, f)
     print("wrote %s" % out_path)
@@ -387,6 +472,8 @@ def dump(out_path, model="transformer", batch=None, seq=None,
     if check_async and not expect_async(payload, out_path):
         rc = 1
     if check_plan and not expect_plan(payload, out_path):
+        rc = 1
+    if check_fp8 and not expect_fp8(payload, out_path):
         rc = 1
     return rc
 
@@ -567,6 +654,17 @@ def main(argv=None):
                          "named offender; on sync-only backends (CPU) "
                          "a structural check rejects a monolithic "
                          "full-parameter all-gather under zero=3")
+    ap.add_argument("--fp8", choices=("on", "auto"),
+                    help="compile the dump under MXNET_FP8 at bf16 "
+                         "compute, plus a matching bf16 baseline whose "
+                         "memory lands in the artifact as "
+                         "baseline_memory")
+    ap.add_argument("--expect-fp8", action="store_true",
+                    help="fail (exit 1) when an --fp8 dump shows a "
+                         "standalone convert among the largest "
+                         "top-level producers (an escaped "
+                         "quantize-dequantize pair) or a temp-bytes "
+                         "watermark above 1.25x the bf16 baseline")
     ap.add_argument("--expect-plan", action="store_true",
                     help="fail (exit 1) when a --plan dump's collective "
                          "roster is not group-scoped: ZeRO traffic must "
@@ -582,7 +680,8 @@ def main(argv=None):
                     seq=args.seq, attn_impl=args.attn_impl,
                     mesh=args.mesh, zero=args.zero,
                     check_async=args.expect_async,
-                    plan=args.plan, check_plan=args.expect_plan)
+                    plan=args.plan, check_plan=args.expect_plan,
+                    fp8=args.fp8, check_fp8=args.expect_fp8)
     if args.diff:
         return diff(*args.diff)
     if not args.paths:
@@ -590,7 +689,7 @@ def main(argv=None):
     ok, async_fail = 0, 0
     for path in args.paths:
         ok += report_file(path)
-        if args.expect_async or args.expect_plan:
+        if args.expect_async or args.expect_plan or args.expect_fp8:
             try:
                 payload = _load(path)
             except (ValueError, SystemExit):
@@ -598,6 +697,8 @@ def main(argv=None):
             if args.expect_async and not expect_async(payload, path):
                 async_fail += 1
             if args.expect_plan and not expect_plan(payload, path):
+                async_fail += 1
+            if args.expect_fp8 and not expect_fp8(payload, path):
                 async_fail += 1
     return 0 if ok and not async_fail else 1
 
