@@ -431,6 +431,83 @@ def measure_ckpt_save(sym, X, y, batch, saves=5):
     return out
 
 
+def measure_migration(sym, X, y, batch):
+    """Live-elasticity A/B: the in-memory plan migration (quiesce /
+    re-form / reshard / resume, ``mxnet_tpu.parallel.elastic``) against
+    the checkpoint-restart it replaces — save + fresh module rebuild +
+    manifest restore onto the same new plan.  Both sides pay the fused
+    step's lazy recompile on their first post-switch step (it lands in
+    ``compile_s``, not here), so this measures the control-path
+    downtime the migration actually removes: process-free mesh re-form
+    and host-memory reshard vs a full checkpoint round trip plus module
+    re-bind.  ``migration_speedup`` = restart_s / downtime_s."""
+    import tempfile
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint as ckpt
+    from mxnet_tpu.parallel.elastic import ElasticCoordinator, ScaleEvent
+
+    ndev = len(jax.devices())
+    if ndev >= 4 and batch % 4 == 0:
+        old_spec, new_spec = "data=4,zero=off", "data=2,model=2,zero=off"
+    elif ndev >= 2 and batch % 2 == 0:
+        old_spec, new_spec = "data=2,zero=off", "data=1,zero=off"
+    else:
+        return {}
+    it = mx.io.NDArrayIter(X[:batch * 4], y[:batch * 4], batch_size=batch)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="adam",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.01}, plan=old_spec)
+    out = {}
+    with tempfile.TemporaryDirectory() as d:
+        mgr = ckpt.CheckpointManager(d, prefix="mig", async_writes=False)
+        # untimed warm-up: the migration side runs first and would
+        # otherwise be charged the cold costs (first host transfer,
+        # first manifest write) that the restart side then skips
+        mgr.save(mod, epoch=0, nbatch=0)
+        mgr.flush()
+        mgr.load()
+        coord = ElasticCoordinator(num_workers=1, rank=0,
+                                   install_signal=False)
+        event = ScaleEvent(num_workers=1, plan=new_spec,
+                           reason="bench A/B", source="manifest")
+        report = coord.migrate(mod, event, epoch=1, nbatch=0,
+                               train_data=it, checkpoint=mgr)
+        out["migration_downtime_s"] = report["downtime_s"]
+        for key, val in report["phases"].items():
+            out["migration_%s_ms" % key[:-2]] = round(val * 1e3, 3)
+        out["migration_old_plan"] = report["old_plan"]["fingerprint"]
+        out["migration_new_plan"] = report["new_plan"]["fingerprint"]
+
+        # baseline: the restart path onto the SAME new plan — final save
+        # (the dying job's handoff), manifest restore, fresh module
+        # re-bind, optimizer-state reinstall, data fast-forward.  No
+        # process spawn is charged, so the baseline flatters restarts.
+        t0 = time.perf_counter()
+        mgr.save(mod, epoch=1, nbatch=0)
+        mgr.flush()
+        state = mgr.load()
+        it2 = mx.io.NDArrayIter(X[:batch * 4], y[:batch * 4],
+                                batch_size=batch)
+        mod2 = mx.mod.Module(sym, context=mx.cpu())
+        mod2.bind(data_shapes=it2.provide_data,
+                  label_shapes=it2.provide_label, for_training=True)
+        mod2.init_params(arg_params=state.arg_params,
+                         aux_params=state.aux_params)
+        mod2.init_optimizer(optimizer="adam",
+                            optimizer_params={"learning_rate": 0.01},
+                            plan=new_spec)
+        mod2._restore_from(state)
+        mod2._fast_forward_data(it2, state.epoch, state.nbatch)
+        out["ckpt_restart_s"] = round(time.perf_counter() - t0, 6)
+    out["migration_speedup"] = round(
+        out["ckpt_restart_s"] / max(1e-9, out["migration_downtime_s"]), 3)
+    return out
+
+
 def measure_decode_ab(n_images=256, hw=64, batch=32, workers=None,
                       epochs=2):
     """Data-plane A/B over one real-JPEG record file: the classic
@@ -633,6 +710,12 @@ def main():
         result.update(measure_plan_ab(sym, batch, feat))
     except Exception as exc:  # mxlint: disable=MX008 — the one-JSON-line contract survives a failed A/B row
         result["plan_ab_error"] = str(exc)[:200]
+    # live elasticity: in-memory plan-migration downtime vs the
+    # checkpoint-restart baseline it replaces
+    try:
+        result.update(measure_migration(sym, X, y, batch))
+    except Exception as exc:  # mxlint: disable=MX008 — the one-JSON-line contract survives a failed A/B row
+        result["migration_error"] = str(exc)[:200]
     # compile_s/step_s split + cache counters (fit's AOT warmup and the
     # pure-step AOT compile both record through profiler.compile_event)
     result.update(bench_util.compile_summary())

@@ -155,6 +155,45 @@ def _index_windows(index, shape):
     return out
 
 
+def assemble_pieces(pieces, params_meta, arrays=None):
+    """Merge piece windows into global host arrays — the ONE audited
+    window-assembly path, shared by the on-disk restore
+    (:meth:`CheckpointManager._assemble`) and the in-memory elastic
+    reshard (``parallel/elastic.py``).
+
+    ``pieces`` iterates ``(key, index_windows_or_None, piece)`` triples
+    in the :func:`_host_pieces` convention: ``index_windows`` is a
+    ``[[start, stop], ...]`` window per dimension, or ``None`` for a
+    whole-array piece.  ``params_meta`` maps each key to its global
+    ``{"shape", "dtype"}``.  Extension dtypes (bfloat16, fp8) arriving
+    as raw same-width bytes — npz stores them as void — are
+    reinterpreted via ``.view``, never value-cast, so the round trip is
+    bit-identical.  Pass ``arrays`` to accumulate across calls (one per
+    shard file); later whole-array pieces replace earlier entries, and
+    windowed pieces write into a zeros-initialized destination of the
+    global shape."""
+    import numpy as np
+
+    arrays = {} if arrays is None else arrays
+    for key, idx, piece in pieces:
+        meta = params_meta[key]
+        want = _np_dtype(meta["dtype"])
+        piece = np.asarray(piece)
+        if piece.dtype != want and piece.dtype.itemsize == want.itemsize:
+            # extension dtypes (bfloat16, fp8) arrive as raw void bytes;
+            # reinterpret, don't cast
+            piece = piece.view(want)
+        if idx is None:
+            arrays[key] = piece
+            continue
+        dst = arrays.get(key)
+        if dst is None:
+            dst = np.zeros(tuple(meta["shape"]), dtype=want)
+            arrays[key] = dst
+        dst[tuple(slice(int(a), int(b)) for a, b in idx)] = piece
+    return arrays
+
+
 def _host_pieces(arr, rank):
     """(global_meta, owned_pieces) for one parameter on this rank.
 
@@ -1020,7 +1059,8 @@ class CheckpointManager:
     # -- reassembly / elastic restore -----------------------------------
     def _assemble(self, manifest):
         """Global numpy arrays from whatever shard layout the saving
-        topology used."""
+        topology used — window merging itself lives in the shared
+        :func:`assemble_pieces` helper."""
         import numpy as np
 
         try:  # bf16/fp8 shards need the extension dtypes registered
@@ -1039,26 +1079,10 @@ class CheckpointManager:
                     "checkpoint shard %s is unreadable: %s"
                     % (shard["file"], e)) from e
             with npz as f:
-                for pkey, info in (shard.get("pieces") or {}).items():
-                    key, idx = info["param"], info["index"]
-                    meta = manifest["params"][key]
-                    piece = np.asarray(f[pkey])
-                    want = _np_dtype(meta["dtype"])
-                    if piece.dtype != want and \
-                            piece.dtype.itemsize == want.itemsize:
-                        # npz stores extension dtypes (bfloat16, fp8)
-                        # as raw void bytes; reinterpret, don't cast
-                        piece = piece.view(want)
-                    if idx is None:
-                        arrays[key] = piece
-                        continue
-                    dst = arrays.get(key)
-                    if dst is None:
-                        dst = np.zeros(tuple(meta["shape"]),
-                                       dtype=_np_dtype(meta["dtype"]))
-                        arrays[key] = dst
-                    dst[tuple(slice(int(a), int(b)) for a, b in idx)] = \
-                        piece
+                assemble_pieces(
+                    ((info["param"], info["index"], f[pkey])
+                     for pkey, info in (shard.get("pieces") or {}).items()),
+                    manifest["params"], arrays)
         return arrays
 
     def _restore_layout(self, mesh, sharding, arrays):

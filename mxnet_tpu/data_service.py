@@ -394,11 +394,24 @@ class DataServiceIter(DataIter):
     def seekable(self):
         return True
 
+    def position(self):
+        """``(epoch, next_batch)`` cursor — the quiesce-boundary record
+        of the elastic migration: ``seek()`` back to exactly this pair
+        resumes the stream bit-identically.  ``next_batch ==
+        steps_per_epoch`` is the legal epoch-final boundary: the next
+        ``next()`` raises StopIteration and the training loop rolls to
+        the following epoch."""
+        return (int(self._epoch), int(self._cursor))
+
     def seek(self, epoch, nbatch):
         """Jump to absolute position ``(epoch, nbatch)`` in O(1): bump the
         generation (in-flight results from the old position are dropped
         on arrival), recompute the epoch order lazily, and refill the
-        submission window from the new cursor."""
+        submission window from the new cursor.  ``nbatch`` may equal
+        ``steps_per_epoch`` — the epoch-final batch boundary — in which
+        case the stream is immediately exhausted and the resume
+        fast-forward rolls to the next epoch (the ``fit`` epoch-head
+        StopIteration contract)."""
         epoch, nbatch = int(epoch), int(nbatch)
         if nbatch < 0 or nbatch > self._steps:
             raise MXNetError("seek nbatch %d out of range [0, %d]"

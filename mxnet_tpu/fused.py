@@ -1136,6 +1136,48 @@ class TrainStep:
             h["fp8_hist"] = _quantize.fp8_hist_init(self._fp8_sites)
         return h
 
+    def export_hstate(self):
+        """Host snapshot of the carried step health state — the dynamic
+        loss scale, its good-step streak, and the fp8 delayed-scaling
+        amax history — or None when this step carries none.  The capture
+        side of the in-memory plan migration (``parallel/elastic.py``);
+        checkpoint-free, bit-exact."""
+        import numpy as np
+
+        if self._hstate is None:
+            return None
+        return {k: np.asarray(v) for k, v in self._hstate.items()}
+
+    def load_hstate(self, hstate):
+        """Install a captured :meth:`export_hstate` snapshot onto THIS
+        step (the reshard side of the in-memory migration, or a restore
+        without a disk round trip).  Dtypes are pinned to the carried
+        contract (f32 scale/history, i32 streak) so the jit signature
+        matches a fresh :meth:`_init_hstate`; an fp8 history also pins
+        the site count, which is topology-independent."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        if hstate is None:
+            return
+        if not self._use_hstate:
+            raise MXNetError(
+                "cannot install a migrated hstate: this TrainStep "
+                "carries no health state (no loss scaler and fp8 off) — "
+                "the new plan's step must be armed like the old one")
+        h = {}
+        if "loss_scale" in hstate:
+            h["loss_scale"] = jnp.asarray(float(hstate["loss_scale"]),
+                                          "float32")
+            h["good_steps"] = jnp.asarray(
+                int(hstate.get("good_steps", 0)), "int32")
+        if "fp8_hist" in hstate:
+            hist = np.asarray(hstate["fp8_hist"])
+            h["fp8_hist"] = jnp.asarray(hist, "float32")
+            self._fp8_sites = int(hist.shape[0])
+        self._hstate = h or None
+
     def _fp8_site_count(self, params, aux, batch):
         """Count the fp8 matmul sites one forward claims (once, via an
         abstract trace) — the leading dim of the carried amax history.

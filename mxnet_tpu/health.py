@@ -586,6 +586,7 @@ class RankHeartbeat:
         self.num_workers = int(num_workers)
         self.interval_s = interval_s if interval_s is not None else \
             get_env("MXNET_HEARTBEAT_INTERVAL_S", 5.0, float)
+        self._write_failing = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -634,27 +635,67 @@ class RankHeartbeat:
                 json.dump({"rank": self.rank, "pid": os.getpid(),
                            "time": time.time()}, f)
             os.replace(tmp, path)
+            if self._write_failing:
+                self._write_failing = False
+                logger.warning("heartbeat writes recovered (rank %d)",
+                               self.rank)
         except OSError as e:  # heartbeats must never kill training
-            logger.warning("heartbeat write failed: %s", e)
+            # rate-limited: a full disk re-fails EVERY beat — log the
+            # transition once, then stay quiet until it recovers
+            if not self._write_failing:
+                self._write_failing = True
+                logger.warning(
+                    "heartbeat write failed: %s (suppressing repeats "
+                    "until writes recover)", e)
+            else:
+                logger.debug("heartbeat write still failing: %s", e)
             try:
                 os.remove(tmp)
             except OSError:
                 pass
 
 
+class PeerScan(list):
+    """Result of :func:`stale_peers`: a list of ``(rank, description)``
+    pairs plus a scan ``error`` field, so "empty because every peer is
+    live" is distinguishable from "empty because the heartbeat
+    directory could not be read at all" (permissions lost, mount gone).
+    Existing truthiness/iteration callers are unchanged; diagnostics
+    that would otherwise blame N peers for a local I/O failure check
+    ``unreadable`` first."""
+
+    def __init__(self, items=(), error=None):
+        super().__init__(items)
+        self.error = None if error is None else str(error)
+
+    @property
+    def unreadable(self):
+        return self.error is not None
+
+
 def stale_peers(directory, num_workers, stale_s=None, self_rank=None,
                 now=None):
     """Name the ranks whose heartbeat is stale or missing.
 
-    Returns ``[(rank, description), ...]`` — empty when every peer is
-    live (or heartbeats are unconfigured)."""
+    Returns a :class:`PeerScan` of ``(rank, description)`` — empty when
+    every peer is live (or heartbeats are unconfigured).  A directory
+    that exists but cannot be read yields a typed EMPTY scan with
+    ``error`` set instead of misreporting every peer as dead: the
+    failure is local, and acting on it (e.g. an elastic shrink) would
+    evict healthy ranks."""
     if not directory:
-        return []
+        return PeerScan()
     if stale_s is None:
         stale_s = get_env("MXNET_HEARTBEAT_STALE_S",
                           3 * get_env("MXNET_HEARTBEAT_INTERVAL_S", 5.0,
                                       float), float)
     now = time.time() if now is None else now
+    if os.path.exists(directory):
+        try:
+            os.listdir(directory)
+        except OSError as e:
+            return PeerScan(error="heartbeat directory %r exists but is "
+                                  "unreadable: %s" % (directory, e))
     out = []
     for rank in range(int(num_workers)):
         if self_rank is not None and rank == self_rank:
@@ -671,7 +712,7 @@ def stale_peers(directory, num_workers, stale_s=None, self_rank=None,
         except (OSError, ValueError):
             out.append((rank, "rank %d never wrote a heartbeat under %r"
                         % (rank, directory)))
-    return out
+    return PeerScan(out)
 
 
 def peer_report(num_workers, self_rank=None):
@@ -681,6 +722,8 @@ def peer_report(num_workers, self_rank=None):
     if not directory or num_workers <= 1:
         return ""
     dead = stale_peers(directory, num_workers, self_rank=self_rank)
+    if getattr(dead, "unreadable", False):
+        return "; peer heartbeats unknown: %s" % dead.error
     if not dead:
         return ("; peer heartbeats under %r are all current — the "
                 "stall is local (device queue or network), not a dead "

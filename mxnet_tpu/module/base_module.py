@@ -193,7 +193,7 @@ class BaseModule:
             metric_sync_period=None, steps_per_call=None,
             checkpoint=None, checkpoint_period=1, resume_from=None,
             health=None, loss_scale=None, step_timeout_s=None,
-            zero=None, plan=None):
+            zero=None, plan=None, elastic=None):
         """The training loop (reference ``BaseModule.fit``,
         ``base_module.py:376``), pipelined: by default the train iterator
         is wrapped in :class:`~mxnet_tpu.io.DevicePrefetchIter` so batch
@@ -263,6 +263,15 @@ class BaseModule:
           composing TP x PP x DP/ZeRO over a named mesh
           (``MXNET_PLAN``; see ``docs/performance.md`` "Composing
           parallelisms").
+        * ``elastic`` — live elasticity: True (or ``MXNET_ELASTIC=1``,
+          or a configured
+          :class:`~mxnet_tpu.parallel.elastic.ElasticCoordinator`)
+          polls for scale events at every batch boundary — SIGUSR1, a
+          dead peer, or a ``tools/launch.py --scale-event`` manifest —
+          and migrates the run in memory (quiesce / re-form / reshard /
+          resume) instead of dying; a failed migration falls back to
+          the last ``checkpoint``.  See ``docs/fault_tolerance.md``
+          "Live elasticity".
         """
         from ..base import get_env
         from ..initializer import Uniform
@@ -332,6 +341,9 @@ class BaseModule:
         # Module.init_optimizer; modules without health support simply
         # have no monitor
         hmon = getattr(self, "_health_monitor", None)
+
+        from ..parallel.elastic import maybe_coordinator
+        elastic = maybe_coordinator(elastic)
 
         if mgr is not None and mgr.kvstore is None:
             # the manager inherits rank/barrier semantics from the store
@@ -418,7 +430,7 @@ class BaseModule:
                              mgr=mgr, checkpoint_period=checkpoint_period,
                              resume_nbatch=resume_state.nbatch
                              if resume_state is not None else 0,
-                             hmon=hmon, watchdog=watchdog)
+                             hmon=hmon, watchdog=watchdog, elastic=elastic)
             if mgr is not None:
                 # drain the async checkpoint writer before declaring the
                 # fit done: a failed background write must fail the fit,
@@ -465,7 +477,7 @@ class BaseModule:
                     epoch_end_callback, eval_end_callback,
                     eval_batch_end_callback, begin_epoch, num_epoch, K,
                     mgr=None, checkpoint_period=1, resume_nbatch=0,
-                    hmon=None, watchdog=None):
+                    hmon=None, watchdog=None, elastic=None):
         from ..testing import faults
 
         period = max(1, int(checkpoint_period))
@@ -529,6 +541,21 @@ class BaseModule:
                         # batch boundary: params/optimizer state consistent
                         self._preempt(guard.fired, fit_data, mgr,
                                       epoch, nbatch)
+                    if elastic is not None:
+                        event = elastic.poll()
+                        if event is not None:
+                            self._elastic_migrate(elastic, event, mgr,
+                                                  fit_data, epoch, nbatch)
+                            # the stream was re-seeked to this boundary
+                            # (migration) or left in place (fallback);
+                            # either way the lookahead batch fetched
+                            # above predates the move — refetch
+                            data_iter = iter(fit_data)
+                            end_of_batch = False
+                            try:
+                                next_data_batch = next(data_iter)
+                            except StopIteration:
+                                end_of_batch = True
 
                 if watchdog is not None:
                     # the epoch tail (eval pass, checkpoint write,
@@ -609,6 +636,40 @@ class BaseModule:
                "configured — pass fit(checkpoint=...) to save on "
                "preemption)"),
             epoch=epoch, nbatch=nbatch, signum=signum)
+
+    def _elastic_migrate(self, elastic, event, mgr, fit_data, epoch,
+                         nbatch):
+        """Run one live plan migration at the batch boundary
+        ``(epoch, nbatch)``; any mid-migration failure falls back to the
+        last good checkpoint so the job is always either migrated or
+        resumable — never wedged half-moved.  A retirement
+        (:class:`TrainingPreempted` from a shrink) propagates: that rank
+        is leaving on purpose, with its quiesce checkpoint written."""
+        try:
+            return elastic.migrate(self, event, epoch=epoch, nbatch=nbatch,
+                                   train_data=fit_data, checkpoint=mgr)
+        except (TrainingPreempted, KeyboardInterrupt):
+            raise
+        except Exception as e:
+            if mgr is None or mgr.latest() is None:
+                raise
+            self.logger.warning(
+                "elastic: migration failed mid-flight (%s: %s); falling "
+                "back to the last good checkpoint", type(e).__name__, e)
+            state = mgr.load()
+            self.set_params(state.arg_params, state.aux_params)
+            self._restore_from(state)
+            # _health_rollback semantics: the restored trajectory
+            # continues from the CURRENT stream boundary — the stream
+            # itself never moved, only the lookahead batch is refetched.
+            # The module may sit on EITHER plan here (a resume-phase
+            # failure lands after the reshard), so repoint the staging
+            # mesh at whatever the module actually runs now
+            if hasattr(fit_data, "mesh"):
+                fit_data.mesh = getattr(self, "_mesh", None)
+            self._fast_forward_data(fit_data, epoch, nbatch)
+            elastic.record_fallback(event, e, epoch=epoch, nbatch=nbatch)
+            return None
 
     def _restore_from(self, state):
         """Apply the optimizer side of a resume after ``init_optimizer``:

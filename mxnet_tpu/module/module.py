@@ -692,6 +692,50 @@ class Module(BaseModule):
         return _zero.export_states(self._fused_states,
                                    fused.zero_layout(pdict))
 
+    def reconfigure_plan(self, plan):
+        """Rebuild the mesh + fused step under a NEW
+        :class:`~mxnet_tpu.parallel.ParallelPlan` without re-running
+        ``init_optimizer`` — the reshard half of the in-memory plan
+        migration (``parallel/elastic.py``).  The live optimizer object
+        is kept, so ``num_update`` and the lr schedule continue
+        uninterrupted; the caller is responsible for capturing the fused
+        optimizer states BEFORE this call (the rebuild drops them) and
+        re-installing the canonical trees afterwards via
+        :meth:`set_fused_optimizer_states`."""
+        from ..parallel.plan import ParallelPlan
+        from ..parallel import zero as _zero_mod
+
+        assert self.binded and self.optimizer_initialized, \
+            "reconfigure_plan needs a bound, optimizer-initialized module"
+        plan = ParallelPlan.parse(plan)
+        if plan.pipe > 1:
+            raise MXNetError(
+                "live migration onto a pipe>1 plan is not supported — "
+                "the pipelined step packs state per stage, which has no "
+                "in-memory reshard path yet (restart from a checkpoint)")
+        if self._pipeline_stages > 1:
+            raise MXNetError(
+                "live migration off a pipelined module is not supported")
+        old_plan = getattr(self, "_plan", None)
+        self._plan = plan
+        if plan.zero is not None:
+            self._zero = _zero_mod.zero_mode(plan.zero)
+        try:
+            self._mesh = self._decide_mesh(self._kvstore)
+            self._zero3_params = None
+            self._zero3_stale = False
+            self._preloaded_zero_states = None
+            self._maybe_compile_fused()
+            if self._fused is None:
+                raise MXNetError(
+                    "plan=%r was requested but the fused step is "
+                    "unavailable after the rebuild" % (plan,))
+        except Exception:
+            # leave the module describing the plan it actually runs
+            self._plan = old_plan
+            raise
+        return self._fused
+
     def prepare_compiled(self, dtype="float32"):
         """AOT warmup: lower-and-compile the fused train step for the
         bound shapes NOW instead of inside the first ``forward_backward``

@@ -17,15 +17,18 @@ from . import expert
 from . import overlap
 from . import zero
 from . import plan
+from . import elastic
 from .mesh import (create_mesh, current_mesh, set_mesh, mesh_scope,
                    init_distributed)
 from .plan import ParallelPlan
+from .elastic import ElasticCoordinator, ElasticRendezvousFailed, ScaleEvent
 from .sequence import ring_attention, sequence_parallel_attention
 from .pipeline import pipeline_apply, split_symbol, PipelineTrainStep
 from .expert import moe_ffn, routed_moe_ffn
 
 __all__ = ["mesh", "collectives", "sharding", "sequence", "overlap",
-           "zero", "plan", "ParallelPlan",
+           "zero", "plan", "elastic", "ParallelPlan",
+           "ElasticCoordinator", "ElasticRendezvousFailed", "ScaleEvent",
            "create_mesh",
            "current_mesh", "set_mesh", "mesh_scope", "init_distributed", "ring_attention",
            "sequence_parallel_attention", "pipeline", "expert",

@@ -1,0 +1,518 @@
+"""Live elasticity: in-memory plan migration without a process restart.
+
+The fault-tolerance story so far treats every topology change as a
+death: a preemption checkpoints and exits (``base_module._preempt``), a
+dead peer is *named* (``health.stale_peers``) but the survivors still
+tear down, and the elastic *restore* (``checkpoint._assemble`` +
+``zero.unflatten_tiles``) only runs on the cold path — a fresh process
+re-reading the manifest from disk.  That round trip pays process
+startup, XLA recompilation and a full checkpoint read for what is, at
+heart, a layout change over state the survivors already hold in host
+memory.
+
+:class:`ElasticCoordinator` closes the loop in-process with four phases,
+each a registered chaos site (``testing/faults.py``):
+
+1. **quiesce** (``elastic_quiesce``) — at a batch boundary, write the
+   last-good checkpoint (the fallback anchor if any later phase dies),
+   then capture params / fused optimizer states / loss-scaler + fp8
+   amax history into canonical host arrays through the SAME audited
+   window math the on-disk path uses (``checkpoint._host_pieces`` →
+   ``checkpoint.assemble_pieces``, ``zero.export_states`` →
+   ``zero.import_state``).
+2. **re-form** (``elastic_rendezvous``) — wait, bounded by
+   ``MXNET_ELASTIC_TIMEOUT_S``, for the new world's peers to show live
+   heartbeats (PR 3's ``RankHeartbeat`` files); on timeout raise the
+   typed :class:`ElasticRendezvousFailed` naming the phase and the
+   dead peers (``health.peer_report`` wording) instead of hanging.
+3. **reshard** (``elastic_reshard``) — ``Module.reconfigure_plan``
+   rebuilds the mesh + fused step under the new
+   :class:`~mxnet_tpu.parallel.ParallelPlan` (the live optimizer object
+   survives, so ``num_update`` and the lr schedule continue), then the
+   captured canonical state is re-installed: params via ``set_params``,
+   optimizer trees via ``set_fused_optimizer_states`` (re-tiled to the
+   new zero layout bit-exactly), loss-scale/fp8 history via
+   ``TrainStep.load_hstate``.  No disk I/O.
+4. **resume** (``elastic_resume``) — seek the data stream back to the
+   quiesce boundary (``seek(epoch, nbatch)``, O(1) on the data
+   service) and hand control back to the batch loop.
+
+Scale events arrive three ways, all surfaced by :meth:`poll` at batch
+boundaries: SIGUSR1, a dead peer detected via ``health.stale_peers``,
+or a host-count/plan change written to the ``MXNET_ELASTIC_DIR``
+manifest (``tools/launch.py --scale-event`` emits it; the JSON schema
+here is the contract).  A failure mid-migration falls back to the
+last-good checkpoint (``BaseModule._elastic_migrate``) — the job is
+always either migrated or resumable, never wedged half-moved.
+
+Every migration writes a ``mxnet_tpu-migration-event`` artifact
+(old/new plan fingerprints, per-phase wall times, ``downtime_s``) under
+``MXNET_HEALTH_DIR``; ``tools/diagnose.py`` renders it and
+``bench_fit.py --migration`` A/Bs the downtime against a
+checkpoint-restart.  See docs/fault_tolerance.md "Live elasticity".
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+
+from ..base import MXNetError, TrainingPreempted, get_env
+
+__all__ = ["ScaleEvent", "ElasticCoordinator", "ElasticRendezvousFailed",
+           "scale_event_path", "read_scale_event", "write_scale_event",
+           "maybe_coordinator"]
+
+logger = logging.getLogger(__name__)
+
+_PHASES = ("quiesce", "rendezvous", "reshard", "resume")
+
+
+class ElasticRendezvousFailed(MXNetError):
+    """The re-form phase could not assemble the new world before the
+    ``MXNET_ELASTIC_TIMEOUT_S`` watchdog expired (or the heartbeat
+    directory itself was unreadable).  ``phase`` names where the
+    migration died, ``dead_peers`` the ranks that never showed a live
+    heartbeat — launchers decide between retrying with a smaller world
+    and restarting from the checkpoint the quiesce phase wrote."""
+
+    def __init__(self, msg, phase="rendezvous", dead_peers=()):
+        super().__init__(msg)
+        self.phase = phase
+        self.dead_peers = list(dead_peers)
+
+
+class ScaleEvent:
+    """One resize/re-plan request: the new world size, an optional new
+    plan (spec string, ``describe()`` dict or ``ParallelPlan``), why,
+    and where it came from (``'manifest'`` / ``'signal'`` /
+    ``'peers'``).  ``seq`` orders manifest events so a file rewrite
+    fires exactly once."""
+
+    __slots__ = ("num_workers", "plan", "reason", "seq", "source")
+
+    def __init__(self, num_workers, plan=None, reason="", seq=0,
+                 source="manifest"):
+        self.num_workers = int(num_workers)
+        self.plan = plan
+        self.reason = str(reason)
+        self.seq = int(seq)
+        self.source = source
+
+    def resolve_plan(self):
+        """The event's plan as a live :class:`ParallelPlan`, or None to
+        keep the module's current plan."""
+        from .plan import ParallelPlan
+
+        p = self.plan
+        if p is None:
+            return None
+        if isinstance(p, dict):
+            return ParallelPlan.from_describe(p)
+        return ParallelPlan.parse(p)
+
+    def __repr__(self):
+        return ("ScaleEvent(num_workers=%d, plan=%r, source=%r, seq=%d)"
+                % (self.num_workers, self.plan, self.source, self.seq))
+
+
+# -- scale-event manifest (the launch.py <-> coordinator contract) ------
+def scale_event_path(directory):
+    return os.path.join(directory, "scale_event.json")
+
+
+def read_scale_event(directory):
+    """Parse ``<dir>/scale_event.json`` into a :class:`ScaleEvent`, or
+    None when absent/unparseable (writes are atomic renames, so a bad
+    file is a foreign artifact, not a torn write — skip it)."""
+    path = scale_event_path(directory)
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        return ScaleEvent(num_workers=int(payload["num_workers"]),
+                          plan=payload.get("plan") or None,
+                          reason=payload.get("reason", ""),
+                          seq=int(payload.get("seq", 1)),
+                          source="manifest")
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def write_scale_event(directory, num_workers, plan=None, reason=""):
+    """Atomically publish a scale event for running coordinators to
+    poll.  ``seq`` auto-increments past any prior event so rewrites
+    fire exactly once; returns the published sequence number.  The same
+    JSON schema is emitted stdlib-only by ``tools/launch.py
+    --scale-event`` — keep the two writers in sync."""
+    os.makedirs(directory, exist_ok=True)
+    prior = read_scale_event(directory)
+    seq = (prior.seq if prior is not None else 0) + 1
+    if plan is not None and not isinstance(plan, (str, dict)):
+        plan = plan.describe()  # ParallelPlan → JSON-able identity
+    payload = {"seq": seq, "num_workers": int(num_workers),
+               "plan": plan, "reason": str(reason)}
+    path = scale_event_path(directory)
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return seq
+
+
+def maybe_coordinator(elastic=None):
+    """Resolve ``fit(elastic=...)``: a coordinator passes through,
+    truthy builds one from the environment, None defers to
+    ``MXNET_ELASTIC``."""
+    if isinstance(elastic, ElasticCoordinator):
+        return elastic
+    if elastic is None:
+        elastic = get_env("MXNET_ELASTIC", False, bool)
+    return ElasticCoordinator() if elastic else None
+
+
+class ElasticCoordinator:
+    """The quiesce → re-form → reshard → resume control loop.
+
+    Construction reads the launcher environment (``MXNET_WORKER_ID`` /
+    ``MXNET_NUM_WORKERS`` from ``tools/launch.py``,
+    ``MXNET_ELASTIC_DIR`` for the scale-event manifest,
+    ``MXNET_HEARTBEAT_DIR`` for peer liveness) and latches any
+    pre-existing manifest as already-seen: the coordinator reacts to
+    changes after it starts, not to leftovers of the previous job.
+    ``poll()`` is cheap enough for every batch boundary (throttled to
+    ``poll_interval_s`` between filesystem looks; a latched SIGUSR1
+    bypasses the throttle).  ``migrate()`` runs the four phases and is
+    deliberately exception-transparent — the caller owns the
+    fall-back-to-checkpoint decision (``BaseModule._elastic_migrate``).
+    """
+
+    def __init__(self, directory=None, heartbeat_dir=None,
+                 num_workers=None, rank=None, timeout_s=None,
+                 poll_interval_s=1.0, install_signal=None):
+        self.directory = directory if directory is not None else \
+            (get_env("MXNET_ELASTIC_DIR", "", str) or None)
+        self.heartbeat_dir = heartbeat_dir if heartbeat_dir is not None \
+            else (get_env("MXNET_HEARTBEAT_DIR", "", str) or None)
+        self.num_workers = int(num_workers if num_workers is not None
+                               else get_env("MXNET_NUM_WORKERS", 1, int))
+        self.rank = int(rank if rank is not None
+                        else get_env("MXNET_WORKER_ID", 0, int))
+        self.timeout_s = float(timeout_s if timeout_s is not None
+                               else get_env("MXNET_ELASTIC_TIMEOUT_S",
+                                            60.0, float))
+        self.poll_interval_s = float(poll_interval_s)
+        self.events = []
+        prior = read_scale_event(self.directory) if self.directory else None
+        self._seen_seq = prior.seq if prior is not None else 0
+        self._reported_dead = frozenset()
+        self._unreadable_warned = False
+        self._signal_pending = 0
+        self._last_poll = float("-inf")
+        self._prev_handler = None
+        self._signal_installed = False
+        if install_signal is None:
+            install_signal = \
+                threading.current_thread() is threading.main_thread()
+        if install_signal and hasattr(signal, "SIGUSR1"):
+            try:
+                self._prev_handler = signal.signal(signal.SIGUSR1,
+                                                   self._on_signal)
+                self._signal_installed = True
+            except (ValueError, OSError):
+                pass  # not the main thread after all / embedded interp
+
+    def _on_signal(self, signum, frame):
+        self._signal_pending += 1
+        prev = self._prev_handler
+        if callable(prev):
+            prev(signum, frame)
+
+    def close(self):
+        """Restore the SIGUSR1 handler (tests; long-lived processes that
+        outlive their fit)."""
+        if self._signal_installed:
+            try:
+                signal.signal(signal.SIGUSR1, self._prev_handler
+                              or signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+            self._signal_installed = False
+
+    # -- event detection -------------------------------------------------
+    def poll(self):
+        """One batch-boundary look at the three event sources; returns a
+        :class:`ScaleEvent` at most once per distinct event, else None.
+        Manifest beats signal (SIGUSR1 usually just says "look at the
+        manifest now"); an unreadable heartbeat directory is a LOCAL
+        failure and never evicts peers (warned once, then quiet)."""
+        now = time.monotonic()
+        if not self._signal_pending and \
+                now - self._last_poll < self.poll_interval_s:
+            return None
+        self._last_poll = now
+        if self.directory:
+            ev = read_scale_event(self.directory)
+            if ev is not None and ev.seq > self._seen_seq:
+                self._seen_seq = ev.seq
+                self._signal_pending = 0
+                return ev
+        if self._signal_pending:
+            self._signal_pending = 0
+            return ScaleEvent(num_workers=self.num_workers, plan=None,
+                              reason="SIGUSR1 requested a re-form",
+                              seq=self._seen_seq, source="signal")
+        if self.heartbeat_dir and self.num_workers > 1:
+            from .. import health
+
+            scan = health.stale_peers(self.heartbeat_dir, self.num_workers,
+                                      self_rank=self.rank)
+            if getattr(scan, "unreadable", False):
+                if not self._unreadable_warned:
+                    self._unreadable_warned = True
+                    logger.warning(
+                        "elastic: peer liveness unknown (%s); not "
+                        "shrinking on a local failure", scan.error)
+                return None
+            self._unreadable_warned = False
+            dead = frozenset(rank for rank, _ in scan)
+            if dead and dead != self._reported_dead:
+                self._reported_dead = dead
+                # the surviving world is the contiguous rank prefix below
+                # the first dead peer — ranks above it retire in quiesce
+                return ScaleEvent(
+                    num_workers=max(1, min(dead)), plan=None,
+                    reason="; ".join(desc for _, desc in scan),
+                    seq=self._seen_seq, source="peers")
+        return None
+
+    # -- the migration ---------------------------------------------------
+    def migrate(self, module, event, epoch=0, nbatch=0, train_data=None,
+                checkpoint=None):
+        """Run the four-phase migration on ``module`` at the batch
+        boundary ``(epoch, nbatch)``.  Returns the migration report (and
+        writes it as an artifact); raises on any phase failure — the
+        quiesce checkpoint written first is the caller's fallback."""
+        from ..testing import faults
+
+        t_total = time.perf_counter()
+        phases = {}
+        old_workers = int(self.num_workers)
+        old_plan = getattr(module, "_plan", None)
+        old_desc = old_plan.describe() if old_plan is not None else None
+        old_fp = old_plan.fingerprint() if old_plan is not None else None
+        logger.info(
+            "elastic: migrating at epoch %d batch %d (%s, %d -> %d "
+            "workers)%s", epoch, nbatch, event.source, self.num_workers,
+            event.num_workers,
+            ": %s" % event.reason if event.reason else "")
+
+        # 1. quiesce — anchor the fallback, then capture canonically
+        t = time.perf_counter()
+        faults.inject("elastic_quiesce")
+        if checkpoint is not None:
+            checkpoint.save(module, epoch=epoch, nbatch=nbatch)
+            checkpoint.flush()
+        if self.rank >= event.num_workers:
+            # retired by the shrink: exit through the preemption path —
+            # the quiesce checkpoint above is this rank's handoff
+            raise TrainingPreempted(
+                "rank %d retired by elastic shrink to %d workers at "
+                "epoch %d, batch %d (checkpoint written in quiesce)"
+                % (self.rank, event.num_workers, epoch, nbatch),
+                epoch=epoch, nbatch=nbatch)
+        capture = self._capture(module)
+        phases["quiesce_s"] = time.perf_counter() - t
+
+        # 2. re-form — bounded wait for the new world's heartbeats
+        t = time.perf_counter()
+        faults.inject("elastic_rendezvous")
+        self._rendezvous(event)
+        phases["rendezvous_s"] = time.perf_counter() - t
+
+        # 3. reshard — new mesh/fused step, then re-install the capture
+        t = time.perf_counter()
+        faults.inject("elastic_reshard")
+        new_plan = event.resolve_plan()
+        if new_plan is not None and hasattr(module, "reconfigure_plan"):
+            module.reconfigure_plan(new_plan)
+        else:
+            new_plan = old_plan
+        self._install(module, capture)
+        phases["reshard_s"] = time.perf_counter() - t
+
+        # 4. resume — seek the stream back to the quiesce boundary
+        t = time.perf_counter()
+        faults.inject("elastic_resume")
+        if train_data is not None:
+            if hasattr(train_data, "mesh"):
+                # a DevicePrefetchIter stages onto the mesh it was built
+                # with — repoint it BEFORE the seek restarts staging, or
+                # the ring fills with old-mesh shardings the new step
+                # rejects
+                train_data.mesh = getattr(module, "_mesh", None)
+            module._fast_forward_data(train_data, epoch, nbatch)
+        self.num_workers = event.num_workers
+        phases["resume_s"] = time.perf_counter() - t
+
+        report = {
+            "kind": "mxnet_tpu-migration-event",
+            "outcome": "migrated",
+            "rank": self.rank,
+            "source": event.source,
+            "reason": event.reason,
+            "epoch": int(epoch),
+            "nbatch": int(nbatch),
+            "num_update": capture["num_update"],
+            "num_workers": [old_workers, int(event.num_workers)],
+        }
+        report["old_plan"] = {"describe": old_desc, "fingerprint": old_fp}
+        report["new_plan"] = {
+            "describe": new_plan.describe() if new_plan is not None else None,
+            "fingerprint": new_plan.fingerprint()
+            if new_plan is not None else None}
+        report["phases"] = {k: round(v, 6) for k, v in phases.items()}
+        report["downtime_s"] = round(time.perf_counter() - t_total, 6)
+        self.events.append(report)
+        self._write_artifact(report)
+        logger.info(
+            "elastic: migration done in %.3fs (%s -> %s)",
+            report["downtime_s"], old_fp,
+            report["new_plan"]["fingerprint"])
+        return report
+
+    def record_fallback(self, event, error, epoch=0, nbatch=0):
+        """Artifact trail for a failed migration the caller rolled back
+        to the last-good checkpoint (``_elastic_migrate``)."""
+        report = {
+            "kind": "mxnet_tpu-migration-event",
+            "outcome": "fallback",
+            "rank": self.rank,
+            "source": getattr(event, "source", "?"),
+            "reason": getattr(event, "reason", ""),
+            "epoch": int(epoch),
+            "nbatch": int(nbatch),
+            "error": "%s: %s" % (type(error).__name__, error),
+        }
+        self.events.append(report)
+        self._write_artifact(report)
+        return report
+
+    # -- phase helpers ---------------------------------------------------
+    def _rendezvous(self, event):
+        """Block (bounded by ``timeout_s``) until every rank of the new
+        world shows a live heartbeat.  A 1-way world, or no heartbeat
+        directory configured (single-host rigs), re-forms trivially."""
+        n = int(event.num_workers)
+        if n <= 1 or not self.heartbeat_dir:
+            return
+        from .. import health
+
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            scan = health.stale_peers(self.heartbeat_dir, n,
+                                      self_rank=self.rank)
+            if getattr(scan, "unreadable", False):
+                raise ElasticRendezvousFailed(
+                    "elastic migration failed in phase 'rendezvous': %s"
+                    % scan.error, phase="rendezvous")
+            if not scan:
+                return
+            if time.monotonic() >= deadline:
+                raise ElasticRendezvousFailed(
+                    "elastic migration failed in phase 'rendezvous': "
+                    "timed out after %.1fs waiting for a %d-worker "
+                    "world; dead/stale peers: %s"
+                    % (self.timeout_s, n,
+                       "; ".join(desc for _, desc in scan)),
+                    phase="rendezvous",
+                    dead_peers=[rank for rank, _ in scan])
+            time.sleep(min(0.2, max(0.01, self.poll_interval_s / 5.0)))
+
+    def _capture(self, module):
+        """Canonical host-memory snapshot of everything the new plan
+        must inherit, through the audited window paths: params + aux
+        (``_host_pieces`` → ``assemble_pieces``, extension dtypes
+        bit-preserved), fused optimizer states (``export_states`` →
+        ``import_state`` for zero layouts, identity for canonical
+        layouts), loss-scaler + fp8 amax history
+        (``TrainStep.export_hstate``), and ``num_update``."""
+        from .. import checkpoint as ckpt
+        from . import zero as _zero
+
+        def _host(x):
+            # one leaf → one global host array, via the audited path
+            meta, owned = ckpt._host_pieces(x, rank=0)
+            merged = ckpt.assemble_pieces(
+                (("leaf", idx, piece) for idx, piece in owned),
+                {"leaf": meta})
+            return merged.get("leaf")
+
+        arg_nd, aux_nd = module.get_params()  # syncs zero3/pipeline
+        arg = {n: _host(v) for n, v in arg_nd.items()}
+        aux = {n: _host(v) for n, v in aux_nd.items()}
+
+        states = None
+        exp = module._export_zero_states() \
+            if hasattr(module, "_export_zero_states") else None
+        if exp is not None:
+            states = {}
+            for name, ent in exp.items():
+                leaves = [_host(leaf) for leaf in ent["leaves"]]
+                states[name] = _zero.import_state(ent, leaves)
+        elif getattr(module, "_fused_states", None) is not None:
+            import jax
+
+            states = {n: jax.tree.map(_host, st)
+                      for n, st in module._fused_states.items()}
+
+        fused = getattr(module, "_fused", None)
+        hstate = fused.export_hstate() \
+            if fused is not None and hasattr(fused, "export_hstate") \
+            else None
+        opt = getattr(module, "_optimizer", None)
+        return {"arg": arg, "aux": aux, "states": states, "hstate": hstate,
+                "num_update": int(getattr(opt, "num_update", 0) or 0)}
+
+    def _install(self, module, capture):
+        """Re-install the capture onto the (re-planned) module: params
+        through ``set_params`` (re-tiled/re-sharded by the module on the
+        next step), optimizer trees through
+        ``set_fused_optimizer_states``, health state through
+        ``load_hstate``.  The optimizer object never changed hands, so
+        ``num_update``/lr continue by construction."""
+        from ..ndarray import array as nd_array
+
+        arg = {n: nd_array(a) for n, a in capture["arg"].items()}
+        aux = {n: nd_array(a) for n, a in capture["aux"].items()}
+        module.set_params(arg, aux)
+        if capture["states"] is not None and \
+                hasattr(module, "set_fused_optimizer_states"):
+            module.set_fused_optimizer_states(capture["states"])
+        fused = getattr(module, "_fused", None)
+        if fused is not None and capture["hstate"] is not None and \
+                hasattr(fused, "load_hstate"):
+            fused.load_hstate(capture["hstate"])
+
+    # -- artifacts -------------------------------------------------------
+    def _write_artifact(self, report):
+        """Best-effort ``migration-<pid>-<n>.json`` under
+        ``MXNET_HEALTH_DIR`` (or the tmpdir) — the trail
+        ``tools/diagnose.py`` renders."""
+        import tempfile
+
+        base = get_env("MXNET_HEALTH_DIR", "", str) or \
+            tempfile.gettempdir()
+        path = os.path.join(base, "migration-%d-%d.json"
+                            % (os.getpid(), len(self.events)))
+        try:
+            os.makedirs(base, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+            os.replace(tmp, path)
+            report["artifact"] = path
+        except OSError as e:
+            logger.debug("elastic: artifact write failed: %s", e)

@@ -139,6 +139,21 @@ class ParallelPlan:
                 raise MXNetError("unknown plan key %r in %r" % (key, spec))
         return cls(**kwargs)
 
+    @classmethod
+    def from_describe(cls, d):
+        """Rebuild a plan from its :meth:`describe` identity dict —
+        checkpoint manifests, migration-event artifacts, and scale-event
+        files all record plans in that form, and the elastic control
+        loop needs them back as live objects."""
+        if d is None:
+            return None
+        if isinstance(d, ParallelPlan):
+            return d
+        kwargs = {k: d[k] for k in ("data", "model", "pipe", "seq",
+                                    "zero", "schedule", "n_microbatches")
+                  if d.get(k) is not None}
+        return cls(**kwargs)
+
     @staticmethod
     def _int(key, val, spec):
         try:

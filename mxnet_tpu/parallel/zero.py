@@ -753,6 +753,43 @@ def export_params(params, lay):
     return out
 
 
+def import_state(ent, leaves=None):
+    """Inverse of one :func:`export_states` record: rebuild the
+    canonical (weight-shaped) fused-state pytree from flat host tiles.
+    ``leaves`` overrides the record's own (e.g. host arrays assembled
+    across ranks through the checkpoint piece windows); flat leaves are
+    trimmed with :func:`unflatten_tiles`, everything else passes
+    through.  This is the in-memory twin of the checkpoint restore's
+    ``_reassemble_zero`` — the elastic migration reshards through it
+    without a disk round-trip."""
+    import numpy as np
+
+    leaves = ent["leaves"] if leaves is None else leaves
+    shape = [int(s) for s in ent["canonical_shape"]]
+    out = []
+    for leaf, flat in zip(leaves, ent["flat"]):
+        arr = np.asarray(leaf)
+        if flat:
+            arr = unflatten_tiles(arr.reshape(-1), int(ent["logical"]),
+                                  shape, ent.get("tp"))
+        out.append(arr)
+    return state_unflatten(ent["structure"], out)
+
+
+def import_param(ent, leaf=None):
+    """Inverse of one :func:`export_params` record: flat at-rest host
+    tile -> canonical full host array (or pass-through when the entry
+    was never sharded)."""
+    import numpy as np
+
+    arr = np.asarray(ent["leaf"] if leaf is None else leaf)
+    if ent["flat"]:
+        arr = unflatten_tiles(arr.reshape(-1), int(ent["logical"]),
+                              [int(s) for s in ent["canonical_shape"]],
+                              ent.get("tp"))
+    return arr
+
+
 # -- accounting ------------------------------------------------------------
 
 def state_bytes_per_replica(states, ndev=None):

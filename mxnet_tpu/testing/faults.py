@@ -84,7 +84,13 @@ per process — or inline on the consumer thread with 0): ``raise``
 surfaces as a typed error at the consumer's ``next()``, ``kill``
 hard-exits the worker so the consumer-side dead-worker detection must
 fire instead of hanging the ring, ``delay`` models slow decode.
-``data_service`` fires at the consumer's ``next()`` itself.
+``data_service`` fires at the consumer's ``next()`` itself.  The four
+``elastic_*`` sites cross the live-migration phases in order
+(``elastic_quiesce`` / ``elastic_rendezvous`` / ``elastic_reshard`` /
+``elastic_resume``, see ``parallel/elastic.py``): a ``raise`` at any of
+them must leave the job falling back to the last good checkpoint, a
+``kill`` must leave it resumable — the chaos matrix in
+``tests/test_elastic.py`` asserts exactly that at every phase.
 
 The parsed spec auto-refreshes when the env var string changes; call
 :func:`reset` to re-arm counters when reusing the same string (tests).
@@ -138,6 +144,16 @@ SITES = {
     "data_decode": "inside each data-service decode task (worker "
                    "process, or inline with num_workers=0)",
     "data_service": "data-service consumer next()",
+    "elastic_quiesce": "elastic migration quiesce phase, after the "
+                       "last-good checkpoint and before the in-memory "
+                       "state capture",
+    "elastic_rendezvous": "elastic migration re-form phase, before the "
+                          "bounded peer-heartbeat wait",
+    "elastic_reshard": "elastic migration reshard phase, before the "
+                       "captured windows move onto the new plan's "
+                       "layout",
+    "elastic_resume": "elastic migration resume phase, before the data "
+                      "service seeks back to the quiesce boundary",
 }
 
 

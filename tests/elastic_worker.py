@@ -1,0 +1,120 @@
+"""Workers for the slow two-process elastic-shrink test.
+
+Usage::
+
+    elastic_worker.py beat  <heartbeat_dir>
+    elastic_worker.py train <heartbeat_dir> <workdir>
+
+``beat`` plays rank 1 of a 2-worker world: it writes heartbeat beacons
+every ``MXNET_HEARTBEAT_INTERVAL_S`` until the parent SIGKILLs it.
+
+``train`` plays the surviving rank 0: it trains a deterministic MLP,
+waits until rank 1's beacon is live (prints ``READY`` — the parent's
+cue to kill the peer), then polls the :class:`ElasticCoordinator` until
+the stale heartbeat surfaces a dead-peer shrink event, migrates the
+live module down to a 1-worker world in memory, and prints the
+migration report as the last JSON line before exiting 0.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _beat(hb_dir):
+    from mxnet_tpu import health
+
+    rhb = health.RankHeartbeat(hb_dir, rank=1, num_workers=2)
+    rhb._beat()
+    print("READY", flush=True)
+    while True:
+        time.sleep(rhb.interval_s)
+        rhb._beat()
+
+
+def _train(hb_dir, workdir):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint as ckpt
+    from mxnet_tpu import health
+    from mxnet_tpu.parallel.elastic import ElasticCoordinator
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype("float32")
+    w = rs.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True, seed=42)
+
+    np.random.seed(7)
+    mx.random.seed(7)
+    mgr = ckpt.CheckpointManager(os.path.join(workdir, "ck"), prefix="m")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="adam",
+            optimizer_params={"learning_rate": 0.125}, checkpoint=mgr)
+
+    own = health.RankHeartbeat(hb_dir, rank=0, num_workers=2)
+    own._beat()
+    coord = ElasticCoordinator(
+        heartbeat_dir=hb_dir, num_workers=2, rank=0,
+        poll_interval_s=0.05, install_signal=False)
+
+    # sync point: don't declare readiness until the peer is truly live
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if not health.stale_peers(hb_dir, 2, self_rank=0):
+            break
+        time.sleep(0.05)
+    else:
+        print("peer never became live", flush=True)
+        return 1
+    print("READY", flush=True)
+
+    event = None
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        own._beat()
+        event = coord.poll()
+        if event is not None:
+            break
+        time.sleep(0.05)
+    if event is None:
+        print("no shrink event before the deadline", flush=True)
+        return 1
+    if event.source != "peers" or event.num_workers != 1:
+        print("unexpected event: %r" % event, flush=True)
+        return 1
+
+    report = coord.migrate(mod, event, epoch=1, nbatch=0, train_data=it,
+                           checkpoint=mgr)
+    # keep training after the shrink: the migrated world must be usable
+    mod.fit(it, num_epoch=2, begin_epoch=1, optimizer="adam",
+            optimizer_params={"learning_rate": 0.125})
+    print(json.dumps(report, default=str), flush=True)
+    return 0
+
+
+def main():
+    import worker_guard
+
+    worker_guard.install(float(os.environ.get("TEST_WORKER_TIMEOUT_S",
+                                              "150")))
+    mode, hb_dir = sys.argv[1], sys.argv[2]
+    if mode == "beat":
+        return _beat(hb_dir)
+    return _train(hb_dir, sys.argv[3])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -337,6 +337,55 @@ def test_fit_sigterm_resume_via_seek_bitexact(tmp_path, monkeypatch):
         np.testing.assert_array_equal(ref[k], res[k])
 
 
+def test_seek_epoch_final_boundary_and_position():
+    """``nbatch == steps_per_epoch`` is the LEGAL epoch-final batch
+    boundary (where an elastic quiesce or a preemption can land): the
+    seek succeeds, ``position()`` records it, the very next ``next()``
+    raises StopIteration, and the epoch roll continues the replayed
+    stream bit-exactly.  One past the boundary is still rejected."""
+    it = DataServiceIter(IndexLoader(32), 8, seed=3, num_workers=0)
+    assert it.position() == (0, 0)
+    it.seek(1, 4)                        # 32/8 == 4 steps per epoch
+    assert it.position() == (1, 4)
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()                           # the fit epoch-head roll
+    assert it.position() == (2, 0)
+    ref = DataServiceIter(IndexLoader(32), 8, seed=3, num_workers=0)
+    ref.seek(2, 0)
+    np.testing.assert_array_equal(_labels(it), _labels(ref))
+    with pytest.raises(MXNetError, match="out of range"):
+        it.seek(0, 5)
+
+
+def test_fit_resume_at_epoch_final_boundary_rolls_to_next_epoch(
+        tmp_path):
+    """A checkpoint recorded exactly at the epoch-final boundary
+    ``(epoch, steps_per_epoch)`` — the elastic quiesce form — must
+    resume by rolling into the next epoch and reproduce the
+    uninterrupted run bit-for-bit, not crash on an exhausted stream."""
+    from mxnet_tpu import checkpoint as ckpt
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype("float32")
+    w = rs.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    ref = _fit_service(2, X, y)
+
+    it = DataServiceIter(ArrayLoader(X, y), 8, seed=17, num_workers=0)
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    mgr = ckpt.CheckpointManager(str(tmp_path), prefix="m")
+    mgr.save(mod, epoch=0, nbatch=8)     # epoch-final: 64/8 == 8 steps
+
+    res = _fit_service(2, X, y, resume_from=mgr)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], res[k], err_msg=k)
+
+
 # -- chaos: decode-pool fault sites ------------------------------------
 
 @pytest.mark.chaos

@@ -1,0 +1,599 @@
+"""Live elasticity: the in-memory plan-migration control loop
+(``parallel/elastic.py``).
+
+Covers the full surface:
+
+* the scale-event manifest contract (atomic seq-ordered JSON, the
+  stdlib-only ``tools/launch.py --scale-event`` writer cross-checked
+  against the coordinator's reader),
+* ``poll()`` over all three event sources — manifest (seq latch, fires
+  once), SIGUSR1 (real signal delivery), dead peers via
+  ``health.stale_peers`` (contiguous-prefix shrink, never on an
+  unreadable local heartbeat dir),
+* the dp4 → tp2 x dp2 migration vs the disk-restore oracle: params,
+  Adam moments and ``num_update`` bit-exact at the boundary AND after
+  one more epoch of training on both sides; loss-scaler and fp8 amax
+  ``hstate`` preserved bit-exactly through the move,
+* the bounded rendezvous: ``ElasticRendezvousFailed`` names the phase
+  and the dead peers instead of hanging; shrink retires high ranks
+  through the ``TrainingPreempted`` path after the quiesce checkpoint,
+* the ``chaos`` matrix at every phase site — ``elastic_quiesce``,
+  ``elastic_rendezvous``, ``elastic_reshard``, ``elastic_resume``:
+  a ``raise`` mid-migration falls back to the last-good checkpoint and
+  training completes; a ``kill`` leaves the job resumable from the
+  quiesce anchor,
+* the fit-integration path (manifest event mid-fit → migrated in place,
+  update trajectory uninterrupted, ``migration-*.json`` artifact
+  rendered by ``tools/diagnose.py``),
+* the slow two-process → one-process shrink (``elastic_worker.py``):
+  SIGKILL a peer, the survivor detects the stale heartbeat, shrinks
+  and finishes.
+"""
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import worker_guard
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import health
+from mxnet_tpu.base import MXNetError, TrainingPreempted
+from mxnet_tpu.parallel import ParallelPlan, elastic
+from mxnet_tpu.parallel.elastic import (ElasticCoordinator,
+                                        ElasticRendezvousFailed,
+                                        ScaleEvent)
+from mxnet_tpu.testing import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+ELASTIC_SITES = ["elastic_quiesce", "elastic_rendezvous",
+                 "elastic_reshard", "elastic_resume"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    os.environ.pop("MXNET_FAULT_INJECT", None)
+    faults.reset()
+
+
+def _devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip("needs %d devices" % n)
+
+
+def _coord(**kw):
+    kw.setdefault("directory", None)
+    kw.setdefault("heartbeat_dir", None)
+    kw.setdefault("num_workers", 1)
+    kw.setdefault("rank", 0)
+    kw.setdefault("poll_interval_s", 0.0)
+    kw.setdefault("install_signal", False)
+    return ElasticCoordinator(**kw)
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _data_iter():
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype("float32")
+    w = rs.randn(8, 3).astype("float32")
+    y = (X @ w).argmax(axis=1).astype("float32")
+    return mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True, seed=42)
+
+
+def _fit(num_epoch, it=None, plan=None, mgr=None, coord=None, cb=None,
+         begin_epoch=0, **kw):
+    it = _data_iter() if it is None else it
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, begin_epoch=begin_epoch,
+            optimizer="adam", optimizer_params={"learning_rate": 0.125},
+            plan=plan, checkpoint=mgr, elastic=coord,
+            batch_end_callback=cb, **kw)
+    return mod, it
+
+
+def _continue_fit(mod, it, num_epoch, begin_epoch, **kw):
+    """One more fit call on a live (possibly migrated) module: bind /
+    init_params / init_optimizer all no-op, the live fused step and
+    optimizer continue; ``it`` must already sit at ``begin_epoch``."""
+    mod.fit(it, num_epoch=num_epoch, begin_epoch=begin_epoch,
+            optimizer="adam", optimizer_params={"learning_rate": 0.125},
+            **kw)
+    return mod
+
+
+def _params_np(mod):
+    arg, aux = mod.get_params()
+    out = {n: a.asnumpy() for n, a in arg.items()}
+    out.update({n: a.asnumpy() for n, a in aux.items()})
+    return out
+
+
+# -- scale-event manifest contract --------------------------------------
+
+def test_scale_event_roundtrip_and_seq(tmp_path):
+    d = str(tmp_path)
+    assert elastic.read_scale_event(d) is None
+    seq = elastic.write_scale_event(d, 4, plan="data=2,model=2",
+                                    reason="resize")
+    assert seq == 1
+    ev = elastic.read_scale_event(d)
+    assert ev.num_workers == 4 and ev.seq == 1
+    assert ev.source == "manifest" and ev.reason == "resize"
+    assert ev.resolve_plan().fingerprint() == \
+        ParallelPlan.parse("data=2,model=2").fingerprint()
+    # a ParallelPlan object serializes as its describe() dict
+    seq = elastic.write_scale_event(d, 2, plan=ParallelPlan(data=2))
+    assert seq == 2
+    ev = elastic.read_scale_event(d)
+    assert isinstance(ev.plan, dict)
+    assert ev.resolve_plan().fingerprint() == \
+        ParallelPlan(data=2).fingerprint()
+    # a plan-less event resolves to "keep the current plan"
+    elastic.write_scale_event(d, 2)
+    assert elastic.read_scale_event(d).resolve_plan() is None
+    # a foreign/corrupt file reads as no event, not an exception
+    with open(elastic.scale_event_path(d), "w") as f:
+        f.write("{not json")
+    assert elastic.read_scale_event(d) is None
+
+
+def test_launch_scale_event_writer_matches_reader(tmp_path, capsys):
+    """tools/launch.py --scale-event is a stdlib-only second writer of
+    the manifest schema; the coordinator's reader must accept it and
+    the seq counters must interleave."""
+    spec = importlib.util.spec_from_file_location(
+        "launch", os.path.join(HERE, "..", "tools", "launch.py"))
+    launch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch)
+    d = str(tmp_path)
+    rc = launch.emit_scale_event(d, 2, plan="data=2,zero=off",
+                                 reason="scale down")
+    assert rc == 0
+    ev = elastic.read_scale_event(d)
+    assert ev.num_workers == 2 and ev.seq == 1
+    assert ev.reason == "scale down"
+    assert ev.resolve_plan().fingerprint() == \
+        ParallelPlan.parse("data=2,zero=off").fingerprint()
+    # both writers advance the same counter
+    assert elastic.write_scale_event(d, 4) == 2
+    launch.emit_scale_event(d, 8)
+    assert elastic.read_scale_event(d).seq == 3
+    # the CLI surface: --scale-event requires --elastic-dir and exits 0
+    rc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "..", "tools", "launch.py"),
+         "-n", "2", "--scale-event", "--elastic-dir", d, "--plan",
+         "data=2,zero=off"],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    assert elastic.read_scale_event(d).seq == 4
+
+
+# -- poll(): the three event sources ------------------------------------
+
+def test_poll_manifest_latches_preexisting_and_fires_once(tmp_path):
+    d = str(tmp_path)
+    elastic.write_scale_event(d, 4, reason="stale leftover")
+    coord = _coord(directory=d)
+    # the pre-existing manifest was latched at construction
+    assert coord.poll() is None
+    elastic.write_scale_event(d, 2, reason="grow")
+    ev = coord.poll()
+    assert ev is not None and ev.num_workers == 2 and ev.seq == 2
+    # fires exactly once per distinct seq
+    assert coord.poll() is None
+
+
+def test_poll_throttles_between_filesystem_looks(tmp_path):
+    d = str(tmp_path)
+    coord = _coord(directory=d, poll_interval_s=3600.0)
+    assert coord.poll() is None          # first look latches the clock
+    elastic.write_scale_event(d, 2)
+    assert coord.poll() is None          # throttled: no filesystem look
+    coord._last_poll = float("-inf")
+    assert coord.poll() is not None      # next interval sees it
+
+
+def test_poll_sigusr1_real_signal(tmp_path):
+    coord = ElasticCoordinator(directory=None, heartbeat_dir=None,
+                               num_workers=2, rank=0,
+                               poll_interval_s=3600.0,
+                               install_signal=True)
+    try:
+        assert coord._signal_installed
+        os.kill(os.getpid(), signal.SIGUSR1)
+        ev = coord.poll()                # a latched signal skips throttle
+        assert ev is not None and ev.source == "signal"
+        assert ev.num_workers == 2 and ev.resolve_plan() is None
+        assert coord.poll() is None
+    finally:
+        coord.close()
+    assert not coord._signal_installed
+
+
+def test_poll_dead_peer_shrinks_to_live_prefix(tmp_path):
+    d = str(tmp_path)
+    health.RankHeartbeat(d, rank=0, num_workers=3, interval_s=30)._beat()
+    coord = _coord(heartbeat_dir=d, num_workers=3, rank=0)
+    ev = coord.poll()
+    assert ev is not None and ev.source == "peers"
+    assert ev.num_workers == 1           # ranks 1 and 2 never wrote
+    assert "rank 1" in ev.reason and "never wrote" in ev.reason
+    # the same dead set does not re-fire
+    assert coord.poll() is None
+
+
+def test_poll_unreadable_heartbeat_dir_never_shrinks(tmp_path,
+                                                     monkeypatch,
+                                                     caplog):
+    import logging
+
+    monkeypatch.setattr(
+        health, "stale_peers",
+        lambda *a, **kw: health.PeerScan(error="mount gone"))
+    coord = _coord(heartbeat_dir=str(tmp_path), num_workers=4, rank=0)
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.parallel.elastic"):
+        assert coord.poll() is None
+        assert coord.poll() is None
+    warns = [r for r in caplog.records
+             if "not shrinking" in r.getMessage()]
+    assert len(warns) == 1               # warned once, then quiet
+
+
+def test_maybe_coordinator_resolution(monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC", raising=False)
+    assert elastic.maybe_coordinator(None) is None
+    c = _coord()
+    assert elastic.maybe_coordinator(c) is c
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    auto = elastic.maybe_coordinator(None)
+    try:
+        assert isinstance(auto, ElasticCoordinator)
+    finally:
+        auto.close()
+
+
+# -- the migration vs the disk-restore oracle ---------------------------
+
+def test_migration_dp4_to_tp2dp2_bit_exact_vs_disk_oracle(tmp_path):
+    """The acceptance oracle: quiesce a dp4 run at an epoch boundary,
+    migrate in memory to tp2 x dp2, and compare against a cold restore
+    of the quiesce checkpoint onto the same new plan — params, Adam
+    moments (transitively, via continued training), ``num_update`` and
+    the dynamic loss-scaler hstate all bit-exact, no disk read on the
+    live side."""
+    _devices(4)
+    d = str(tmp_path / "ck")
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mod, it = _fit(1, plan="data=4,zero=off", mgr=mgr,
+                   loss_scale="dynamic")
+    hstate_before = mod._fused.export_hstate()
+    assert hstate_before is not None and "loss_scale" in hstate_before
+    nup = mod._optimizer.num_update
+    assert nup == 8
+
+    coord = _coord()
+    report = coord.migrate(
+        mod, ScaleEvent(num_workers=1, plan="data=2,model=2,zero=off"),
+        epoch=1, nbatch=0, train_data=it, checkpoint=mgr)
+    assert report["outcome"] == "migrated"
+    assert report["num_update"] == nup
+    assert report["old_plan"]["fingerprint"] != \
+        report["new_plan"]["fingerprint"]
+    assert mod._plan.fingerprint() == \
+        ParallelPlan.parse("data=2,model=2,zero=off").fingerprint()
+    for k in ("quiesce_s", "rendezvous_s", "reshard_s", "resume_s"):
+        assert report["phases"][k] >= 0.0
+    assert report["downtime_s"] >= sum(report["phases"].values()) * 0.5
+
+    # hstate (loss scale, good-step streak) moved bit-exactly
+    hstate_after = mod._fused.export_hstate()
+    assert sorted(hstate_after) == sorted(hstate_before)
+    for k in hstate_before:
+        np.testing.assert_array_equal(np.asarray(hstate_before[k]),
+                                      np.asarray(hstate_after[k]),
+                                      err_msg=k)
+    assert mod._optimizer.num_update == nup
+
+    # boundary oracle: the quiesce checkpoint holds the same params
+    state = ckpt.CheckpointManager(d, prefix="m").load()
+    assert state.epoch == 1 and state.num_update == nup
+    live = _params_np(mod)
+    for k, v in state.arg_params.items():
+        np.testing.assert_array_equal(v.asnumpy(), live[k], err_msg=k)
+
+    # trajectory oracle: one more epoch live vs a cold resume of the
+    # same checkpoint onto the same new plan — bit-exact parameters
+    # (pins the Adam moments and the update counters transitively)
+    _continue_fit(mod, it, num_epoch=2, begin_epoch=1)
+    migrated = _params_np(mod)
+    np.random.seed(7)
+    mx.random.seed(7)
+    oracle = mx.mod.Module(_mlp(), context=mx.cpu())
+    oracle.fit(_data_iter(), num_epoch=2, optimizer="adam",
+               optimizer_params={"learning_rate": 0.125},
+               plan="data=2,model=2,zero=off", loss_scale="dynamic",
+               resume_from=ckpt.CheckpointManager(d, prefix="m"))
+    cold = _params_np(oracle)
+    assert sorted(migrated) == sorted(cold)
+    for k in migrated:
+        np.testing.assert_array_equal(migrated[k], cold[k], err_msg=k)
+    assert mod._optimizer.num_update == oracle._optimizer.num_update == 16
+
+
+def test_migration_preserves_fp8_amax_history(tmp_path, monkeypatch):
+    """fp8 delayed scaling rides the carried hstate: the per-site amax
+    history must cross the migration bit-exactly (site count is
+    topology-independent) and keep accumulating afterwards."""
+    _devices(4)
+    monkeypatch.setenv("MXNET_FP8", "on")
+    mod, it = _fit(1, plan="data=4,zero=off")
+    h = mod._fused.export_hstate()
+    assert h is not None and "fp8_hist" in h
+    hist_before = np.asarray(h["fp8_hist"]).copy()
+    assert np.abs(hist_before).sum() > 0   # a trained history, not init
+
+    coord = _coord()
+    coord.migrate(mod, ScaleEvent(num_workers=1, plan="data=2,zero=off"),
+                  epoch=1, nbatch=0, train_data=it)
+    h2 = mod._fused.export_hstate()
+    np.testing.assert_array_equal(hist_before,
+                                  np.asarray(h2["fp8_hist"]))
+    assert mod._fused._fp8_sites == hist_before.shape[0]
+
+    _continue_fit(mod, it, num_epoch=2, begin_epoch=1)
+    h3 = mod._fused.export_hstate()
+    assert not np.array_equal(hist_before, np.asarray(h3["fp8_hist"]))
+
+
+# -- rendezvous bounds + shrink retirement ------------------------------
+
+def test_rendezvous_timeout_names_phase_and_dead_peers(tmp_path):
+    d = str(tmp_path)
+    health.RankHeartbeat(d, rank=0, num_workers=2, interval_s=30)._beat()
+    coord = _coord(heartbeat_dir=d, num_workers=2, rank=0,
+                   timeout_s=0.3, poll_interval_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(ElasticRendezvousFailed) as ei:
+        coord._rendezvous(ScaleEvent(num_workers=2))
+    assert time.monotonic() - t0 < 30.0   # bounded, not a hang
+    err = ei.value
+    assert err.phase == "rendezvous"
+    assert err.dead_peers == [1]
+    assert "timed out after" in str(err)
+    assert "never wrote a heartbeat" in str(err)
+    # a 1-way world (or no heartbeat dir) re-forms trivially
+    coord._rendezvous(ScaleEvent(num_workers=1))
+    _coord(num_workers=2)._rendezvous(ScaleEvent(num_workers=2))
+
+
+def test_rendezvous_unreadable_dir_fails_typed(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        health, "stale_peers",
+        lambda *a, **kw: health.PeerScan(error="mount gone"))
+    coord = _coord(heartbeat_dir=str(tmp_path), num_workers=2,
+                   timeout_s=30.0)
+    with pytest.raises(ElasticRendezvousFailed, match="mount gone"):
+        coord._rendezvous(ScaleEvent(num_workers=2))
+
+
+def test_shrink_retires_high_rank_after_quiesce_checkpoint(tmp_path):
+    d = str(tmp_path / "ck")
+    mgr = ckpt.CheckpointManager(d, prefix="m")
+    mod, it = _fit(1)
+    coord = _coord(num_workers=2, rank=1)
+    with pytest.raises(TrainingPreempted, match="retired by elastic"):
+        coord.migrate(mod, ScaleEvent(num_workers=1), epoch=1, nbatch=0,
+                      train_data=it, checkpoint=mgr)
+    # the handoff checkpoint was written before the rank retired
+    assert mgr.latest() is not None
+    assert ckpt.CheckpointManager(d, prefix="m").load().epoch == 1
+
+
+# -- chaos: every phase, both fault shapes ------------------------------
+
+def _event_writer(elastic_dir, plan):
+    """A batch_end_callback that publishes one scale event at epoch 1,
+    batch 2 — after the epoch-0 checkpoint exists (the fallback
+    anchor for a quiesce-phase fault)."""
+    fired = []
+
+    def cb(param):
+        if param.epoch == 1 and param.nbatch == 2 and not fired:
+            fired.append(True)
+            elastic.write_scale_event(elastic_dir, 1, plan=plan,
+                                      reason="chaos probe")
+    return cb
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ELASTIC_SITES)
+def test_chaos_raise_falls_back_and_training_completes(tmp_path,
+                                                       monkeypatch,
+                                                       site):
+    """A fault raised inside any migration phase must roll back to the
+    last-good checkpoint and KEEP TRAINING — never a wedged or dead
+    fit.  The fallback is recorded in the coordinator's event trail."""
+    _devices(4)
+    guard = worker_guard.install(300)
+    try:
+        ed = str(tmp_path / "elastic")
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), prefix="m")
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "%s:raise" % site)
+        faults.reset()
+        coord = _coord(directory=ed)
+        mod, _ = _fit(3, plan="data=4,zero=off", mgr=mgr, coord=coord,
+                      cb=_event_writer(ed, "data=2,model=2,zero=off"))
+        assert coord.events, "the scale event was never polled"
+        last = coord.events[-1]
+        assert last["outcome"] == "fallback"
+        assert "FaultInjected" in last["error"]
+        assert last["epoch"] == 1
+        # faults up to and including the reshard site fire BEFORE the
+        # plan flips, so the fallback trains on under the old plan; a
+        # resume-phase fault lands after the reshard and the restored
+        # trajectory legitimately continues on the new plan
+        want = "data=2,model=2,zero=off" if site == "elastic_resume" \
+            else "data=4,zero=off"
+        assert mod._plan.fingerprint() == \
+            ParallelPlan.parse(want).fingerprint()
+        assert mod._optimizer.num_update > 8
+    finally:
+        guard.cancel()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ELASTIC_SITES)
+def test_chaos_kill_leaves_job_resumable(tmp_path, monkeypatch, site):
+    """A hard kill (WorkerKilled is a BaseException: no fallback path
+    can swallow it) mid-migration must leave a loadable checkpoint —
+    the job restarts from the quiesce anchor (or the epoch boundary)
+    and finishes."""
+    _devices(4)
+    guard = worker_guard.install(300)
+    try:
+        ed = str(tmp_path / "elastic")
+        d = str(tmp_path / "ck")
+        mgr = ckpt.CheckpointManager(d, prefix="m")
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "%s:kill" % site)
+        faults.reset()
+        coord = _coord(directory=ed)
+        with pytest.raises(faults.WorkerKilled):
+            _fit(3, plan="data=4,zero=off", mgr=mgr, coord=coord,
+                 cb=_event_writer(ed, "data=2,model=2,zero=off"))
+        monkeypatch.delenv("MXNET_FAULT_INJECT")
+        faults.reset()
+
+        # resumable: a checkpoint exists and a fresh process continues
+        state = ckpt.CheckpointManager(d, prefix="m").load()
+        assert state is not None
+        np.random.seed(7)
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(_data_iter(), num_epoch=3, optimizer="adam",
+                optimizer_params={"learning_rate": 0.125},
+                plan="data=4,zero=off",
+                resume_from=ckpt.CheckpointManager(d, prefix="m"))
+        assert mod._optimizer.num_update == 24
+    finally:
+        guard.cancel()
+
+
+# -- fit integration + artifact trail -----------------------------------
+
+def test_fit_migrates_on_manifest_event_and_writes_artifact(
+        tmp_path, monkeypatch, capsys):
+    _devices(4)
+    hd = str(tmp_path / "health")
+    monkeypatch.setenv("MXNET_HEALTH_DIR", hd)
+    ed = str(tmp_path / "elastic")
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), prefix="m")
+    coord = _coord(directory=ed)
+    mod, _ = _fit(3, plan="data=4,zero=off", mgr=mgr, coord=coord,
+                  cb=_event_writer(ed, "data=2,model=2,zero=off"))
+    assert coord.events and coord.events[-1]["outcome"] == "migrated"
+    rep = coord.events[-1]
+    assert rep["epoch"] == 1 and rep["source"] == "manifest"
+    assert mod._plan.fingerprint() == \
+        ParallelPlan.parse("data=2,model=2,zero=off").fingerprint()
+    # the migration re-seeked to its own boundary: no lost or repeated
+    # updates across the whole 3-epoch run
+    assert mod._optimizer.num_update == 24
+
+    # artifact exists and tools/diagnose.py renders it
+    path = rep.get("artifact")
+    assert path and os.path.dirname(path) == hd and os.path.exists(path)
+    with open(path) as f:
+        assert json.load(f)["kind"] == "mxnet_tpu-migration-event"
+    spec = importlib.util.spec_from_file_location(
+        "diagnose", os.path.join(HERE, "..", "tools", "diagnose.py"))
+    diagnose = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(diagnose)
+    assert diagnose.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "MIGRATION EVENT" in out and "migrated" in out
+    assert "downtime" in out
+
+
+def test_record_fallback_artifact_shape(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_DIR", str(tmp_path))
+    coord = _coord()
+    ev = ScaleEvent(num_workers=2, reason="why", source="peers")
+    rep = coord.record_fallback(ev, RuntimeError("boom"), epoch=2,
+                                nbatch=5)
+    assert rep["outcome"] == "fallback" and rep["error"].endswith("boom")
+    assert rep["source"] == "peers" and rep["epoch"] == 2
+    assert os.path.exists(rep["artifact"])
+
+
+# -- slow: real two-process shrink --------------------------------------
+
+@pytest.mark.slow
+def test_two_process_shrink_to_one(tmp_path):
+    """Kill a live peer: the survivor's coordinator must detect the
+    stale heartbeat, shrink the world to the live prefix, migrate in
+    memory and finish — no hang, exit 0, the artifact names the dead
+    rank."""
+    env = {**os.environ}
+    for k in ("MXNET_FAULT_INJECT", "MXNET_PLAN", "MXNET_ELASTIC",
+              "XLA_FLAGS"):
+        env.pop(k, None)
+    env["MXNET_HEARTBEAT_INTERVAL_S"] = "0.1"
+    env["MXNET_HEARTBEAT_STALE_S"] = "1.0"
+    env["TEST_WORKER_TIMEOUT_S"] = "150"
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    worker = os.path.join(HERE, "elastic_worker.py")
+
+    beat = subprocess.Popen(
+        [sys.executable, worker, "beat", hb], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    train = None
+    try:
+        assert "READY" in beat.stdout.readline()
+        train = subprocess.Popen(
+            [sys.executable, worker, "train", hb, str(tmp_path)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        # the trainer confirms it sees a 2-worker world before the kill
+        for line in train.stdout:
+            if "READY" in line:
+                break
+        else:
+            pytest.fail("trainer never became ready")
+        os.kill(beat.pid, signal.SIGKILL)
+        out, _ = train.communicate(timeout=150)
+        assert train.returncode == 0, out
+        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        assert lines, out
+        report = json.loads(lines[-1])
+        assert report["outcome"] == "migrated"
+        assert report["source"] == "peers"
+        assert report["num_workers"] == [2, 1]
+        assert "rank 1" in report["reason"]
+    finally:
+        for proc in (beat, train):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()

@@ -556,6 +556,69 @@ def test_peer_report_and_maybe_start(tmp_path, monkeypatch):
     hb.stop()
 
 
+def test_heartbeat_write_failure_warns_once_then_recovers(tmp_path,
+                                                          caplog):
+    """A persistently failing heartbeat write (full disk, lost mount)
+    must not spam one warning per beat: the transition logs once at
+    WARNING, repeats drop to DEBUG, and recovery announces itself."""
+    import logging
+
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("x")              # open() under a file: OSError
+    hb = health.RankHeartbeat(str(blocker), rank=0, num_workers=2,
+                              interval_s=30)
+    with caplog.at_level(logging.DEBUG, logger="mxnet_tpu"):
+        for _ in range(3):
+            hb._beat()                   # never raises
+        warns = [r for r in caplog.records
+                 if r.levelno == logging.WARNING
+                 and "heartbeat write failed" in r.getMessage()]
+        assert len(warns) == 1
+        debugs = [r for r in caplog.records
+                  if r.levelno == logging.DEBUG
+                  and "still failing" in r.getMessage()]
+        assert len(debugs) == 2
+
+        caplog.clear()
+        hb.directory = str(tmp_path)     # writes start landing again
+        hb._beat()
+        hb._beat()
+        recovered = [r for r in caplog.records
+                     if "heartbeat writes recovered" in r.getMessage()]
+        assert len(recovered) == 1
+    assert os.path.exists(
+        health.RankHeartbeat.path_for(str(tmp_path), 0))
+
+
+def test_stale_peers_unreadable_dir_is_typed_empty(tmp_path,
+                                                   monkeypatch):
+    """A heartbeat directory that exists but cannot be listed is a
+    LOCAL failure: ``stale_peers`` returns a typed empty scan (never a
+    list blaming every peer) and ``peer_report`` says 'unknown', so an
+    elastic shrink or a timeout diagnosis cannot evict healthy ranks
+    over a lost mount."""
+    d = str(tmp_path)
+    health.RankHeartbeat(d, rank=1, num_workers=2)._beat()
+    real_listdir = os.listdir
+
+    def deny(path="."):
+        if os.path.abspath(str(path)) == os.path.abspath(d):
+            raise PermissionError(13, "Permission denied", str(path))
+        return real_listdir(path)
+
+    monkeypatch.setattr(os, "listdir", deny)
+    scan = health.stale_peers(d, 2, self_rank=0)
+    assert list(scan) == []
+    assert scan.unreadable and "unreadable" in scan.error
+    monkeypatch.setenv("MXNET_HEARTBEAT_DIR", d)
+    rep = health.peer_report(2, self_rank=0)
+    assert "peer heartbeats unknown" in rep
+    assert "dead/stale" not in rep
+    # readable again: the same surface names the live/dead peers
+    monkeypatch.setattr(os, "listdir", real_listdir)
+    assert not health.stale_peers(d, 2, stale_s=100, self_rank=0)
+
+
 def test_run_bounded_timeout_includes_peer_diagnosis():
     from mxnet_tpu.kvstore import _run_bounded
 

@@ -6,13 +6,17 @@ Usage::
     python tools/diagnose.py <file-or-dir> [...]
     python tools/diagnose.py            # scans $MXNET_HEALTH_DIR / tmpdir
 
-Understands the two JSON artifact kinds the sentinel writes:
+Understands the three JSON artifact kinds the sentinel writes:
 
 * ``watchdog-<pid>-<time>.json`` — the StepWatchdog's all-thread stack
   dump plus the last HealthMonitor snapshot, written when a training
   step stalls past ``MXNET_STEP_TIMEOUT_S``.
 * ``heartbeat_rank<k>.json`` — per-rank liveness beacons under
   ``MXNET_HEARTBEAT_DIR``.
+* ``migration-<pid>-<n>.json`` — live-elasticity migration events
+  (``mxnet_tpu.parallel.elastic``): old/new plan fingerprints,
+  per-phase wall times and total ``downtime_s``, or the error a failed
+  migration fell back to its checkpoint with.
 
 Stdlib only: this must run on the stripped coordinator image where the
 training venv is gone but the dump survived.
@@ -66,6 +70,35 @@ def print_heartbeat(path, payload, now=None):
              _fmt_time(payload.get("time")), age, path))
 
 
+def print_migration(path, payload):
+    print("=" * 72)
+    print("MIGRATION EVENT  %s" % path)
+    old = payload.get("old_plan") or {}
+    new = payload.get("new_plan") or {}
+    nw = payload.get("num_workers") or ["?", "?"]
+    print("  rank %s: %s -> %s (%s -> %s workers), %s via %r"
+          % (payload.get("rank", "?"),
+             old.get("fingerprint") or "<no plan>",
+             new.get("fingerprint") or "<no plan>",
+             nw[0], nw[1], payload.get("outcome", "?"),
+             payload.get("source", "?")))
+    if payload.get("reason"):
+        print("  reason: %s" % payload["reason"])
+    print("  boundary: epoch %s batch %s (num_update %s)"
+          % (payload.get("epoch", "?"), payload.get("nbatch", "?"),
+             payload.get("num_update", "?")))
+    phases = payload.get("phases") or {}
+    for key in ("quiesce_s", "rendezvous_s", "reshard_s", "resume_s"):
+        if key in phases:
+            print("  %-13s %8.1f ms"
+                  % (key[:-2], float(phases[key]) * 1e3))
+    if payload.get("downtime_s") is not None:
+        print("  downtime      %8.1f ms"
+              % (float(payload["downtime_s"]) * 1e3))
+    if payload.get("error"):
+        print("  error: %s" % payload["error"])
+
+
 def diagnose_file(path):
     """Returns True when the file was a recognized artifact."""
     try:
@@ -80,6 +113,9 @@ def diagnose_file(path):
     if payload.get("kind") == "mxnet_tpu-watchdog-dump":
         print_watchdog(path, payload)
         return True
+    if payload.get("kind") == "mxnet_tpu-migration-event":
+        print_migration(path, payload)
+        return True
     if name.startswith("heartbeat_rank") and "rank" in payload:
         print_heartbeat(path, payload)
         return True
@@ -89,7 +125,8 @@ def diagnose_file(path):
 def gather(target):
     if os.path.isdir(target):
         found = (glob.glob(os.path.join(target, "watchdog-*.json"))
-                 + glob.glob(os.path.join(target, "heartbeat_rank*.json")))
+                 + glob.glob(os.path.join(target, "heartbeat_rank*.json"))
+                 + glob.glob(os.path.join(target, "migration-*.json")))
         return sorted(found)
     return [target]
 
@@ -108,13 +145,14 @@ def main(argv=None):
     for target in targets:
         files = gather(target)
         if not files:
-            print("%s: no watchdog/heartbeat artifacts" % target,
+            print("%s: no watchdog/heartbeat/migration artifacts" % target,
                   file=sys.stderr)
         for path in files:
             shown += diagnose_file(path)
     if not shown:
-        print("nothing recognized — expected watchdog-*.json or "
-              "heartbeat_rank*.json (see docs/health_monitoring.md)",
+        print("nothing recognized — expected watchdog-*.json, "
+              "heartbeat_rank*.json or migration-*.json (see "
+              "docs/health_monitoring.md)",
               file=sys.stderr)
         return 1
     return 0

@@ -25,6 +25,12 @@ collective.
     python tools/launch.py -n 16 --launcher gke --gke-image IMG \
         --gke-output job.yaml python train.py ...
 
+    # live elasticity: tell a RUNNING job (launched with --elastic-dir)
+    # to re-form at a new size/plan without restarting — workers polling
+    # the manifest migrate in memory (mxnet_tpu.parallel.elastic)
+    python tools/launch.py -n 2 --scale-event --elastic-dir /shared/el \
+        --plan data=2
+
 Workers read MXNET_COORDINATOR / MXNET_NUM_WORKERS / MXNET_WORKER_ID and
 call ``mxnet_tpu.parallel.init_distributed()`` (or pass them straight to
 ``jax.distributed.initialize``).  On real TPU pods the runtime provides
@@ -79,19 +85,52 @@ def _wait_propagating(procs, poll_s=0.2):
     return rc
 
 
-def _worker_env(env, coordinator, num_workers, rank):
-    return dict(env,
-                MXNET_COORDINATOR=coordinator,
-                MXNET_NUM_WORKERS=str(num_workers),
-                MXNET_WORKER_ID=str(rank))
+def _worker_env(env, coordinator, num_workers, rank, elastic_dir=None):
+    out = dict(env,
+               MXNET_COORDINATOR=coordinator,
+               MXNET_NUM_WORKERS=str(num_workers),
+               MXNET_WORKER_ID=str(rank))
+    if elastic_dir:
+        out["MXNET_ELASTIC_DIR"] = elastic_dir
+    return out
 
 
-def launch_local(num_workers, command, env):
+def launch_local(num_workers, command, env, elastic_dir=None):
     coordinator = "127.0.0.1:%d" % _free_port()
     procs = [subprocess.Popen(
-        command, env=_worker_env(env, coordinator, num_workers, rank))
+        command, env=_worker_env(env, coordinator, num_workers, rank,
+                                 elastic_dir=elastic_dir))
         for rank in range(num_workers)]
     return _wait_propagating(procs)
+
+
+def emit_scale_event(directory, num_workers, plan=None, reason=""):
+    """Publish a live-elasticity scale event for running workers to poll
+    (``mxnet_tpu.parallel.elastic``): atomic rename of
+    ``<dir>/scale_event.json`` with a monotonically increasing ``seq``.
+    Deliberately stdlib-only and schema-identical to
+    ``elastic.write_scale_event`` — the JSON file IS the contract, the
+    same way the gke manifest is."""
+    import json
+
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, "scale_event.json")
+    seq = 0
+    try:
+        with open(path) as f:
+            seq = int(json.load(f).get("seq", 0))
+    except (OSError, ValueError):
+        pass
+    payload = {"seq": seq + 1, "num_workers": int(num_workers),
+               "plan": plan or None, "reason": reason}
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    print("launch.py: published scale event seq %d (%d workers%s) to %s"
+          % (payload["seq"], num_workers,
+             ", plan %s" % plan if plan else "", path))
+    return 0
 
 
 def _read_hosts(hostfile, num_workers):
@@ -249,8 +288,28 @@ def main():
     ap.add_argument("--gke-tpu-per-pod", type=int, default=4)
     ap.add_argument("--gke-output", default=None,
                     help="write the Job manifest here (default: stdout)")
+    ap.add_argument("--elastic-dir", default=None,
+                    help="shared directory for live-elasticity scale "
+                         "events; exported to workers as "
+                         "MXNET_ELASTIC_DIR (see docs/fault_tolerance.md "
+                         "'Live elasticity')")
+    ap.add_argument("--scale-event", action="store_true",
+                    help="instead of launching, publish a scale event to "
+                         "--elastic-dir telling a RUNNING elastic job to "
+                         "re-form at -n workers (optionally --plan)")
+    ap.add_argument("--plan", default=None,
+                    help="new parallel plan spec for --scale-event, e.g. "
+                         "'data=2,model=2'")
+    ap.add_argument("--scale-reason", default="launch.py --scale-event",
+                    help="reason string recorded in the scale event")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if args.scale_event:
+        if not args.elastic_dir:
+            raise SystemExit("--scale-event needs --elastic-dir")
+        sys.exit(emit_scale_event(args.elastic_dir, args.num_workers,
+                                  plan=args.plan,
+                                  reason=args.scale_reason))
     if getattr(args, "num_servers", 0):
         print("WARNING: -s/--num-servers ignored: dist_tpu_sync is SPMD "
               "(no parameter servers); launching workers only",
@@ -258,8 +317,13 @@ def main():
     if not args.command:
         raise SystemExit("no command given")
     env = dict(os.environ)
+    if args.elastic_dir:
+        # ssh/tpu-vm inject via the MXNET_* passthrough; local via
+        # _worker_env
+        env["MXNET_ELASTIC_DIR"] = args.elastic_dir
     if args.launcher == "local":
-        sys.exit(launch_local(args.num_workers, args.command, env))
+        sys.exit(launch_local(args.num_workers, args.command, env,
+                              elastic_dir=args.elastic_dir))
     if args.launcher == "gke":
         if not args.gke_image:
             raise SystemExit("--launcher gke needs --gke-image")
