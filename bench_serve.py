@@ -57,6 +57,14 @@ Also certifies the serving acceptance criteria directly in the JSON:
                            ``followup`` hook holds concurrency constant)
                            under a TTFT budget: goodput-under-SLO and
                            SLO attainment.
+* ``soak_*``             — replicated-serving chaos soak
+                           (``serve.ReplicaSet``, 3 replicas): one
+                           replica chaos-killed mid-traffic, asserting
+                           zero lost requests, bit-exact survivor
+                           streams vs the fault-free baseline, typed
+                           shed accounting, goodput >= 60% of baseline,
+                           and the per-replica executable count frozen
+                           across death + failover.
 * ``compile_report``     — ``compile_cache.write_artifact`` path for
                            the serving executable set
                            (pretty-print: ``tools/compile_report.py``).
@@ -598,6 +606,97 @@ def measure(argv=None):
     _RESULT["closed_loop_ttft_p99_s"] = round(summary["ttft_p99_s"], 5)
     _RESULT["closed_loop_tokens_per_sec"] = round(
         summary["tokens_per_sec"], 1)
+
+    # -- replicated-serving chaos soak -----------------------------------
+    # Three identical replicas (replica 0 IS the main session) behind
+    # the ReplicaSet dispatcher; one replica is chaos-killed mid-traffic
+    # and stays dead (huge rejoin backoff), so the survivors absorb its
+    # in-flight work through the park/resume failover path.  Acceptance,
+    # asserted here and recorded in the JSON: zero lost requests,
+    # completed streams bit-identical to the fault-free baseline run,
+    # shed requests typed and accounted, goodput >= 60% of the baseline
+    # (proportional to the capacity that survived), and the per-replica
+    # executable count frozen across death + failover.
+    from mxnet_tpu.testing import faults as _faults
+
+    soak_sessions = [sess] + [
+        serve.InferenceSession(params, num_heads=cfg.num_heads,
+                               config=sconf) for _ in range(2)]
+    soak_n = max(3 * n_requests // 2, 24)
+    soak_trace = _poisson_trace(soak_n, mean_gap_s=0.002,
+                                prompt_lens=(9, 14), max_new=8, seed=11)
+
+    def _soak_run():
+        rs_set = serve.ReplicaSet(sessions=soak_sessions,
+                                  rejoin_backoff_s=1e9)
+        done, makespan = rs_set.run(
+            [serve.Request(**spec) for spec in soak_trace])
+        return rs_set, done, makespan, serve.summarize(done, makespan)
+
+    # fault-free baseline: the goodput bar's denominator and the
+    # bit-exactness oracle
+    _, base_done, base_makespan, base_sum = _soak_run()
+    assert base_sum["failed"] == 0 and base_sum["completed"] == soak_n
+    soak_oracle = {r.rid: list(r.tokens) for r in base_done}
+    base_rps = base_sum["completed"] / max(base_makespan, 1e-9)
+
+    import os as _os
+    _os.environ["MXNET_FAULT_INJECT"] = "serve_replica_kill:kill:after=16"
+    _faults.reset()
+    try:
+        rs_set, done, makespan, soak_sum = _soak_run()
+    finally:
+        del _os.environ["MXNET_FAULT_INJECT"]
+        _faults.reset()
+    _RESULT["soak_replicas"] = 3
+    _RESULT["soak_requests"] = soak_n
+    _RESULT["soak_deaths"] = rs_set.counters["deaths"]
+    _RESULT["soak_failover_requests"] = rs_set.counters["failover_requests"]
+    _RESULT["soak_resumes"] = soak_sum["resumes"]
+    _RESULT["soak_shed"] = soak_sum["shed"]
+    _RESULT["soak_completed"] = soak_sum["completed"]
+    assert rs_set.counters["deaths"] == 1
+    # zero lost: every request either completed or was shed TYPED —
+    # nothing vanished with the dead replica
+    _RESULT["soak_zero_lost"] = (
+        soak_sum["completed"] + soak_sum["shed"] == soak_n
+        and soak_sum["faulted"] == 0)
+    assert _RESULT["soak_zero_lost"], \
+        "soak lost requests: %r" % {k: soak_sum[k] for k in
+                                    ("completed", "shed", "faulted")}
+    assert all(("ServeOverloaded" in r.error) for r in done if r.failed)
+    # completed streams bit-identical to the never-failed baseline
+    _RESULT["soak_bitexact"] = all(
+        soak_oracle[r.rid] == r.tokens for r in done if not r.failed)
+    assert _RESULT["soak_bitexact"], "failover streams drifted"
+    # goodput degrades no worse than the capacity lost: one of three
+    # replicas died mid-run, so >= 60% of baseline must survive
+    soak_rps = soak_sum["completed"] / max(makespan, 1e-9)
+    _RESULT["soak_baseline_rps"] = round(base_rps, 2)
+    _RESULT["soak_chaos_rps"] = round(soak_rps, 2)
+    _RESULT["soak_goodput_ratio"] = round(soak_rps / max(base_rps, 1e-9), 3)
+    assert _RESULT["soak_goodput_ratio"] >= 0.6, \
+        "soak goodput %.2f below 60%% of baseline" \
+        % _RESULT["soak_goodput_ratio"]
+    # executables stay frozen per replica across death + failover
+    _RESULT["soak_executables_per_replica"] = rs_set.executables_per_replica()
+    assert rs_set.executables_per_replica() \
+        == [len(sconf.buckets) + 1] * 3, "soak minted executables"
+    assert all(s.fallback_count() == 0 for s in soak_sessions)
+    _RESULT["soak_incident"] = rs_set.incident_path
+
+    # deterministic overload probe: a 2-deep admission queue under the
+    # same burst must shed typed, with the accounting closed
+    rs_over = serve.ReplicaSet(sessions=soak_sessions[1:], queue_cap=2)
+    odone, omakespan = rs_over.run(
+        [serve.Request(**spec) for spec in soak_trace])
+    over_sum = serve.summarize(odone, omakespan)
+    _RESULT["soak_overload_shed"] = over_sum["shed"]
+    assert over_sum["shed"] > 0 and over_sum["faulted"] == 0
+    assert over_sum["completed"] + over_sum["shed"] == soak_n
+    assert all(r.shed and "ServeOverloaded" in r.error
+               for r in odone if r.failed)
+    assert over_sum["shed"] == rs_over.counters["shed"]
 
     # -- acceptance probe 3: no per-request recompiles -------------------
     guards = sess.guard_report()

@@ -38,6 +38,29 @@ from .ndarray import NDArray, zeros, imperative_invoke
 __all__ = ["KVStore", "create"]
 
 
+def _retry_backoffs(rank, base_s, attempts, cap_s=30.0):
+    """Per-rank decorrelated-jitter retry schedule.
+
+    Plain exponential backoff is synchronized: every rank that hit the
+    same rendezvous race sleeps the same 1s/2s/4s and the whole job
+    re-collides (thundering herd) on each retry.  Decorrelated jitter
+    (AWS architecture blog) breaks the lockstep — ``sleep = min(cap,
+    uniform(base, prev * 3))`` — and seeding the stream from the rank
+    makes each rank's schedule *different from its peers yet
+    reproducible run-over-run*, so a flaky-rendezvous repro retries on
+    the exact same schedule every time."""
+    import hashlib
+    import random
+
+    digest = hashlib.sha256(b"kv-backoff-%d" % int(rank)).digest()
+    rng = random.Random(int.from_bytes(digest[:8], "big"))
+    schedule, prev = [], float(base_s)
+    for _ in range(int(attempts)):
+        prev = min(float(cap_s), rng.uniform(float(base_s), prev * 3.0))
+        schedule.append(prev)
+    return schedule
+
+
 def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0,
                  diagnose=None):
     """Run ``fn()`` under a wall-clock bound with retry/backoff.
@@ -48,8 +71,10 @@ def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0,
     finished within ``timeout_s`` (``MXNET_KV_TIMEOUT_S``, 0 disables
     the bound) a diagnosable :class:`MXNetError` names the wedged site
     instead.  Transient non-MXNetError failures are retried up to
-    ``retries`` times (``MXNET_KV_RETRIES``) with exponential backoff —
-    rendezvous races at job start are the common case.  The abandoned
+    ``retries`` times (``MXNET_KV_RETRIES``) on a rank-seeded
+    decorrelated-jitter schedule (:func:`_retry_backoffs`) —
+    rendezvous races at job start are the common case, and jitter keeps
+    the retrying ranks from re-colliding in lockstep.  The abandoned
     helper thread cannot be killed; it is left daemonized (the process
     is about to fail loudly anyway, which is the point).
 
@@ -62,6 +87,8 @@ def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0,
     if timeout_s is None:
         timeout_s = get_env("MXNET_KV_TIMEOUT_S", 300.0, float)
     attempt = 0
+    backoffs = _retry_backoffs(get_env("MXNET_WORKER_ID", 0, int),
+                               backoff_s, retries) if retries else []
     while True:
         box = {}
 
@@ -97,10 +124,11 @@ def _run_bounded(fn, what, timeout_s=None, retries=0, backoff_s=1.0,
             raise MXNetError("%s failed after %d attempt(s): %s"
                              % (what, attempt + 1, err)) from err
         attempt += 1
-        logger.warning("%s failed (%s); retry %d/%d in %.1fs",
-                       what, err, attempt, retries, backoff_s)
-        time.sleep(backoff_s)
-        backoff_s *= 2
+        sleep_s = backoffs[attempt - 1]
+        logger.warning("%s failed (%s); retry %d/%d in %.2fs "
+                       "(rank-seeded decorrelated jitter)",
+                       what, err, attempt, retries, sleep_s)
+        time.sleep(sleep_s)
 
 _VALID_TYPES = ("local", "local_allreduce_cpu", "local_allreduce_device",
                 "device", "dist_sync", "dist_device_sync", "dist_async",

@@ -9,14 +9,18 @@ from .model import ModelConfig, config_from_params, decode_step, \
     full_forward, init_params, prefill_forward, reference_last_logits
 from .scheduler import Request, Scheduler, summarize
 from .session import InferenceSession, ServeConfig
+from .supervisor import ReplicaSet, ServeOverloaded, ServeUnavailable
 
 __all__ = [
     "InferenceSession",
     "ModelConfig",
     "PagedKVCache",
+    "ReplicaSet",
     "Request",
     "Scheduler",
     "ServeConfig",
+    "ServeOverloaded",
+    "ServeUnavailable",
     "config_from_params",
     "decode_step",
     "full_forward",

@@ -495,6 +495,21 @@ class PagedKVCache:
     def active_slots(self):
         return sorted(self._pages_of)
 
+    def drop_prefix_index(self):
+        """Forget every published prefix chain (replica cold rejoin:
+        a restarted replica's pool holds no reusable KV, so its index
+        must not advertise any).  Retained refcount-0 pages go back to
+        the free heap; pages live slots still map merely lose their
+        published key — their holders keep decoding untouched and the
+        pages free normally on release.  Returns pages unpublished."""
+        dropped = len(self._key_of)
+        for page in self._retained:
+            heapq.heappush(self._free_pages, page)
+        self._retained.clear()
+        self._index.clear()
+        self._key_of.clear()
+        return dropped
+
     # -- executable-facing views -----------------------------------------
     def device_tables(self):
         """The (slots, max_pages) int32 page-table array, uploaded only

@@ -52,6 +52,17 @@ finished_request, now_s)`` at every completion; returned requests join
 the arrival queue — that is how the bench holds concurrency constant
 instead of replaying a fixed open-loop trace.
 
+Tick form (the replica supervisor's hook, ``serve/supervisor.py``):
+``run()`` is ``begin(requests)`` followed by ``tick()`` until no work
+remains — one ``tick()`` is exactly one decode-boundary iteration
+(resume parked, admit arrivals, step every active slot once).  A
+:class:`~mxnet_tpu.serve.supervisor.ReplicaSet` drives N schedulers
+tick-by-tick from one thread, all sharing the supervisor's ``t0`` so
+arrival offsets stay comparable, and on replica death calls
+:meth:`drain` to pull the unfinished requests out for re-admission on
+a survivor — requests with committed tokens re-enter a survivor's
+parked list and replay through the same resume path preemption uses.
+
 Fault sites (``testing/faults.py``): every admit / decode-step /
 response boundary crosses ``serve_queue`` plus a phase-specific site
 (``serve_admit`` / ``serve_decode`` — or ``serve_verify`` when
@@ -75,6 +86,9 @@ __all__ = ["Request", "Scheduler", "summarize"]
 
 _POLICIES = ("serial", "static", "continuous")
 
+_FRESH_STATS = {"preemptions": 0, "resumes": 0, "peak_active": 0,
+                "faulted": 0}
+
 
 @dataclasses.dataclass
 class Request:
@@ -92,6 +106,9 @@ class Request:
     failed: bool = False
     error: str = ""
     preemptions: int = 0  # times this request was evicted and parked
+    resumes: int = 0      # times its transcript re-prefilled (park or
+    #                       failover — both cross the same resume path)
+    shed: bool = False    # refused by overload protection (typed error)
 
     @property
     def finished(self):
@@ -107,10 +124,13 @@ class Scheduler(object):
                              % (policy, ", ".join(_POLICIES)))
         self.session = session
         self.policy = policy
-        self.stats = {"preemptions": 0, "resumes": 0, "peak_active": 0}
+        self.stats = dict(_FRESH_STATS)
         self._followup = None
-        self._pending = None
-        self._queue = None
+        self._pending = []
+        self._queue = []
+        self._parked = []
+        self._active = {}
+        self._t0 = None
 
     # -- fault boundaries -------------------------------------------------
     def _boundary(self, req, slot, site):
@@ -147,11 +167,74 @@ class Scheduler(object):
     def _fail(self, req, slot, exc):
         req.failed = True
         req.error = "%s: %s" % (type(exc).__name__, exc)
+        self.stats["faulted"] += 1
         if slot is not None:
             try:
                 self.session.release(slot)
             except MXNetError:
                 pass
+
+    # -- tick-form state machine ------------------------------------------
+    def begin(self, requests, followup=None, t0=None):
+        """Arm the scheduler for a run without stepping it: sort the
+        trace, reset the stats, record the clock origin.  ``t0`` (a
+        ``time.perf_counter()`` value) lets a supervisor share one clock
+        across many schedulers so ``arrival_s`` offsets line up."""
+        self._queue = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
+        self._pending = list(self._queue)
+        self._parked = []  # preempted requests, in eviction order
+        self._active = {}  # slot -> Request
+        self.stats = dict(_FRESH_STATS)
+        self._followup = followup
+        self._t0 = time.perf_counter() if t0 is None else t0
+        return self
+
+    def now(self):
+        return time.perf_counter() - self._t0
+
+    @property
+    def outstanding(self):
+        """True while unfinished requests remain anywhere (pending,
+        parked, or active)."""
+        return bool(self._pending or self._parked or self._active)
+
+    @property
+    def load(self):
+        """Requests this scheduler currently owns (pending + parked +
+        active) — the supervisor's least-loaded dispatch key."""
+        return len(self._pending) + len(self._parked) + len(self._active)
+
+    def submit(self, request, parked=False):
+        """Enqueue one request mid-run.  ``parked=True`` re-admits a
+        request that already holds committed tokens (replica failover)
+        through the resume path: its transcript re-prefills and the
+        replayed token is asserted against the last committed one."""
+        self._queue.append(request)
+        if parked:
+            self._parked.append(request)
+        else:
+            self._pending.append(request)
+
+    def drain(self):
+        """Pull every unfinished request out (replica death): returns
+        ``(resumable, fresh)`` — requests with committed tokens, and
+        requests not yet prefilled.  Active slots are released
+        best-effort (in-process the host-side bookkeeping is still
+        reachable; a real dead replica's memory is gone with it)."""
+        resumable, fresh = [], []
+        for slot in sorted(self._active):
+            req = self._active[slot]
+            try:
+                self.session.release(slot)
+            except MXNetError:
+                pass
+            (resumable if req.tokens else fresh).append(req)
+        resumable.extend(self._parked)
+        fresh.extend(self._pending)
+        self._active = {}
+        self._parked = []
+        self._pending = []
+        return resumable, fresh
 
     # -- the run loop -----------------------------------------------------
     def run(self, requests, followup=None):
@@ -161,163 +244,166 @@ class Scheduler(object):
         return a new :class:`Request` (or list of them) to enqueue —
         the closed-loop driving hook; generated requests are included
         in the returned list."""
-        sess = self.session
-        queue = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
-        pending = list(queue)
-        parked = []  # preempted requests, in eviction order
-        active = {}  # slot -> Request
-        self.stats = {"preemptions": 0, "resumes": 0, "peak_active": 0}
-        self._followup = followup
-        self._pending = pending
-        self._queue = queue
-        t0 = time.perf_counter()
+        self.begin(requests, followup=followup)
+        while self.tick():
+            pass
+        return self._queue, self.now()
 
-        def now():
-            return time.perf_counter() - t0
+    def tick(self, wait=True):
+        """One decode-boundary iteration: resume parked requests, admit
+        arrivals, cross every fault boundary, preempt on the watermark,
+        and run one fixed-shape step.  Returns :attr:`outstanding`.
+        ``wait=False`` skips the idle open-loop sleep (a supervisor
+        interleaving many schedulers owns the clock)."""
+        sess = self.session
+        pending, parked, active = self._pending, self._parked, self._active
+        now = self.now
+        if not self.outstanding:
+            return False
 
         slo_s = float(getattr(sess.config, "ttft_slo_ms", 0.0)) / 1000.0
         oversub = bool(getattr(sess.config, "oversub", False))
 
-        while pending or parked or active:
-            # 0) resume parked requests first — they hold queue
-            # seniority over fresh arrivals, and their transcript pages
-            # often still sit in the prefix cache
-            for req in list(parked):
-                if not self._boundary(req, None, "serve_resume"):
-                    parked.remove(req)
-                    continue
-                seq = list(req.prompt) + req.tokens[:-1]
-                budget = req.max_new - len(req.tokens) + 1
-                slot = sess.try_alloc(len(seq), budget, tokens=seq,
-                                      resume=True)
-                if slot is None:
-                    if not active and not pending:
-                        raise MXNetError(
-                            "parked request %d cannot resume into an "
-                            "idle session — pool smaller than one "
-                            "request's worst case" % req.rid)
-                    break
+        # 0) resume parked requests first — they hold queue
+        # seniority over fresh arrivals, and their transcript pages
+        # often still sit in the prefix cache
+        for req in list(parked):
+            if not self._boundary(req, None, "serve_resume"):
                 parked.remove(req)
-                first = self._prefill(req, slot, seq)
-                if first is None:
-                    continue
-                if first != req.tokens[-1]:
-                    raise MXNetError(
-                        "resume replay diverged for request %d: "
-                        "re-prefill produced token %d, committed stream "
-                        "holds %d — determinism bug"
-                        % (req.rid, first, req.tokens[-1]))
-                active[slot] = req
-                self.stats["resumes"] += 1
-
-            # 1) admit whatever the policy allows right now
-            arrived = [r for r in pending if r.arrival_s <= now()]
-            if slo_s > 0:
-                # requests that can still meet the TTFT budget first
-                # (FIFO within each class): a burst spends its slots on
-                # goodput, not on arrivals that already blew the budget
-                t = now()
-                arrived.sort(key=lambda r: ((t - r.arrival_s) > slo_s,
-                                            r.arrival_s, r.rid))
-            if self.policy == "serial":
-                admit_cap = 1 if not active else 0
-            elif self.policy == "static":
-                admit_cap = sess.config.slots if not active else 0
-            else:
-                admit_cap = sess.config.slots - len(active)
-            for req in arrived[:max(admit_cap, 0)]:
-                if not self._boundary(req, None, "serve_admit"):
-                    pending.remove(req)
-                    continue
-                slot = sess.try_alloc(len(req.prompt), req.max_new,
-                                      tokens=req.prompt)
-                if slot is None:
-                    break  # pool full: stays queued for a later boundary
-                pending.remove(req)
-                first = self._prefill(req, slot, req.prompt)
-                if first is None:
-                    continue
-                req.ttft_s = now() - req.arrival_s
-                req.tokens.append(first)
-                active[slot] = req
-                if len(req.tokens) >= req.max_new or first == req.eos_id:
-                    self._finish(req, slot, active, now)
-            self.stats["peak_active"] = max(self.stats["peak_active"],
-                                            len(active))
-
-            if not active:
-                if pending and not parked:
-                    # idle until the next arrival (open-loop replay)
-                    wait = min(r.arrival_s for r in pending) - now()
-                    if wait > 0:
-                        time.sleep(min(wait, 0.05))
                 continue
+            seq = list(req.prompt) + req.tokens[:-1]
+            budget = req.max_new - len(req.tokens) + 1
+            slot = sess.try_alloc(len(seq), budget, tokens=seq,
+                                  resume=True)
+            if slot is None:
+                if not active and not pending:
+                    raise MXNetError(
+                        "parked request %d cannot resume into an "
+                        "idle session — pool smaller than one "
+                        "request's worst case" % req.rid)
+                break
+            parked.remove(req)
+            first = self._prefill(req, slot, seq)
+            if first is None:
+                continue
+            if first != req.tokens[-1]:
+                raise MXNetError(
+                    "resume replay diverged for request %d: "
+                    "re-prefill produced token %d, committed stream "
+                    "holds %d — determinism bug"
+                    % (req.rid, first, req.tokens[-1]))
+            active[slot] = req
+            req.resumes += 1
+            self.stats["resumes"] += 1
 
-            # 2) per-request step boundaries (deterministic slot order)
-            spec = getattr(sess.config, "spec_k", 0) > 0
-            site = "serve_verify" if spec else "serve_decode"
+        # 1) admit whatever the policy allows right now
+        arrived = [r for r in pending if r.arrival_s <= now()]
+        if slo_s > 0:
+            # requests that can still meet the TTFT budget first
+            # (FIFO within each class): a burst spends its slots on
+            # goodput, not on arrivals that already blew the budget
+            t = now()
+            arrived.sort(key=lambda r: ((t - r.arrival_s) > slo_s,
+                                        r.arrival_s, r.rid))
+        if self.policy == "serial":
+            admit_cap = 1 if not active else 0
+        elif self.policy == "static":
+            admit_cap = sess.config.slots if not active else 0
+        else:
+            admit_cap = sess.config.slots - len(active)
+        for req in arrived[:max(admit_cap, 0)]:
+            if not self._boundary(req, None, "serve_admit"):
+                pending.remove(req)
+                continue
+            slot = sess.try_alloc(len(req.prompt), req.max_new,
+                                  tokens=req.prompt)
+            if slot is None:
+                break  # pool full: stays queued for a later boundary
+            pending.remove(req)
+            first = self._prefill(req, slot, req.prompt)
+            if first is None:
+                continue
+            req.ttft_s = now() - req.arrival_s
+            req.tokens.append(first)
+            active[slot] = req
+            if len(req.tokens) >= req.max_new or first == req.eos_id:
+                self._finish(req, slot, active, now)
+        self.stats["peak_active"] = max(self.stats["peak_active"],
+                                        len(active))
+
+        if not active:
+            if wait and pending and not parked:
+                # idle until the next arrival (open-loop replay)
+                idle = min(r.arrival_s for r in pending) - now()
+                if idle > 0:
+                    time.sleep(min(idle, 0.05))
+            return self.outstanding
+
+        # 2) per-request step boundaries (deterministic slot order)
+        spec = getattr(sess.config, "spec_k", 0) > 0
+        site = "serve_verify" if spec else "serve_decode"
+        for slot in sorted(active):
+            req = active[slot]
+            if not self._boundary(req, slot, site):
+                del active[slot]
+
+        if not active:
+            return self.outstanding
+
+        # 2b) watermark preemption: if the coming step's page
+        # growth would drain the pool below the watermark, evict
+        # the coldest request(s) — latest arrival, ties highest rid
+        # — park them, and let the survivors step.  The last active
+        # request is never evicted (it can always finish: one
+        # request's worst case fits the pool by construction).
+        if oversub:
+            rows = sess.config.spec_window if spec else 1
+            wm = max(int(getattr(sess.config, "watermark", 0)), 0)
+            while (len(active) > 1
+                   and sess.pages_short(rows) + wm
+                   > sess.cache.reclaimable_pages):
+                victim_slot = max(
+                    active, key=lambda s: (active[s].arrival_s,
+                                           active[s].rid))
+                victim = active.pop(victim_slot)
+                if not self._boundary(victim, victim_slot,
+                                      "serve_evict"):
+                    continue  # fault: failed + slot released
+                sess.release(victim_slot)  # shared pages survive
+                victim.preemptions += 1
+                parked.append(victim)
+                self.stats["preemptions"] += 1
+
+        if not active:
+            return self.outstanding
+
+        # 3) one fixed-shape step advances every survivor — by one
+        # token (decode) or by 1..K+1 committed tokens (verify)
+        if spec:
+            limits = {slot: active[slot].max_new
+                      - len(active[slot].tokens) for slot in active}
+            committed = sess.spec_step(limits=limits)
             for slot in sorted(active):
                 req = active[slot]
-                if not self._boundary(req, slot, site):
-                    del active[slot]
-
-            if not active:
-                continue
-
-            # 2b) watermark preemption: if the coming step's page
-            # growth would drain the pool below the watermark, evict
-            # the coldest request(s) — latest arrival, ties highest rid
-            # — park them, and let the survivors step.  The last active
-            # request is never evicted (it can always finish: one
-            # request's worst case fits the pool by construction).
-            if oversub:
-                rows = sess.config.spec_window if spec else 1
-                wm = max(int(getattr(sess.config, "watermark", 0)), 0)
-                while (len(active) > 1
-                       and sess.pages_short(rows) + wm
-                       > sess.cache.reclaimable_pages):
-                    victim_slot = max(
-                        active, key=lambda s: (active[s].arrival_s,
-                                               active[s].rid))
-                    victim = active.pop(victim_slot)
-                    if not self._boundary(victim, victim_slot,
-                                          "serve_evict"):
-                        continue  # fault: failed + slot released
-                    sess.release(victim_slot)  # shared pages survive
-                    victim.preemptions += 1
-                    parked.append(victim)
-                    self.stats["preemptions"] += 1
-
-            if not active:
-                continue
-
-            # 3) one fixed-shape step advances every survivor — by one
-            # token (decode) or by 1..K+1 committed tokens (verify)
-            if spec:
-                limits = {slot: active[slot].max_new
-                          - len(active[slot].tokens) for slot in active}
-                committed = sess.spec_step(limits=limits)
-                for slot in sorted(active):
-                    req = active[slot]
-                    for tok in committed[slot]:
-                        req.tokens.append(tok)
-                        if (len(req.tokens) >= req.max_new
-                                or tok == req.eos_id):
-                            # EOS inside the speculated window: the
-                            # committed tail past it is dropped, exactly
-                            # where non-speculative decode would stop
-                            self._finish(req, slot, active, now)
-                            break
-            else:
-                step_tokens, _ = sess.step()
-                for slot in sorted(active):
-                    req = active[slot]
-                    req.tokens.append(step_tokens[slot])
+                for tok in committed[slot]:
+                    req.tokens.append(tok)
                     if (len(req.tokens) >= req.max_new
-                            or step_tokens[slot] == req.eos_id):
+                            or tok == req.eos_id):
+                        # EOS inside the speculated window: the
+                        # committed tail past it is dropped, exactly
+                        # where non-speculative decode would stop
                         self._finish(req, slot, active, now)
+                        break
+        else:
+            step_tokens, _ = sess.step()
+            for slot in sorted(active):
+                req = active[slot]
+                req.tokens.append(step_tokens[slot])
+                if (len(req.tokens) >= req.max_new
+                        or step_tokens[slot] == req.eos_id):
+                    self._finish(req, slot, active, now)
 
-        return queue, now()
+        return self.outstanding
 
     def _finish(self, req, slot, active, now):
         active.pop(slot, None)
@@ -345,9 +431,18 @@ def summarize(requests, makespan_s, ttft_slo_ms=0.0):
     TTFT budget (``ttft_slo_ms`` > 0) it additionally reports
     ``goodput_rps`` — completed requests that met the budget, per
     second — and ``slo_attainment``, the met-budget fraction of
-    completions (the closed-loop bench's primary metric)."""
+    completions (the closed-loop bench's primary metric).
+
+    Robustness counters always ride along so chaos A/Bs can assert on
+    them: ``preemptions``/``resumes`` (watermark evictions and
+    transcript replays, failover resumes included), ``shed`` (requests
+    the dispatcher refused with a typed ``ServeOverloaded``), and
+    ``faulted`` — failures that were NOT sheds, i.e. a fault or crash
+    ate the request.  ``failed`` stays the historical total (faulted +
+    shed), so existing ``failed == 0`` assertions keep their meaning."""
     done = [r for r in requests if r.done_s >= 0.0 and not r.failed]
     failed = [r for r in requests if r.failed]
+    shed = [r for r in failed if getattr(r, "shed", False)]
     ttfts = [r.ttft_s for r in done if r.ttft_s >= 0.0]
     per_token = []
     total_tokens = 0
@@ -359,6 +454,10 @@ def summarize(requests, makespan_s, ttft_slo_ms=0.0):
     out = {
         "completed": len(done),
         "failed": len(failed),
+        "shed": len(shed),
+        "faulted": len(failed) - len(shed),
+        "preemptions": sum(r.preemptions for r in requests),
+        "resumes": sum(getattr(r, "resumes", 0) for r in requests),
         "total_tokens": total_tokens,
         "makespan_s": float(makespan_s),
         "tokens_per_sec": (total_tokens / makespan_s) if makespan_s > 0

@@ -941,6 +941,23 @@ class InferenceSession(object):
     def active_slots(self):
         return sorted(self._slot_tokens)
 
+    def reset_cold(self):
+        """Return the session to a just-built state (replica rejoin
+        after a supervisor eject): every slot released and the prefix
+        index dropped, so the replica re-enters rotation COLD and warms
+        its cache from live traffic — exactly what a restarted process
+        would do, minus the recompile (the executables are immutable
+        and carry no request state, so reusing them in-process models
+        only the state a real restart loses)."""
+        for slot in list(self._slot_tokens):
+            try:
+                self.release(slot)
+            except MXNetError:
+                pass
+        self.cache.drop_prefix_index()
+        if self.draft_cache is not None:
+            self.draft_cache.drop_prefix_index()
+
     # -- accounting -------------------------------------------------------
     @property
     def executables(self):

@@ -46,6 +46,13 @@ Keys:
   batches through first — deterministic by construction.
 * ``seconds=S`` — sleep length for ``delay`` (default 1.0).
 * ``sticky=1`` — keep firing on every hit >= ``after`` instead of once.
+* ``prob=P``   — probabilistic trigger: every hit >= ``after`` fires
+  independently with probability ``P`` from a *seeded per-spec RNG
+  stream* (sha256 of site/action/``seed``), so soak tests can inject
+  sustained random faults that replay identically run over run.
+  :func:`reset` re-seeds the stream, so re-arming the same spec string
+  replays the same fire pattern.  ``seed=N`` (default 0) picks a
+  different deterministic stream.
 
 Sites instrumented today: ``device_prefetch`` / ``prefetch`` (the io.py
 worker loops), ``checkpoint_io`` (between temp-file write and the atomic
@@ -78,7 +85,15 @@ refcount-aware, so shared prefix pages stay intact for other holders)
 and ``serve_resume`` (parked-request resume, before the re-prefill — a
 fault fails the parked request alone and survivors keep decoding).
 The serve sites fire in deterministic slot order each step, so
-``after=N`` picks a specific request.  ``data_decode`` fires inside each data-service decode task
+``after=N`` picks a specific request.  The replica supervisor
+(``serve/supervisor.py``) adds three coarser sites: ``serve_replica_kill``
+fires at the top of every replica's decode-boundary tick — ``kill``
+hard-kills that replica (drain + failover), ``raise`` counts against its
+circuit breaker, ``hang`` wedges it until the per-replica step watchdog
+trips — ``serve_dispatch`` fires per request at dispatcher admission (a
+fault fails that one request, typed), and ``serve_rejoin`` fires at each
+ejected replica's rejoin probe (a fault fails the probe and doubles its
+backoff).  ``data_decode`` fires inside each data-service decode task
 (in the worker *process* with ``num_workers > 0`` — hits are counted
 per process — or inline on the consumer thread with 0): ``raise``
 surfaces as a typed error at the consumer's ``next()``, ``kill``
@@ -139,6 +154,14 @@ SITES = {
                    "victim's pages are released",
     "serve_resume": "serving scheduler parked-request resume, before "
                     "the re-prefill",
+    "serve_replica_kill": "replica supervisor, top of every replica's "
+                          "decode-boundary tick (kill = replica death, "
+                          "raise = breaker fault, hang = watchdog trip)",
+    "serve_dispatch": "replica supervisor dispatcher, per-request "
+                      "admission into the bounded queue",
+    "serve_rejoin": "replica supervisor rejoin probe of an ejected "
+                    "replica (a fault fails the probe, doubling its "
+                    "backoff)",
     "kv_quant": "quantized-KV prefill, before the request's pages/"
                 "scales are written",
     "data_decode": "inside each data-service decode task (worker "
@@ -191,7 +214,7 @@ def _parse(raw):
                 "bad %s entry %r: want <site>:<action>[:key=value]* with "
                 "action one of %s" % (ENV_VAR, entry, ", ".join(_ACTIONS)))
         spec = {"site": fields[0], "action": fields[1], "after": 1,
-                "seconds": 1.0, "sticky": False}
+                "seconds": 1.0, "sticky": False, "prob": None, "seed": 0}
         for kv in fields[2:]:
             key, sep, val = kv.partition("=")
             if key == "after" and sep:
@@ -200,12 +223,35 @@ def _parse(raw):
                 spec["seconds"] = float(val)
             elif key == "sticky" and sep:
                 spec["sticky"] = val not in ("0", "false", "False")
+            elif key == "prob" and sep:
+                spec["prob"] = float(val)
+                if not 0.0 < spec["prob"] <= 1.0:
+                    raise MXNetError(
+                        "bad %s field %r in entry %r: prob must be in "
+                        "(0, 1]" % (ENV_VAR, kv, entry))
+            elif key == "seed" and sep:
+                spec["seed"] = int(val)
             else:
                 raise MXNetError(
-                    "bad %s field %r in entry %r (want after=N, seconds=S "
-                    "or sticky=0/1)" % (ENV_VAR, kv, entry))
+                    "bad %s field %r in entry %r (want after=N, seconds=S, "
+                    "sticky=0/1, prob=P or seed=N)" % (ENV_VAR, kv, entry))
+        if spec["prob"] is not None:
+            spec["rng"] = _spec_rng(spec)
         specs.append(spec)
     return specs
+
+
+def _spec_rng(spec):
+    """Seeded per-spec RNG stream for ``prob=`` triggers.  sha256 of
+    site/action/seed — NOT the builtin ``hash``, which is salted per
+    process and would break replayability."""
+    import hashlib
+    import random
+
+    digest = hashlib.sha256(
+        ("%s:%s:%d" % (spec["site"], spec["action"],
+                       spec["seed"])).encode()).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
 
 
 def _refresh_locked():
@@ -270,8 +316,17 @@ def inject(site, path=None):
                 continue
             _hits[i] += 1
             n = _hits[i]
-            if n != spec["after"] and not (spec["sticky"] and
-                                           n > spec["after"]):
+            if spec["prob"] is not None:
+                # probabilistic trigger: every hit >= after rolls the
+                # spec's seeded stream; the roll happens for skipped
+                # pre-`after` hits too so the stream position — and
+                # therefore the replayed fire pattern — depends only on
+                # the hit count, never on the `after` offset
+                if spec["rng"].random() >= spec["prob"] \
+                        or n < spec["after"]:
+                    continue
+            elif n != spec["after"] and not (spec["sticky"] and
+                                             n > spec["after"]):
                 continue
             if spec["action"] == "delay":
                 delays.append(spec["seconds"])

@@ -354,6 +354,52 @@ def test_fault_spec_parsing(monkeypatch):
         faults.inject("site")
 
 
+def test_fault_prob_trigger_deterministic_and_replayable(monkeypatch):
+    """prob=P fires from a seeded per-spec RNG stream: the fire pattern
+    is deterministic, reset() replays it exactly, and seed=N picks a
+    different (equally deterministic) stream."""
+    def pattern(spec, hits=200):
+        monkeypatch.setenv("MXNET_FAULT_INJECT", spec)
+        faults.reset()
+        fired = []
+        for i in range(hits):
+            try:
+                faults.inject("collective")
+            except faults.FaultInjected:
+                fired.append(i)
+        return fired
+
+    base = pattern("collective:raise:prob=0.3")
+    # probabilistic but not degenerate: some hits fire, most don't
+    assert 20 < len(base) < 120
+    assert pattern("collective:raise:prob=0.3") == base  # replay
+    assert pattern("collective:raise:prob=0.3:seed=7") != base
+    # after=N only masks the head of the stream; the roll positions —
+    # and therefore the post-`after` pattern — stay put
+    shifted = pattern("collective:raise:prob=0.3:after=50")
+    assert shifted == [i for i in base if i >= 49]
+    with pytest.raises(MXNetError, match="prob must be in"):
+        monkeypatch.setenv("MXNET_FAULT_INJECT", "collective:raise:prob=1.5")
+        faults.reset()
+
+
+def test_kv_retry_backoff_rank_seeded_jitter():
+    """Retry backoff is decorrelated jitter seeded by the worker rank:
+    peers retry on different schedules (no thundering-herd lockstep)
+    while every rank's own schedule is reproducible run-over-run."""
+    from mxnet_tpu.kvstore import _retry_backoffs
+
+    r0 = _retry_backoffs(0, base_s=1.0, attempts=6)
+    r1 = _retry_backoffs(1, base_s=1.0, attempts=6)
+    assert r0 != r1  # per-rank schedules differ
+    assert r0 == _retry_backoffs(0, base_s=1.0, attempts=6)  # pinned
+    assert r1 == _retry_backoffs(1, base_s=1.0, attempts=6)
+    for schedule in (r0, r1):
+        assert len(schedule) == 6
+        assert all(1.0 <= s <= 30.0 for s in schedule)  # base..cap
+    assert max(_retry_backoffs(3, 1.0, 50, cap_s=4.0)) <= 4.0
+
+
 def test_injected_prefetch_error_surfaces(monkeypatch):
     X, y = _data(32)
     monkeypatch.setenv("MXNET_FAULT_INJECT", "device_prefetch:raise:after=2")

@@ -6,7 +6,7 @@ Usage::
     python tools/diagnose.py <file-or-dir> [...]
     python tools/diagnose.py            # scans $MXNET_HEALTH_DIR / tmpdir
 
-Understands the three JSON artifact kinds the sentinel writes:
+Understands the JSON artifact kinds the sentinel writes:
 
 * ``watchdog-<pid>-<time>.json`` — the StepWatchdog's all-thread stack
   dump plus the last HealthMonitor snapshot, written when a training
@@ -17,6 +17,9 @@ Understands the three JSON artifact kinds the sentinel writes:
   (``mxnet_tpu.parallel.elastic``): old/new plan fingerprints,
   per-phase wall times and total ``downtime_s``, or the error a failed
   migration fell back to its checkpoint with.
+* ``serve-incident-<pid>-<n>.json`` — a serving ``ReplicaSet``'s
+  incident timeline (``mxnet_tpu.serve.supervisor``): replica deaths,
+  failover drains, shed requests, and rejoin probes, in order.
 
 Stdlib only: this must run on the stripped coordinator image where the
 training venv is gone but the dump survived.
@@ -99,6 +102,45 @@ def print_migration(path, payload):
         print("  error: %s" % payload["error"])
 
 
+def print_serve_incident(path, payload):
+    print("=" * 72)
+    print("SERVE INCIDENT  %s" % path)
+    counters = payload.get("counters") or {}
+    print("  pid %s at %s — %s replicas x %s slots "
+          "(deadline %s ms, step timeout %s s, breaker K=%s)"
+          % (payload.get("pid", "?"), _fmt_time(payload.get("time")),
+             payload.get("replicas", "?"),
+             payload.get("slots_per_replica", "?"),
+             payload.get("deadline_ms", "?"),
+             payload.get("step_timeout_s", "?"),
+             payload.get("breaker_k", "?")))
+    print("  totals: %s death(s), %s failover request(s), %s shed, "
+          "%s rejoin(s), %s failed probe(s)"
+          % (counters.get("deaths", 0),
+             counters.get("failover_requests", 0),
+             counters.get("shed", 0), counters.get("rejoins", 0),
+             counters.get("probes_failed", 0)))
+    states = payload.get("replica_states") or []
+    if states:
+        print("  final states: %s"
+              % ", ".join("r%s=%s(%s deaths)"
+                          % (s.get("index", "?"), s.get("state", "?"),
+                             s.get("deaths", 0)) for s in states))
+    print("  timeline:")
+    for ev in payload.get("timeline") or []:
+        who = "r%s" % ev["replica"] if ev.get("replica") is not None \
+            else "dispatcher"
+        extra = " ".join(
+            "%s=%r" % (k, v) for k, v in sorted(ev.items())
+            if k not in ("t", "event", "replica", "detail"))
+        line = "    %8.3fs  %-13s %-10s %s" \
+            % (float(ev.get("t", 0) or 0), ev.get("event", "?"), who,
+               extra)
+        print(line.rstrip())
+        if ev.get("detail"):
+            print("              %s" % ev["detail"])
+
+
 def diagnose_file(path):
     """Returns True when the file was a recognized artifact."""
     try:
@@ -116,6 +158,9 @@ def diagnose_file(path):
     if payload.get("kind") == "mxnet_tpu-migration-event":
         print_migration(path, payload)
         return True
+    if payload.get("kind") == "mxnet_tpu-serve-incident":
+        print_serve_incident(path, payload)
+        return True
     if name.startswith("heartbeat_rank") and "rank" in payload:
         print_heartbeat(path, payload)
         return True
@@ -126,7 +171,9 @@ def gather(target):
     if os.path.isdir(target):
         found = (glob.glob(os.path.join(target, "watchdog-*.json"))
                  + glob.glob(os.path.join(target, "heartbeat_rank*.json"))
-                 + glob.glob(os.path.join(target, "migration-*.json")))
+                 + glob.glob(os.path.join(target, "migration-*.json"))
+                 + glob.glob(os.path.join(target,
+                                          "serve-incident-*.json")))
         return sorted(found)
     return [target]
 
@@ -145,14 +192,14 @@ def main(argv=None):
     for target in targets:
         files = gather(target)
         if not files:
-            print("%s: no watchdog/heartbeat/migration artifacts" % target,
-                  file=sys.stderr)
+            print("%s: no watchdog/heartbeat/migration/serve-incident "
+                  "artifacts" % target, file=sys.stderr)
         for path in files:
             shown += diagnose_file(path)
     if not shown:
         print("nothing recognized — expected watchdog-*.json, "
-              "heartbeat_rank*.json or migration-*.json (see "
-              "docs/health_monitoring.md)",
+              "heartbeat_rank*.json, migration-*.json or "
+              "serve-incident-*.json (see docs/health_monitoring.md)",
               file=sys.stderr)
         return 1
     return 0
