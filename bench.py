@@ -370,6 +370,13 @@ def main():
             result["serving_spec_acceptance_rate"] = sv["acceptance_rate"]
             result["serving_spec_tokens_per_verify_step"] = \
                 sv["tokens_per_verify_step"]
+            # hybrid long-context row (window+SSM stack vs full
+            # attention at fixed pool bytes; bench_serve.py asserts the
+            # 2x capacity bar and the O(1) latency flatness)
+            result["serving_window_capacity_ratio"] = \
+                sv["window_capacity_ratio"]
+            result["serving_window_latency_ratio_32k_over_4k"] = \
+                sv["window_latency_ratio_32k_over_4k"]
         except Exception as exc:  # keep the primary metric robust
             result["serving_error"] = str(exc)[:200]
         _emit_partial()

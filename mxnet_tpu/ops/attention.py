@@ -129,7 +129,7 @@ def online_block_merge(acc, m, l, scores, v, mi=False):
 
 
 def attend_block(q32, kb, vb, acc, m, l, q_pos=None, k_pos=None,
-                 causal=False, kv_valid=None, mi=False):
+                 causal=False, kv_valid=None, mi=False, window=0):
     """Visit one K/V block: score, mask, merge into the running stats.
 
     ``q32`` is the full (pre-scaled, fp32) query; ``kb``/``vb`` one key/
@@ -139,12 +139,19 @@ def attend_block(q32, kb, vb, acc, m, l, q_pos=None, k_pos=None,
     ``kv_valid`` masks padded keys in the (ragged) last block; any
     broadcastable mask shape works (the paged decode kernel passes a
     per-batch-element (..., 1, Tk) validity mask).  ``mi`` selects the
-    M-invariant matmuls (see :func:`_qk_scores`).
+    M-invariant matmuls (see :func:`_qk_scores`).  ``window > 0`` adds a
+    sliding-window lower bound: a key is visible only when
+    ``q_pos - k_pos < window`` — a causal horizon that also *starts*
+    late.  Fully windowed-out blocks are exact no-ops in the merge, so
+    windowing preserves the M-invariant accumulation contract.
     """
     scores = _qk_scores(q32, kb.astype(jnp.float32), mi=mi)
     mask = None
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
+    if window:
+        wmask = k_pos[None, :] > q_pos[:, None] - window
+        mask = wmask if mask is None else mask & wmask
     if kv_valid is not None:
         mask = kv_valid if mask is None else mask & kv_valid
     if mask is not None:
@@ -162,19 +169,28 @@ def finalize_attention(acc, l):
 # reference (materialized) path
 # ---------------------------------------------------------------------------
 
-def reference_attention(q, k, v, causal=True, scale=None):
+def reference_attention(q, k, v, causal=True, scale=None, window=0):
     """Exact softmax attention over the full (..., Tq, Tk) score matrix.
 
     The pre-flash ``_multi_head_attention`` body, kept verbatim as the
     numeric ground truth: scores in fp32, O(T²) peak memory.
+    ``window > 0`` restricts row ``i`` to keys ``j`` with
+    ``i - j < window`` (sliding-window attention).
     """
     t, d = q.shape[-2], q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     scores = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32)
     scores = scores * scale
+    mask = None
     if causal:
         mask = jnp.tril(jnp.ones((t, k.shape[-2]), bool))
+    if window:
+        row = jnp.arange(t)[:, None]
+        col = jnp.arange(k.shape[-2])[None, :]
+        wmask = col > row - window
+        mask = wmask if mask is None else mask & wmask
+    if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("...qk,...kd->...qd", probs, v)
@@ -192,7 +208,7 @@ def _kv_blocks(x, t_pad, block):
     return jnp.moveaxis(x, -3, 0)
 
 
-def _flash_forward(q, k, v, causal, scale, block, mi=False):
+def _flash_forward(q, k, v, causal, scale, block, mi=False, window=0):
     """Tiled forward: scan over K/V blocks carrying (acc, m, l) in fp32.
 
     Returns ``(out, lse)`` where ``lse = m + log l`` is the per-query
@@ -219,7 +235,7 @@ def _flash_forward(q, k, v, causal, scale, block, mi=False):
         kv_valid = k_pos < t if t_pad != t else None
         acc, m, l = attend_block(q32, kblk, vblk, acc, m, l,
                                  q_pos=q_pos, k_pos=k_pos, causal=causal,
-                                 kv_valid=kv_valid, mi=mi)
+                                 kv_valid=kv_valid, mi=mi, window=window)
         return (acc, m, l), None
 
     (acc, m, l), _ = lax.scan(body, (acc0, m0, l0), (kb, vb, starts))
@@ -230,7 +246,7 @@ def _flash_forward(q, k, v, causal, scale, block, mi=False):
     return out, lse
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, block):
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block, window=0):
     """Recompute-based backward: one more scan over K/V blocks.
 
     Each block's probabilities are rebuilt from ``lse`` (never stored),
@@ -256,6 +272,9 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block):
         scores = jnp.einsum("...qd,...kd->...qk", q32, kb32)
         k_pos = start + jnp.arange(block)
         mask = q_pos[:, None] >= k_pos[None, :] if causal else None
+        if window:
+            wmask = k_pos[None, :] > q_pos[:, None] - window
+            mask = wmask if mask is None else mask & wmask
         if t_pad != t:
             valid = k_pos < t
             mask = valid if mask is None else mask & valid
@@ -284,35 +303,40 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block):
 
 
 @functools.lru_cache(maxsize=64)
-def _flash_fn(causal, scale, block, mi=False):
-    """Per-(causal, scale, block, mi) custom-VJP closure.
+def _flash_fn(causal, scale, block, mi=False, window=0):
+    """Per-(causal, scale, block, mi, window) custom-VJP closure.
 
     ``custom_vjp`` needs the static config out of the traced signature;
     the cache keeps function identity stable so jit does not re-trace
     per call.  ``mi`` only changes the forward matmul form (serving
     bit-exactness); the recompute backward keeps the einsum form —
-    gradients carry no M-invariance contract.
+    gradients carry no M-invariance contract.  ``window`` masks
+    identically in forward and backward (a windowed-out key gets exactly
+    zero probability and zero gradient).
     """
 
     @jax.custom_vjp
     def attn(q, k, v):
-        out, _ = _flash_forward(q, k, v, causal, scale, block, mi=mi)
+        out, _ = _flash_forward(q, k, v, causal, scale, block, mi=mi,
+                                window=window)
         return out
 
     def fwd(q, k, v):
-        out, lse = _flash_forward(q, k, v, causal, scale, block, mi=mi)
+        out, lse = _flash_forward(q, k, v, causal, scale, block, mi=mi,
+                                  window=window)
         return out, (q, k, v, out, lse)
 
     def bwd(res, g):
         q, k, v, out, lse = res
-        return _flash_backward(q, k, v, out, lse, g, causal, scale, block)
+        return _flash_backward(q, k, v, out, lse, g, causal, scale, block,
+                               window=window)
 
     attn.defvjp(fwd, bwd)
     return attn
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block=None,
-                    mi=False):
+                    mi=False, window=0):
     """Blockwise online-softmax attention, O(T·block) peak memory.
 
     q/k/v: (..., T, D) with identical leading dims (batch, heads are
@@ -321,6 +345,8 @@ def flash_attention(q, k, v, causal=True, scale=None, block=None,
     recompute-based ``custom_vjp`` (no stored probabilities).  ``mi``
     selects M-invariant forward matmuls so per-row outputs do not depend
     on how many query rows share the call (see :func:`_qk_scores`).
+    ``window > 0`` limits each query to the most recent ``window`` keys
+    (sliding-window attention; see :func:`attend_block`).
     """
     d = q.shape[-1]
     t = k.shape[-2]
@@ -332,7 +358,7 @@ def flash_attention(q, k, v, causal=True, scale=None, block=None,
         # needs the accumulation width fixed across different T.
         block = min(attention_block_size(), max(t, 1))
     return _flash_fn(bool(causal), float(scale), int(block),
-                     bool(mi))(q, k, v)
+                     bool(mi), int(window))(q, k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -340,7 +366,8 @@ def flash_attention(q, k, v, causal=True, scale=None, block=None,
 # ---------------------------------------------------------------------------
 
 def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
-                     mi=False, k_scale=None, v_scale=None):
+                     mi=False, k_scale=None, v_scale=None, window=0,
+                     k_positions=None):
     """One autoregressive decode step of attention over a paged KV
     context: the O(1)-per-token serving counterpart of
     :func:`flash_attention`, built from the same :func:`attend_block`
@@ -367,6 +394,19 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
     an elementwise convert + multiply feeding the score/value matmuls
     directly, so XLA fuses it into the attention kernel and the f32
     context never materializes at (S, H, Tcap, D).
+
+    ``window > 0`` adds the sliding-window lower bound: a context row is
+    visible only when its position ``p`` satisfies
+    ``valid_len - 1 - window < p <= valid_len - 1``.  ``k_positions``
+    (optional, (S, Tcap) int32) gives each context row an explicit
+    absolute position — the windowed-layer ring gather rotates a slot's
+    ring pages into ascending-position order and labels each row, so
+    rows that wrapped (or were never written) carry positions outside
+    the window (or < 0) and mask out exactly.  Because the gathered
+    blocks are page-aligned at the same absolute boundaries the
+    reference forward uses, the online merge visits visible blocks in
+    the same order with the same masks — windowed decode stays
+    bit-exact against the windowed reference under ``mi=True``.
     """
     d = q.shape[-1]
     t_cap = k_ctx.shape[-2]
@@ -391,6 +431,14 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
 
     ksb = _scale_blocks(k_scale) if k_scale is not None else None
     vsb = _scale_blocks(v_scale) if v_scale is not None else None
+
+    def _pos_blocks(p):
+        # (S, Tcap) -> (nblk, S, 1, 1, block): broadcast-ready against
+        # the (S, H, Q, block) mask
+        p = p.reshape(p.shape[0], nblk, block)
+        return jnp.moveaxis(p, 1, 0)[:, :, None, None, :]
+
+    kpb = _pos_blocks(k_positions) if k_positions is not None else None
     starts = jnp.arange(nblk) * block
     q32 = q.astype(jnp.float32) * scale
     acc0 = jnp.zeros(q.shape[:-1] + (v_ctx.shape[-1],), jnp.float32)
@@ -409,23 +457,36 @@ def decode_attention(q, k_ctx, v_ctx, lengths, scale=None, block=None,
 
     def body(carry, xs):
         acc, m, l = carry
-        kblk, vblk, start, ks, vs = xs
+        kblk, vblk, start, ks, vs, kp = xs
         if ks is not None:  # in-kernel dequant of quantized pages
             kblk = kblk.astype(jnp.float32) * ks
             vblk = vblk.astype(jnp.float32) * vs
-        k_pos = start + jnp.arange(block)
-        kv_valid = k_pos < valid_len
+        if kp is not None:
+            # explicit per-row absolute positions (ring gather): rows
+            # that wrapped or were never written carry positions outside
+            # [0, valid_len) and mask out exactly
+            pos = valid_len - 1  # query row's absolute position
+            kv_valid = (kp >= 0) & (kp <= pos)
+            if window:
+                kv_valid = kv_valid & (kp > pos - window)
+        else:
+            k_pos = start + jnp.arange(block)
+            kv_valid = k_pos < valid_len
+            if window:
+                kv_valid = kv_valid & (k_pos >= valid_len - window)
         acc, m, l = attend_block(q32, kblk, vblk, acc, m, l,
                                  kv_valid=kv_valid, mi=mi)
         return (acc, m, l), None
 
-    if ksb is None:
-        (acc, _, l), _ = lax.scan(
-            lambda c, xs: body(c, xs + (None, None)),
-            (acc0, m0, l0), (kb, vb, starts))
-    else:
-        (acc, _, l), _ = lax.scan(body, (acc0, m0, l0),
-                                  (kb, vb, starts, ksb, vsb))
+    slots = [kb, vb, starts, ksb, vsb, kpb]
+    present = [x is not None for x in slots]
+    packed = tuple(x for x in slots if x is not None)
+
+    def step(carry, xs):
+        it = iter(xs)
+        return body(carry, tuple(next(it) if p else None for p in present))
+
+    (acc, _, l), _ = lax.scan(step, (acc0, m0, l0), packed)
     return finalize_attention(acc, l).astype(q.dtype)
 
 
@@ -446,21 +507,24 @@ def _pallas_attention(q, k, v, causal, scale):
 
 
 def dot_product_attention(q, k, v, causal=True, scale=None, impl=None,
-                          block=None):
+                          block=None, window=0):
     """Dispatch attention to the implementation ``MXNET_ATTN_IMPL`` (or
     the explicit ``impl`` argument) selects.
 
     ``auto`` tries the Pallas fused kernel when tracing for TPU and
     falls back to the portable ``lax`` blockwise kernel — which is also
     what ``flash`` forces, so the CPU tier-1 rig and the TPU fallback
-    run identical code.
+    run identical code.  ``window > 0`` (sliding-window attention) is
+    not expressible in the Pallas kernel's mask, so it always takes the
+    blockwise path.
     """
     impl = (impl or attention_impl()).strip().lower()
     if impl not in _IMPLS:
         raise MXNetError("attention impl %r not in %s" % (impl, _IMPLS))
     if impl == "reference":
-        return reference_attention(q, k, v, causal=causal, scale=scale)
-    if impl == "auto" and jax.default_backend() == "tpu":
+        return reference_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window)
+    if impl == "auto" and jax.default_backend() == "tpu" and not window:
         if scale is None:
             scale = 1.0 / (q.shape[-1] ** 0.5)
         try:
@@ -470,4 +534,4 @@ def dot_product_attention(q, k, v, causal=True, scale=None, impl=None,
         except Exception:  # unsupported shape/kernel -> portable path
             pass
     return flash_attention(q, k, v, causal=causal, scale=scale,
-                           block=block)
+                           block=block, window=window)

@@ -885,6 +885,11 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
 
     q, k, v = heads(q), heads(k), heads(v)
     if bool(attrs.get("seq_parallel", False)):
+        if int(attrs.get("window", 0)):
+            raise MXNetError(
+                "MultiHeadAttention: window attr is not supported with "
+                "seq_parallel=True (ring attention has no sliding-window "
+                "mask)")
         # long-context path: shard T over the active mesh's 'seq' axis
         # and run ring attention (K/V rotate over ICI, O(T_local^2/ring)
         # peak memory per chip) — parallel/sequence.py
@@ -902,9 +907,10 @@ def _multi_head_attention(attrs, data, in_weight, in_bias, out_weight,
         from .attention import dot_product_attention
 
         block = int(attrs["attn_block"]) if "attn_block" in attrs else None
+        window = int(attrs.get("window", 0))
         ctx = dot_product_attention(q, k, v, causal=causal,
                                     impl=attrs.get("attn_impl") or None,
-                                    block=block)
+                                    block=block, window=window)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
     proj = fp8_apply_dot(ctx, out_weight, label=attrs.get("__node_name__"),
                          w_dim=1)

@@ -1,0 +1,118 @@
+"""Linear-attention / SSM scan kernels for the serving runtime.
+
+Not in the 0.11 reference (state-space layers post-date it) — added for
+the hybrid serving stacks of ``serve/model.py``: interleaving
+full-attention, sliding-window, and SSM layers makes per-slot decode
+state O(1) in context length ("Compiler-First State Space Duality and
+Portable O(1) Autoregressive Caching", arXiv 2603.09555: a linear
+attention layer *is* a diagonal SSM, so one recurrence serves both
+framings).
+
+The layer is retention-style linear attention with a fixed per-head
+exponential decay (no new parameters — the q/k/v projections reuse the
+block's existing ``attn_in`` weights):
+
+    state_t = gamma_h * state_{t-1} + k_t (outer) v_t      # (D, D) per head
+    y_t     = (q_t . state_t) * scale                      # (D,)
+
+Two execution forms, one op sequence:
+
+* chunked-scan prefill — ``lax.scan`` over the chunk's rows inside one
+  executable (one dispatch per prefill chunk, no host round-trips);
+* recurrent decode — the same scan with T == 1: one state update per
+  emitted token, O(1) memory and compute per step.
+
+Bit-exactness contract (the serving M-invariance analog): every state
+update and readout is an elementwise multiply-add plus a fixed-order
+reduction over D, independent of how many rows share the call — so a
+chunked prefill over T rows, T single-row decode steps, and a W-row
+speculative verify scan produce bit-identical states and outputs from
+the same inputs.  All arithmetic is fp32; the state round-trips through
+the cache's fp32 state pool exactly.
+
+Padded rows (bucket tail) pass the state through untouched — a masked
+update is the identity, not a rounded no-op — so chunk padding cannot
+perturb the recurrence.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["ssm_decay", "ssm_scan"]
+
+
+def ssm_decay(num_heads):
+    """Fixed per-head decay vector (fp32, shape (H,)).
+
+    ``gamma_h = 1 - 2**-(5 + h)`` (retention-style geometric ladder):
+    head 0 remembers ~32 tokens, each further head doubles the horizon.
+    Deterministic in the head index — no learned parameters, so hybrid
+    stacks reuse existing attention checkpoints unchanged.
+    """
+    if num_heads < 1:
+        raise MXNetError("ssm_decay: num_heads must be >= 1, got %d"
+                         % num_heads)
+    h = jnp.arange(num_heads, dtype=jnp.float32)
+    return 1.0 - jnp.exp2(-(5.0 + h))
+
+
+def ssm_scan(q, k, v, state0, gamma, scale=None, row_valid=None,
+             collect=False):
+    """Scan the linear-attention recurrence over ``T`` rows.
+
+    q/k/v: (S, T, H, D); state0: (S, H, D, D) fp32 — the state *before*
+    row 0; gamma: (H,) fp32 per-head decay; row_valid: optional (S, T)
+    bool — rows marked invalid (bucket padding) leave the state exactly
+    unchanged and their outputs are zeroed.  Returns ``(y, state)`` with
+    y (S, T, H, D) fp32 and state (S, H, D, D) the post-scan state; with
+    ``collect=True`` returns ``(y, state, states)`` where states
+    (T, S, H, D, D) holds the state *after* each row — the speculative
+    verify step selects the snapshot at its commit point, making
+    rollback an O(1) gather instead of a re-scan.
+    """
+    if q.ndim != 4:
+        raise MXNetError("ssm_scan: expected (S, T, H, D) inputs, got %r"
+                         % (q.shape,))
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q32 = q.astype(jnp.float32) * scale
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    g = gamma.astype(jnp.float32)[:, None, None]  # (H, 1, 1)
+    state0 = state0.astype(jnp.float32)
+
+    # scan over T: move the row axis to the front
+    qs = jnp.moveaxis(q32, 1, 0)  # (T, S, H, D)
+    ks = jnp.moveaxis(k32, 1, 0)
+    vs = jnp.moveaxis(v32, 1, 0)
+    if row_valid is not None:
+        rv = jnp.moveaxis(row_valid, 1, 0)  # (T, S)
+    else:
+        rv = None
+
+    def body(state, xs):
+        if rv is None:
+            qt, kt, vt = xs
+            valid = None
+        else:
+            qt, kt, vt, valid = xs
+        new = g * state + kt[..., :, None] * vt[..., None, :]
+        if valid is not None:
+            new = jnp.where(valid[:, None, None, None], new, state)
+        # readout: fixed-order reduction over the first state axis
+        yt = jnp.sum(qt[..., :, None] * new, axis=-2)
+        if valid is not None:
+            yt = jnp.where(valid[:, None, None], yt, 0.0)
+        out = (yt, new) if collect else yt
+        return new, out
+
+    xs = (qs, ks, vs) if rv is None else (qs, ks, vs, rv)
+    state, out = lax.scan(body, state0, xs)
+    if collect:
+        ys, states = out
+        return jnp.moveaxis(ys, 0, 1), state, states
+    return jnp.moveaxis(out, 0, 1), state

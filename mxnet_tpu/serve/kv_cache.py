@@ -83,7 +83,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_heads, head_dim, page_size,
                  num_pages, slots, max_pages_per_slot, dtype=None,
-                 table_pad=0, prefix_pages=0, kv_quant=""):
+                 table_pad=0, prefix_pages=0, kv_quant="",
+                 layer_kinds=(), window=0, ring_pages=0):
         import jax.numpy as jnp
         import numpy as np
 
@@ -104,6 +105,38 @@ class PagedKVCache:
         self.num_pages = int(num_pages)
         self.slots = int(slots)
         self.max_pages_per_slot = int(max_pages_per_slot)
+        # -- hybrid-stack layout ------------------------------------------
+        # layer_kinds: per-layer "full" | "window" | "ssm" (empty = all
+        # full-attention).  Only FULL layers occupy the paged pools —
+        # the pool's layer axis is the full-layer count, so a hybrid
+        # stack's page costs proportionally less and a fixed pool budget
+        # admits proportionally more slots.  Windowed layers get a fixed
+        # ring of ``ring_pages`` pages per slot (``kw_pool``/``vw_pool``,
+        # slot-indexed: ring append overwrites the oldest page's rows in
+        # place, and the attention mask saturates visibility at the
+        # window).  SSM layers get one (H, D, D) fp32 recurrence state
+        # per slot (``ssm_state``), the per-layer state pool beside the
+        # KV pools.
+        self.layer_kinds = tuple(layer_kinds) or ("full",) * self.num_layers
+        if len(self.layer_kinds) != self.num_layers:
+            raise MXNetError(
+                "PagedKVCache: layer_kinds %r does not cover %d layers"
+                % (self.layer_kinds, self.num_layers))
+        bad = set(self.layer_kinds) - {"full", "window", "ssm"}
+        if bad:
+            raise MXNetError("PagedKVCache: unknown layer kinds %r"
+                             % sorted(bad))
+        self.n_full = self.layer_kinds.count("full")
+        self.n_window = self.layer_kinds.count("window")
+        self.n_ssm = self.layer_kinds.count("ssm")
+        self.window = int(window)
+        self.ring_pages = int(ring_pages)
+        if self.n_window and (self.window < 1 or self.ring_pages < 1):
+            raise MXNetError(
+                "PagedKVCache: windowed layers need window >= 1 and "
+                "ring_pages >= 1 (got window=%d, ring_pages=%d)"
+                % (self.window, self.ring_pages))
+        self.ring_tokens = self.ring_pages * self.page_size
         # extra always-trash table columns past the reservable range, so
         # executables that clip a past-the-reservation write position
         # (the speculative verify's overflow rows) land on the trash
@@ -124,8 +157,8 @@ class PagedKVCache:
             dtype = jnp.dtype(_quantize.quant_dtype(self.kv_quant))
         else:
             dtype = dtype or jnp.float32
-        pool_shape = (self.num_layers, self.num_pages + 1, self.page_size,
-                      self.num_heads, self.head_dim)
+        pool_shape = (max(self.n_full, 1), self.num_pages + 1,
+                      self.page_size, self.num_heads, self.head_dim)
         self.k_pool = jnp.zeros(pool_shape, dtype)
         self.v_pool = jnp.zeros(pool_shape, dtype)
         if self.kv_quant:
@@ -134,6 +167,31 @@ class PagedKVCache:
             self.v_scale = jnp.ones(scale_shape, jnp.float32)
         else:
             self.k_scale = self.v_scale = None
+        # windowed-layer rings: slot-indexed, no page table — every slot
+        # owns exactly ring_pages pages for each windowed layer, for the
+        # session's whole lifetime (that is the O(1)-per-slot story)
+        if self.n_window:
+            ring_shape = (self.n_window, self.slots, self.ring_tokens,
+                          self.num_heads, self.head_dim)
+            self.kw_pool = jnp.zeros(ring_shape, dtype)
+            self.vw_pool = jnp.zeros(ring_shape, dtype)
+            if self.kv_quant:
+                self.kw_scale = jnp.ones(ring_shape[:3], jnp.float32)
+                self.vw_scale = jnp.ones(ring_shape[:3], jnp.float32)
+            else:
+                self.kw_scale = self.vw_scale = None
+        else:
+            self.kw_pool = self.vw_pool = None
+            self.kw_scale = self.vw_scale = None
+        # SSM state pool: fp32 regardless of kv_quant — the state is a
+        # running accumulator, not content-addressed KV rows; quantizing
+        # it would break the chunked-prefill == serial-decode contract
+        if self.n_ssm:
+            self.ssm_state = jnp.zeros(
+                (self.n_ssm, self.slots, self.num_heads, self.head_dim,
+                 self.head_dim), jnp.float32)
+        else:
+            self.ssm_state = None
         # min-heaps: heappop yields the lowest free id, preserving the
         # deterministic lowest-first reuse contract (a sorted range is
         # already a valid heap)
@@ -180,8 +238,18 @@ class PagedKVCache:
         quantity the scheduler's oversubscription watermark watches."""
         return len(self._free_pages) + len(self._retained)
 
+    @property
+    def hybrid(self):
+        """True when the stack holds any windowed or SSM layer."""
+        return bool(self.n_window or self.n_ssm)
+
     def pages_needed(self, prompt_len, max_new):
-        """Worst-case page reservation for one request."""
+        """Worst-case page reservation for one request.  Pool pages hold
+        FULL-attention layers only — a stack with none needs no pages at
+        all (ring and state buffers are per-slot and pre-reserved), so
+        admission is bounded by slots alone."""
+        if not self.n_full:
+            return 0
         total = int(prompt_len) + int(max_new)
         return -(-total // self.page_size)
 
@@ -203,8 +271,16 @@ class PagedKVCache:
         """Longest mapped-page chain the prompt may reuse: full pages
         whose chain key is published, capped so at least one prompt
         token is always left for prefill (the suffix computes the
-        request's first logits, and suffix offsets stay page-aligned)."""
-        if tokens is None or not self.prefix_pages:
+        request's first logits, and suffix offsets stay page-aligned).
+
+        Hybrid stacks: a usable hit must restore EVERY layer kind's
+        state at the resume boundary.  Published pool pages restore the
+        full-attention layers, but window rings and SSM states are
+        slot-private — the only window-aligned boundary at which they
+        are reconstructible without recomputation is offset 0, so hits
+        cap at zero pages and hybrid prompts always prefill cold (see
+        :meth:`register_prefix`)."""
+        if tokens is None or not self.prefix_pages or self.hybrid:
             return []
         hit = self.match_prefix(tokens)
         cap = (int(prompt_len) - 1) // self.page_size
@@ -250,8 +326,12 @@ class PagedKVCache:
         below the committed length are never rewritten).  Pages already
         published under the same chain (the slot's own hits) are left
         alone; a chain another slot published concurrently wins and this
-        slot's duplicate page stays private.  Returns pages published."""
-        if not self.prefix_pages:
+        slot's duplicate page stays private.  Returns pages published.
+
+        Hybrid stacks publish nothing: :meth:`_usable_hit` can never map
+        the pages (window rings / SSM states cannot ride along), so
+        publishing would only pin pool pages in the retained LRU."""
+        if not self.prefix_pages or self.hybrid:
             return 0
         pages = self._pages_of.get(slot)
         if pages is None:
@@ -350,6 +430,12 @@ class PagedKVCache:
         # page
         self.lengths[slot] = self._cached_len[slot]
         self._tables_dev = None
+        # SSM recurrence starts from a zero state at offset 0; ring
+        # rows need no scrub — the position labels the windowed gather
+        # computes for a fresh request exclude every row the request has
+        # not itself written (stale rows label as position < 0)
+        if self.ssm_state is not None:
+            self.ssm_state = self.ssm_state.at[:, slot].set(0.0)
         if tokens is not None and self.prefix_pages:
             self.prefix_stats["lookups"] += 1
             if hit:
@@ -479,7 +565,17 @@ class PagedKVCache:
         and the next append overwrites them.  The device page-table
         upload cache is deliberately NOT touched (the invalidate-only-
         on-table-mutation contract holds): tables do not change here,
-        and lengths re-upload every step anyway."""
+        and lengths re-upload every step anyway.
+
+        Hybrid stacks stay O(1) too.  Window rings: the position -> ring
+        row map is deterministic, so the rejected rows' ring slots are
+        exactly the ones the re-issued positions overwrite next step,
+        and the windowed mask (driven by the rolled-back length) never
+        reads them in between — rolling back ``lengths`` IS rolling back
+        the ring position.  SSM state: the verify executable selects the
+        committed snapshot in-graph before returning (see
+        ``model.verify_step``), so by the time the host truncates, the
+        state pool already holds the post-commit state."""
         if slot not in self._pages_of:
             raise MXNetError("truncate of unallocated slot %r" % (slot,))
         n = int(n_tokens)
@@ -538,6 +634,13 @@ class PagedKVCache:
         total = int(self.k_pool.nbytes) + int(self.v_pool.nbytes)
         if self.kv_quant:
             total += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        if self.kw_pool is not None:
+            total += int(self.kw_pool.nbytes) + int(self.vw_pool.nbytes)
+            if self.kv_quant:
+                total += (int(self.kw_scale.nbytes)
+                          + int(self.vw_scale.nbytes))
+        if self.ssm_state is not None:
+            total += int(self.ssm_state.nbytes)
         return total
 
     @classmethod

@@ -54,21 +54,53 @@ def exact_mode():
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Static decoder geometry (everything the traced functions close
-    over)."""
+    over).
+
+    ``layer_kinds``/``window`` describe a hybrid stack: per layer,
+    ``"full"`` (paged full-context attention), ``"window"`` (sliding-
+    window attention over the last ``window`` keys, ring-buffered KV),
+    or ``"ssm"`` (linear-attention recurrence, O(1) state — see
+    ``ops/ssm_ops.py``).  All kinds reuse the block's existing
+    ``attn_in``/``attn_out`` weights, so any attention checkpoint hosts
+    any stack.  The empty tuple means all-full (the classic decoder).
+    """
     vocab_size: int
     num_layers: int
     d_model: int
     num_heads: int
     max_len: int          # pos_embed rows == the context ceiling
+    window: int = 0       # sliding-window length for "window" layers
+    layer_kinds: tuple = ()  # per-layer kind; () = all "full"
 
     @property
     def head_dim(self):
         return self.d_model // self.num_heads
 
+    @property
+    def kinds(self):
+        """Per-layer kinds, expanded to ``num_layers`` entries."""
+        return self.layer_kinds or ("full",) * self.num_layers
+
+    @property
+    def hybrid(self):
+        return any(k != "full" for k in self.kinds)
+
     def validate(self):
         if self.d_model % self.num_heads:
             raise MXNetError("d_model %d not divisible by num_heads %d"
                              % (self.d_model, self.num_heads))
+        if self.layer_kinds:
+            if len(self.layer_kinds) != self.num_layers:
+                raise MXNetError(
+                    "layer_kinds %r does not cover %d layers"
+                    % (self.layer_kinds, self.num_layers))
+            bad = set(self.layer_kinds) - {"full", "window", "ssm"}
+            if bad:
+                raise MXNetError("unknown layer kinds %r" % sorted(bad))
+            if "window" in self.layer_kinds and self.window < 1:
+                raise MXNetError(
+                    "windowed layers need window >= 1 (got %d)"
+                    % self.window)
         return self
 
 
@@ -219,29 +251,165 @@ def _kv_fake_quant(k, v, kv_quant):
     return _fq(k), _fq(v)
 
 
-def _block_attention(params, i, x, cfg, exact, block, kv_quant=""):
+def _qkv_heads(params, i, x, cfg, exact):
+    """Shared sublayer head: pre-norm + in-projection + head split.
+    Returns (q, k, v) as (n, H, T, D) — identical ops for every layer
+    kind, so hybrid stacks share the projection's bit pattern."""
+    import jax.numpy as jnp
+
+    n, t, _ = x.shape
+    h, d = cfg.num_heads, cfg.head_dim
+    hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
+                      params["blk%d_ln1_beta" % i])
+    qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
+        + params["blk%d_attn_in_bias" % i]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return (_attn_heads(q, n, t, h, d), _attn_heads(k, n, t, h, d),
+            _attn_heads(v, n, t, h, d))
+
+
+def _pool_pack(k_pool, v_pool, k_scale, v_scale, kw_pool, vw_pool,
+               kw_scale, vw_scale, ssm_state, kv_quant):
+    """Canonical pool ordering every serve executable returns (and the
+    session's ``_pool_args``/``_store_pools`` mirror): paged pools, the
+    paged scales (kv_quant), the window rings, the ring scales
+    (kv_quant), then the SSM state pool.  Absent pools are simply
+    omitted, so the classic all-full stack keeps its historical
+    signature byte-for-byte."""
+    pools = [k_pool, v_pool]
+    if kv_quant:
+        pools += [k_scale, v_scale]
+    if kw_pool is not None:
+        pools += [kw_pool, vw_pool]
+        if kv_quant:
+            pools += [kw_scale, vw_scale]
+    if ssm_state is not None:
+        pools.append(ssm_state)
+    return tuple(pools)
+
+
+def _pool_names(kv_quant, has_window, has_ssm):
+    """Keyword names matching :func:`_pool_pack`'s ordering — lets a
+    caller re-bind a packed pool tuple onto the executables' signatures
+    without hand-maintaining the order in two places."""
+    names = ["k_pool", "v_pool"]
+    if kv_quant:
+        names += ["k_scale", "v_scale"]
+    if has_window:
+        names += ["kw_pool", "vw_pool"]
+        if kv_quant:
+            names += ["kw_scale", "vw_scale"]
+    if has_ssm:
+        names.append("ssm_state")
+    return tuple(names)
+
+
+def _block_attention(params, i, x, cfg, exact, block, kv_quant="",
+                     window=0):
     """One pre-norm attention sublayer on (n, T, C); returns the
     residual-added activations plus this layer's (k, v) heads —
     (n, H, T, D) each, the page-writable prefill byproduct.  With
     ``kv_quant`` the keys/values are fake-quantized per token before
-    attention, mirroring what a paged reader reconstructs."""
+    attention, mirroring what a paged reader reconstructs.  ``window``
+    restricts attention to the last ``window`` keys (the windowed-layer
+    reference path)."""
     n, t, c = x.shape
-    h, d = cfg.num_heads, cfg.head_dim
-    hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
-                      params["blk%d_ln1_beta" % i])
-    import jax.numpy as jnp
-
-    qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
-        + params["blk%d_attn_in_bias" % i]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k, v = (_attn_heads(q, n, t, h, d), _attn_heads(k, n, t, h, d),
-               _attn_heads(v, n, t, h, d))
+    q, k, v = _qkv_heads(params, i, x, cfg, exact)
     k, v = _kv_fake_quant(k, v, kv_quant)
-    ctx = flash_attention(q, k, v, causal=True, block=block, mi=exact)
+    ctx = flash_attention(q, k, v, causal=True, block=block, mi=exact,
+                          window=window)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(n, t, c)
     out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
         + params["blk%d_attn_out_bias" % i]
     return x + out, (k, v)
+
+
+def _block_ssm(params, i, x, cfg, exact, state0=None, row_valid=None,
+               collect=False):
+    """One SSM (linear-attention) sublayer on (n, T, C): the recurrence
+    of ``ops/ssm_ops.py`` fed by the block's own q/k/v projections.
+    ``state0`` (n, H, D, D) fp32 is the pre-scan state (zeros for a
+    from-scratch forward); ``row_valid`` masks bucket padding out of the
+    state.  Returns (x + out, state[, states]) — ``states`` (T, n, H, D,
+    D) per-row snapshots when ``collect`` (the verify step's O(1)
+    rollback source).  K/V are consumed in-register and never stored,
+    so ``kv_quant`` does not apply (the state pool is fp32)."""
+    import jax.numpy as jnp
+
+    from ..ops.ssm_ops import ssm_decay, ssm_scan
+
+    n, t, c = x.shape
+    q, k, v = _qkv_heads(params, i, x, cfg, exact)
+    # scan wants rows-major (n, T, H, D)
+    q, k, v = (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+               v.transpose(0, 2, 1, 3))
+    if state0 is None:
+        state0 = jnp.zeros((n, cfg.num_heads, cfg.head_dim, cfg.head_dim),
+                           jnp.float32)
+    res = ssm_scan(q, k, v, state0, ssm_decay(cfg.num_heads),
+                   row_valid=row_valid, collect=collect)
+    y = res[0].astype(x.dtype)
+    ctx = y.reshape(n, t, c)
+    out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+        + params["blk%d_attn_out_bias" % i]
+    return (x + out,) + res[1:]
+
+
+def _ring_append(pool, scale_pool, i, slot_ids, rows_idx, rows, kv_quant):
+    """Scatter KV rows into windowed layer ``i``'s per-slot ring.
+
+    pool: (Lw, S, R, H, D); ``slot_ids``/``rows_idx`` broadcastable int
+    arrays selecting (slot, ring row) per written token; ``rows`` the
+    matching (..., H, D) values.  Quantization is per row with the same
+    helper the paged pools use, so ring bytes are a pure function of
+    the token written — the preempt/re-prefill and COW arguments carry
+    over to rings unchanged."""
+    if kv_quant:
+        from .. import quantize as _q
+
+        codes, scales = _q.kv_quantize_rows(rows, kv_quant)
+        pool = pool.at[i, slot_ids, rows_idx].set(codes)
+        scale_pool = scale_pool.at[i, slot_ids, rows_idx].set(scales)
+        return pool, scale_pool
+    return (pool.at[i, slot_ids, rows_idx].set(rows.astype(pool.dtype)),
+            scale_pool)
+
+
+def _ring_gather(pool, scale_pool, i, pb_max, page_size, kv_quant,
+                 slot=None):
+    """Gather a ring in ascending-absolute-position order.
+
+    ``pb_max``: (S,) int32 — the highest absolute PAGE index written
+    (the newest page).  The ring's pages are rotated so the gathered
+    page ``j`` is absolute page ``pb_max - ring_pages + 1 + j``; each
+    row is labeled with its absolute position (``k_positions``) so the
+    windowed mask in :func:`..ops.attention.decode_attention` sees
+    page-aligned blocks in exactly the reference forward's visit order —
+    that alignment is what keeps ring reads bit-exact.  ``slot`` selects
+    one slot's ring (prefill); otherwise all slots gather.  Returns
+    (ctx (S, R, H, D), scales (S, R) or None, k_positions (S, R))."""
+    import jax.numpy as jnp
+
+    ring = pool[i] if slot is None else \
+        jnp.take(pool[i], slot, axis=0)[None]
+    s, ring_tokens = ring.shape[0], ring.shape[1]
+    ring_pages = ring_tokens // page_size
+    pb = pb_max.reshape(-1, 1)                              # (S, 1)
+    j = jnp.arange(ring_pages, dtype=pb.dtype)[None, :]     # (1, RP)
+    gather_page = (pb + 1 + j) % ring_pages                 # ring page ids
+    abs_page = pb - (ring_pages - 1) + j                    # their positions
+    in_page = jnp.arange(page_size, dtype=pb.dtype)
+    row_idx = (gather_page[:, :, None] * page_size
+               + in_page[None, None, :]).reshape(s, ring_tokens)
+    k_positions = (abs_page[:, :, None] * page_size
+                   + in_page[None, None, :]).reshape(s, ring_tokens)
+    ctx = jnp.take_along_axis(ring, row_idx[:, :, None, None], axis=1)
+    scales = None
+    if kv_quant:
+        sc = scale_pool[i] if slot is None else \
+            jnp.take(scale_pool[i], slot, axis=0)[None]
+        scales = jnp.take_along_axis(sc, row_idx, axis=1)
+    return ctx, scales, k_positions
 
 
 def _block_mlp(params, i, x, exact):
@@ -278,10 +446,18 @@ def full_forward(params, tokens, cfg, exact=None, block=None,
                  axis=0)
     x = x + params["pos_embed"][:, :t]
     kvs = []
-    for i in range(cfg.num_layers):
-        x, kv = _block_attention(params, i, x, cfg, exact, block,
-                                 kv_quant=kv_quant)
-        kvs.append(kv)
+    for i, kind in enumerate(cfg.kinds):
+        if kind == "ssm":
+            # serial scan from a zero state: the same per-row op
+            # sequence chunked prefill and recurrent decode run, so this
+            # forward stays the bit-exactness oracle for hybrid stacks
+            x, _ = _block_ssm(params, i, x, cfg, exact)
+            kvs.append(None)
+        else:
+            x, kv = _block_attention(
+                params, i, x, cfg, exact, block, kv_quant=kv_quant,
+                window=cfg.window if kind == "window" else 0)
+            kvs.append(kv)
         x = _block_mlp(params, i, x, exact)
     x = _layer_norm(x, params["final_ln_gamma"], params["final_ln_beta"])
     logits = _mm(x, params["lm_head_weight"], exact) \
@@ -293,7 +469,9 @@ def full_forward(params, tokens, cfg, exact=None, block=None,
 
 def prefill_forward(params, tokens, length, offset, table_row, k_pool,
                     v_pool, cfg, page_size, exact=None, k_scale=None,
-                    v_scale=None, kv_quant=""):
+                    v_scale=None, kv_quant="", kw_pool=None, vw_pool=None,
+                    kw_scale=None, vw_scale=None, ssm_state=None,
+                    slot=None):
     """Bucketed prefill over one suffix chunk: write the chunk's KV into
     the slot's pages and attend each row over everything at or before
     its absolute position — including KV the slot did NOT compute this
@@ -325,6 +503,15 @@ def prefill_forward(params, tokens, length, offset, table_row, k_pool,
     and corrupt it — bucket padding can overhang the mapped range when
     ``offset > 0``); their positions exceed every row's horizon, so
     nothing reads them.
+
+    Hybrid stacks: windowed layers scatter the chunk's rows into the
+    slot's ring (``kw_pool``/``vw_pool``, selected by the ``slot``
+    scalar) at ``abs_pos % ring_tokens`` and attend over the
+    position-labeled rotated ring gather; SSM layers advance the slot's
+    recurrence state (``ssm_state``) across the chunk in one
+    ``lax.scan`` — chunk padding passes the state through untouched.
+    The updated ring/state pools ride the return tuple after the paged
+    pools (and their scales).
     """
     import jax.numpy as jnp
 
@@ -349,30 +536,68 @@ def prefill_forward(params, tokens, length, offset, table_row, k_pool,
     pages = jnp.where(idx < max_pages,
                       table_row[jnp.clip(idx, 0, max_pages - 1)], trash)
     offsets = abs_pos % page_size
-    for i in range(cfg.num_layers):
+    fi = wi = si = 0  # per-kind pool indices (static)
+    for i, kind in enumerate(cfg.kinds):
+        if kind == "ssm":
+            state0 = jnp.take(ssm_state[si], slot, axis=0)[None]
+            rv = (offs < length).reshape(1, t_b)  # padding: state no-op
+            x, state = _block_ssm(params, i, x, cfg, exact, state0=state0,
+                                  row_valid=rv)
+            ssm_state = ssm_state.at[si, slot].set(state[0])
+            si += 1
+            x = _block_mlp(params, i, x, exact)
+            continue
         hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
                           params["blk%d_ln1_beta" % i])
         qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
             + params["blk%d_attn_in_bias" % i]
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        # append the chunk's KV at its absolute rows (one vectorized
-        # scatter; only trash rows can collide, and nothing reads them)
-        k_pool, k_scale = _kv_append(k_pool, k_scale, i, pages, offsets,
-                                     k.reshape(t_b, h, d), kv_quant)
-        v_pool, v_scale = _kv_append(v_pool, v_scale, i, pages, offsets,
-                                     v.reshape(t_b, h, d), kv_quant)
-        ctx_k = k_pool[i][table_row].reshape(1, max_pages * page_size,
-                                             h, d).transpose(0, 2, 1, 3)
-        ctx_v = v_pool[i][table_row].reshape(1, max_pages * page_size,
-                                             h, d).transpose(0, 2, 1, 3)
-        ks = vs = None
-        if kv_quant:
-            ks = k_scale[i][table_row].reshape(1, max_pages * page_size)
-            vs = v_scale[i][table_row].reshape(1, max_pages * page_size)
-        att = decode_attention(
-            q.reshape(1, t_b, h, d).transpose(0, 2, 1, 3),
-            ctx_k, ctx_v, row_valid, block=page_size, mi=exact,
-            k_scale=ks, v_scale=vs)
+        if kind == "window":
+            ring_tokens = kw_pool.shape[2]
+            ring_rows = abs_pos % ring_tokens
+            kw_pool, kw_scale = _ring_append(
+                kw_pool, kw_scale, wi, slot, ring_rows,
+                k.reshape(t_b, h, d), kv_quant)
+            vw_pool, vw_scale = _ring_append(
+                vw_pool, vw_scale, wi, slot, ring_rows,
+                v.reshape(t_b, h, d), kv_quant)
+            pb_max = (offset + t_b - 1) // page_size
+            ctx_k, ks, kp = _ring_gather(kw_pool, kw_scale, wi,
+                                         jnp.atleast_1d(pb_max),
+                                         page_size, kv_quant, slot=slot)
+            ctx_v, vs, _ = _ring_gather(vw_pool, vw_scale, wi,
+                                        jnp.atleast_1d(pb_max),
+                                        page_size, kv_quant, slot=slot)
+            att = decode_attention(
+                q.reshape(1, t_b, h, d).transpose(0, 2, 1, 3),
+                ctx_k.transpose(0, 2, 1, 3), ctx_v.transpose(0, 2, 1, 3),
+                row_valid, block=page_size, mi=exact, k_scale=ks,
+                v_scale=vs, window=cfg.window, k_positions=kp)
+            wi += 1
+        else:
+            # append the chunk's KV at its absolute rows (one vectorized
+            # scatter; only trash rows can collide, nothing reads them)
+            k_pool, k_scale = _kv_append(k_pool, k_scale, fi, pages,
+                                         offsets, k.reshape(t_b, h, d),
+                                         kv_quant)
+            v_pool, v_scale = _kv_append(v_pool, v_scale, fi, pages,
+                                         offsets, v.reshape(t_b, h, d),
+                                         kv_quant)
+            ctx_k = k_pool[fi][table_row].reshape(
+                1, max_pages * page_size, h, d).transpose(0, 2, 1, 3)
+            ctx_v = v_pool[fi][table_row].reshape(
+                1, max_pages * page_size, h, d).transpose(0, 2, 1, 3)
+            ks = vs = None
+            if kv_quant:
+                ks = k_scale[fi][table_row].reshape(
+                    1, max_pages * page_size)
+                vs = v_scale[fi][table_row].reshape(
+                    1, max_pages * page_size)
+            att = decode_attention(
+                q.reshape(1, t_b, h, d).transpose(0, 2, 1, 3),
+                ctx_k, ctx_v, row_valid, block=page_size, mi=exact,
+                k_scale=ks, v_scale=vs)
+            fi += 1
         ctx = att.transpose(0, 2, 1, 3).reshape(1, t_b, cfg.d_model)
         out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
             + params["blk%d_attn_out_bias" % i]
@@ -383,14 +608,15 @@ def prefill_forward(params, tokens, length, offset, table_row, k_pool,
         + params["lm_head_bias"]
     last = jnp.take(logits[0], length - 1, axis=0)
     first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
-    if kv_quant:
-        return first_token, last, k_pool, v_pool, k_scale, v_scale
-    return first_token, last, k_pool, v_pool
+    return (first_token, last) + _pool_pack(
+        k_pool, v_pool, k_scale, v_scale, kw_pool, vw_pool, kw_scale,
+        vw_scale, ssm_state, kv_quant)
 
 
 def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
                 page_size, exact=None, k_scale=None, v_scale=None,
-                kv_quant=""):
+                kv_quant="", kw_pool=None, vw_pool=None, kw_scale=None,
+                vw_scale=None, ssm_state=None):
     """One continuous-batching decode step for every slot at once.
 
     tokens: (S,) int32 — each slot's previous output token; lengths:
@@ -399,12 +625,20 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     all-trash rows, length 0).  Appends each slot's new KV at
     ``lengths``, attends over the gathered pages with the shared
     online-softmax kernel, and returns
-    (next_tokens (S,), logits (S, V), k_pool, v_pool).
+    (next_tokens (S,), logits (S, V), *pools).
 
     Per-token cost is constant in the generated length: fixed-shape
     gather/scatter over the page pool plus ``Tcap/page_size`` block
     visits — there is no tensor here whose size depends on how many
     tokens any request has generated.
+
+    Hybrid stacks tighten that constant further: windowed layers write
+    the token's KV at ``lengths % ring_tokens`` in the slot's ring and
+    attend over only ``ring_tokens`` rows (the rotated position-labeled
+    gather); SSM layers advance the (H, D, D) recurrence one step.
+    Idle slots harmlessly re-write their own ring row 0 / state (both
+    are re-initialized on alloc/prefill before anything reads them) —
+    the hybrid analog of idle slots writing the trash page.
     """
     import jax.numpy as jnp
 
@@ -421,31 +655,79 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     page_slot = jnp.clip(lengths // page_size, 0, max_pages - 1)
     page = jnp.take_along_axis(tables, page_slot[:, None], axis=1)[:, 0]
     offset = lengths % page_size
-    for i in range(cfg.num_layers):
+    slot_ids = jnp.arange(s)
+    fi = wi = si = 0
+    for i, kind in enumerate(cfg.kinds):
+        if kind == "ssm":
+            hdn = _layer_norm(x[:, None, :],
+                              params["blk%d_ln1_gamma" % i],
+                              params["blk%d_ln1_beta" % i])
+            qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
+                + params["blk%d_attn_in_bias" % i]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            from ..ops.ssm_ops import ssm_decay, ssm_scan
+
+            y, state = ssm_scan(q.reshape(s, 1, h, d),
+                                k.reshape(s, 1, h, d),
+                                v.reshape(s, 1, h, d),
+                                ssm_state[si], ssm_decay(h))
+            ssm_state = ssm_state.at[si].set(state)
+            ctx = y.astype(x.dtype).reshape(s, cfg.d_model)
+            out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+                + params["blk%d_attn_out_bias" % i]
+            x = x + out
+            x = _block_mlp(params, i, x, exact)
+            si += 1
+            continue
         hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
                           params["blk%d_ln1_beta" % i])
         qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
             + params["blk%d_attn_in_bias" % i]
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        # append this token's KV at (page, offset); inactive slots write
-        # the trash page (their table rows are all-trash)
-        k_pool, k_scale = _kv_append(k_pool, k_scale, i, page, offset,
-                                     k.reshape(s, h, d), kv_quant)
-        v_pool, v_scale = _kv_append(v_pool, v_scale, i, page, offset,
-                                     v.reshape(s, h, d), kv_quant)
-        # gather the slot's full page set: (S, P, page, H, D) ->
-        # (S, H, P*page, D)
-        ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
-        ctx_v = v_pool[i][tables].reshape(s, max_pages * page_size, h, d)
-        ctx_k = ctx_k.transpose(0, 2, 1, 3)
-        ctx_v = ctx_v.transpose(0, 2, 1, 3)
-        ks = vs = None
-        if kv_quant:
-            ks = k_scale[i][tables].reshape(s, max_pages * page_size)
-            vs = v_scale[i][tables].reshape(s, max_pages * page_size)
-        att = decode_attention(q.reshape(s, h, 1, d), ctx_k, ctx_v,
-                               lengths + 1, block=page_size, mi=exact,
-                               k_scale=ks, v_scale=vs)
+        if kind == "window":
+            ring_tokens = kw_pool.shape[2]
+            ring_rows = lengths % ring_tokens
+            kw_pool, kw_scale = _ring_append(
+                kw_pool, kw_scale, wi, slot_ids, ring_rows,
+                k.reshape(s, h, d), kv_quant)
+            vw_pool, vw_scale = _ring_append(
+                vw_pool, vw_scale, wi, slot_ids, ring_rows,
+                v.reshape(s, h, d), kv_quant)
+            pb_max = lengths // page_size
+            ctx_k, ks, kp = _ring_gather(kw_pool, kw_scale, wi, pb_max,
+                                         page_size, kv_quant)
+            ctx_v, vs, _ = _ring_gather(vw_pool, vw_scale, wi, pb_max,
+                                        page_size, kv_quant)
+            att = decode_attention(q.reshape(s, h, 1, d),
+                                   ctx_k.transpose(0, 2, 1, 3),
+                                   ctx_v.transpose(0, 2, 1, 3),
+                                   lengths + 1, block=page_size, mi=exact,
+                                   k_scale=ks, v_scale=vs,
+                                   window=cfg.window, k_positions=kp)
+            wi += 1
+        else:
+            # append this token's KV at (page, offset); inactive slots
+            # write the trash page (their table rows are all-trash)
+            k_pool, k_scale = _kv_append(k_pool, k_scale, fi, page,
+                                         offset, k.reshape(s, h, d),
+                                         kv_quant)
+            v_pool, v_scale = _kv_append(v_pool, v_scale, fi, page,
+                                         offset, v.reshape(s, h, d),
+                                         kv_quant)
+            # gather the slot's full page set: (S, P, page, H, D) ->
+            # (S, H, P*page, D)
+            ctx_k = k_pool[fi][tables].reshape(
+                s, max_pages * page_size, h, d).transpose(0, 2, 1, 3)
+            ctx_v = v_pool[fi][tables].reshape(
+                s, max_pages * page_size, h, d).transpose(0, 2, 1, 3)
+            ks = vs = None
+            if kv_quant:
+                ks = k_scale[fi][tables].reshape(s, max_pages * page_size)
+                vs = v_scale[fi][tables].reshape(s, max_pages * page_size)
+            att = decode_attention(q.reshape(s, h, 1, d), ctx_k, ctx_v,
+                                   lengths + 1, block=page_size, mi=exact,
+                                   k_scale=ks, v_scale=vs)
+            fi += 1
         ctx = att.transpose(0, 2, 1, 3).reshape(s, cfg.d_model)
         out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
             + params["blk%d_attn_out_bias" % i]
@@ -455,14 +737,15 @@ def decode_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     logits = _mm(x, params["lm_head_weight"], exact) \
         + params["lm_head_bias"]
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if kv_quant:
-        return next_tokens, logits, k_pool, v_pool, k_scale, v_scale
-    return next_tokens, logits, k_pool, v_pool
+    return (next_tokens, logits) + _pool_pack(
+        k_pool, v_pool, k_scale, v_scale, kw_pool, vw_pool, kw_scale,
+        vw_scale, ssm_state, kv_quant)
 
 
 def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
                 page_size, exact=None, k_scale=None, v_scale=None,
-                kv_quant=""):
+                kv_quant="", kw_pool=None, vw_pool=None, kw_scale=None,
+                vw_scale=None, ssm_state=None, limits=None):
     """Speculative-decoding verify: advance every slot ``W = K + 1``
     teacher-forced positions in ONE fixed-shape step.
 
@@ -472,7 +755,7 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     Writes all W rows' KV at positions ``lengths .. lengths + W - 1``
     and attends row ``j`` over exactly ``lengths + j + 1`` keys (the
     causal horizon expressed as a per-row validity length), then
-    returns (greedy (S, W), logits (S, W, V), k_pool, v_pool).
+    returns (greedy (S, W), logits (S, W, V), *pools).
 
     Bit-exactness contract: with ``exact=True`` every op here is the
     M-invariant form of the matching :func:`decode_step` op, and the
@@ -488,6 +771,18 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     ``spec_pad_pages`` all-trash columns so the page clip below can
     never alias a real page); such rows are never committed, so their
     garbage logits are dead by construction.
+
+    Hybrid-stack rollback is O(1) by construction.  Windowed layers
+    write all W rows into the ring at their deterministic slots
+    ``abs_pos % ring_tokens``; rejected rows need no undo — after the
+    host rolls ``lengths`` back, their ring rows label as positions
+    outside every future mask until the committed stream rewrites them.
+    SSM layers scan with ``collect=True`` and, because a recurrence has
+    no per-row storage to mask, the acceptance count is recomputed
+    IN-GRAPH (``limits``: (S,) int32 per-slot commit cap — the same
+    integer comparison the host's commit loop runs) to select each
+    slot's state snapshot at its commit point; only that snapshot is
+    written back, so a rejected suffix never touches committed state.
     """
     import jax.numpy as jnp
 
@@ -507,7 +802,10 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     page_slot = jnp.clip(abs_pos // page_size, 0, max_pages - 1)
     pages = jnp.take_along_axis(tables, page_slot, axis=1)  # (S, W)
     offsets = abs_pos % page_size
-    for i in range(cfg.num_layers):
+    slot_ids = jnp.arange(s)
+    ssm_snaps = []          # (pool index, (W, S, H, D, D) snapshots)
+    fi = wi = si = 0
+    for i, kind in enumerate(cfg.kinds):
         hdn = _layer_norm(x, params["blk%d_ln1_gamma" % i],
                           params["blk%d_ln1_beta" % i])
         qkv = _mm(hdn, params["blk%d_attn_in_weight" % i], exact) \
@@ -515,25 +813,62 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
         q, k, v = jnp.split(qkv, 3, axis=-1)
         k = k.reshape(s, w, h, d)
         v = v.reshape(s, w, h, d)
+        if kind == "ssm":
+            from ..ops.ssm_ops import ssm_decay, ssm_scan
+
+            y, _, snaps = ssm_scan(q.reshape(s, w, h, d), k, v,
+                                   ssm_state[si], ssm_decay(h),
+                                   collect=True)
+            ssm_snaps.append((si, snaps))
+            si += 1
+            ctx = y.astype(x.dtype).reshape(s, w, cfg.d_model)
+            out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
+                + params["blk%d_attn_out_bias" % i]
+            x = x + out
+            x = _block_mlp(params, i, x, exact)
+            continue
         # append all W rows' KV, then attend with per-row horizons: row
         # j only ever reads rows <= j of this very step plus committed
         # context, so write-then-attend reproduces the serial interleave
-        for j in range(w):
-            k_pool, k_scale = _kv_append(k_pool, k_scale, i, pages[:, j],
-                                         offsets[:, j], k[:, j], kv_quant)
-            v_pool, v_scale = _kv_append(v_pool, v_scale, i, pages[:, j],
-                                         offsets[:, j], v[:, j], kv_quant)
-        ctx_k = k_pool[i][tables].reshape(s, max_pages * page_size, h, d)
-        ctx_v = v_pool[i][tables].reshape(s, max_pages * page_size, h, d)
-        ctx_k = ctx_k.transpose(0, 2, 1, 3)
-        ctx_v = ctx_v.transpose(0, 2, 1, 3)
-        ks = vs = None
-        if kv_quant:
-            ks = k_scale[i][tables].reshape(s, max_pages * page_size)
-            vs = v_scale[i][tables].reshape(s, max_pages * page_size)
+        if kind == "window":
+            ring_tokens = kw_pool.shape[2]
+            for j in range(w):
+                rr = abs_pos[:, j] % ring_tokens
+                kw_pool, kw_scale = _ring_append(
+                    kw_pool, kw_scale, wi, slot_ids, rr, k[:, j], kv_quant)
+                vw_pool, vw_scale = _ring_append(
+                    vw_pool, vw_scale, wi, slot_ids, rr, v[:, j], kv_quant)
+            pb_max = (lengths + w - 1) // page_size
+            ctx_k, ks, kp = _ring_gather(kw_pool, kw_scale, wi, pb_max,
+                                         page_size, kv_quant)
+            ctx_v, vs, _ = _ring_gather(vw_pool, vw_scale, wi, pb_max,
+                                        page_size, kv_quant)
+            ctx_k = ctx_k.transpose(0, 2, 1, 3)
+            ctx_v = ctx_v.transpose(0, 2, 1, 3)
+            win = cfg.window
+            wi += 1
+        else:
+            for j in range(w):
+                k_pool, k_scale = _kv_append(k_pool, k_scale, fi,
+                                             pages[:, j], offsets[:, j],
+                                             k[:, j], kv_quant)
+                v_pool, v_scale = _kv_append(v_pool, v_scale, fi,
+                                             pages[:, j], offsets[:, j],
+                                             v[:, j], kv_quant)
+            ctx_k = k_pool[fi][tables].reshape(
+                s, max_pages * page_size, h, d).transpose(0, 2, 1, 3)
+            ctx_v = v_pool[fi][tables].reshape(
+                s, max_pages * page_size, h, d).transpose(0, 2, 1, 3)
+            ks = vs = kp = None
+            if kv_quant:
+                ks = k_scale[fi][tables].reshape(s, max_pages * page_size)
+                vs = v_scale[fi][tables].reshape(s, max_pages * page_size)
+            win = 0
+            fi += 1
         att = decode_attention(q.reshape(s, w, h, d).transpose(0, 2, 1, 3),
                                ctx_k, ctx_v, row_valid, block=page_size,
-                               mi=exact, k_scale=ks, v_scale=vs)
+                               mi=exact, k_scale=ks, v_scale=vs,
+                               window=win, k_positions=kp)
         ctx = att.transpose(0, 2, 1, 3).reshape(s, w, cfg.d_model)
         out = _mm(ctx, params["blk%d_attn_out_weight" % i], exact) \
             + params["blk%d_attn_out_bias" % i]
@@ -543,14 +878,32 @@ def verify_step(params, tokens, lengths, tables, k_pool, v_pool, cfg,
     logits = _mm(x, params["lm_head_weight"], exact) \
         + params["lm_head_bias"]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if kv_quant:
-        return greedy, logits, k_pool, v_pool, k_scale, v_scale
-    return greedy, logits, k_pool, v_pool
+    if ssm_snaps:
+        # in-graph acceptance: leading run of draft tokens that match
+        # greedy — integer-exact, so it reproduces the host commit loop
+        agree = (tokens[:, 1:].astype(jnp.int32) == greedy[:, :-1])
+        run = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                      axis=1)
+        c = 1 + run
+        if limits is not None:
+            c = jnp.minimum(c, limits.astype(jnp.int32))
+        idx = jnp.clip(c - 1, 0, w - 1)
+        for si, snaps in ssm_snaps:
+            # (W, S, H, D, D) -> (S, W, H, D, D), pick each slot's
+            # commit-point snapshot
+            per_slot = jnp.moveaxis(snaps, 0, 1)
+            sel = jnp.take_along_axis(
+                per_slot, idx[:, None, None, None, None], axis=1)[:, 0]
+            ssm_state = ssm_state.at[si].set(sel)
+    return (greedy, logits) + _pool_pack(
+        k_pool, v_pool, k_scale, v_scale, kw_pool, vw_pool, kw_scale,
+        vw_scale, ssm_state, kv_quant)
 
 
 def draft_propose(params, tokens, n_feed, lengths, tables, k_pool, v_pool,
                   cfg, page_size, exact=None, k_scale=None, v_scale=None,
-                  kv_quant=""):
+                  kv_quant="", kw_pool=None, vw_pool=None, kw_scale=None,
+                  vw_scale=None):
     """Draft-model K+1-step scan: one dispatch that both *ingests*
     committed tokens and *proposes* speculative continuations.
 
@@ -561,40 +914,47 @@ def draft_propose(params, tokens, n_feed, lengths, tables, k_pool, v_pool,
     ``n_feed = W`` is pure teacher forcing (prompt ingestion in W-token
     chunks).  Every step appends its token's KV at ``lengths + j``, so
     the draft cache tracks exactly the positions the target cache holds.
-    Returns (outs (S, W), k_pool, v_pool) where ``outs[:, j]`` is the
-    greedy token after feeding position ``lengths + j`` — propose mode
-    uses ``outs[:, :W-1]`` as its K proposals.
+    Returns (outs (S, W), *pools) where ``outs[:, j]`` is the greedy
+    token after feeding position ``lengths + j`` — propose mode uses
+    ``outs[:, :W-1]`` as its K proposals.
+
+    Draft stacks may mix full and windowed layers (the ring append /
+    rotated gather is scan-compatible and rollback is lengths-only) but
+    never SSM layers — see the guard below.
     """
     import jax.numpy as jnp
     from jax import lax
 
     if exact is None:
         exact = exact_mode()
+    if "ssm" in cfg.kinds:
+        # an SSM draft would need its own state pool threaded through the
+        # scan AND verify-synchronized rollback; nothing needs it, so the
+        # session rejects the configuration up front
+        raise MXNetError("draft_propose: SSM layers are not supported in "
+                         "draft models")
     # resolve once, outside the scan body, so the dequantized weights
     # are loop invariants XLA hoists rather than per-step work
     params = _resolve_params(params)
+    pools0 = _pool_pack(k_pool, v_pool, k_scale, v_scale, kw_pool,
+                        vw_pool, kw_scale, vw_scale, None, kv_quant)
+    names = _pool_names(kv_quant, kw_pool is not None, False)
 
     def body(carry, xs):
-        prev, kp, vp, ks, vs = carry
+        prev, pools = carry
         teach, j = xs
         tok = jnp.where(j < n_feed, teach, prev)
-        out = decode_step(params, tok, lengths + j, tables, kp, vp, cfg,
-                          page_size, exact=exact, k_scale=ks, v_scale=vs,
-                          kv_quant=kv_quant)
-        if kv_quant:
-            nxt, _, kp, vp, ks, vs = out
-        else:
-            nxt, _, kp, vp = out
-        return (nxt, kp, vp, ks, vs), nxt
+        out = decode_step(params, tok, lengths + j, tables,
+                          cfg=cfg, page_size=page_size, exact=exact,
+                          kv_quant=kv_quant,
+                          **dict(zip(names, pools)))
+        return (out[0], out[2:]), out[0]
 
     w = tokens.shape[1]
     xs = (tokens.T, jnp.arange(w, dtype=lengths.dtype))
-    carry0 = (tokens[:, 0].astype(jnp.int32), k_pool, v_pool,
-              k_scale, v_scale)
-    (_, k_pool, v_pool, k_scale, v_scale), outs = lax.scan(body, carry0, xs)
-    if kv_quant:
-        return outs.T, k_pool, v_pool, k_scale, v_scale
-    return outs.T, k_pool, v_pool
+    carry0 = (tokens[:, 0].astype(jnp.int32), pools0)
+    (_, pools), outs = lax.scan(body, carry0, xs)
+    return (outs.T,) + pools
 
 
 @functools.lru_cache(maxsize=None)

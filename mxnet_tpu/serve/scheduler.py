@@ -41,6 +41,15 @@ exact, the recomputed stream is bit-identical to a never-evicted one —
 the resume asserts it by checking the replayed token against the last
 committed one.  Oversubscription changes capacity, never content.
 
+Hybrid stacks (windowed/SSM layers) ride the same resume path with no
+extra bookkeeping: eviction releases only pages (a slot's window rings
+and SSM state stay physically allocated but become garbage), and the
+re-prefill deterministically reconstructs both — ring rows are a pure
+function of the replayed tokens and their positions, and the SSM
+recurrence replays from its zero alloc state through the identical
+chunked scan — so the bit-exact divergence assert above pins ring and
+state reconstruction exactly as it pins page contents.
+
 SLO-aware admission (``session.config.ttft_slo_ms`` > 0): arrivals are
 admitted can-still-meet-the-TTFT-budget first (FIFO within each class),
 so a burst spends its slots on requests that still count toward

@@ -81,13 +81,36 @@ active slots on demand (:meth:`InferenceSession.pages_short` is the
 scheduler's shortfall probe, and the scheduler preempts requests when
 the pool runs below its watermark before the growth would fail).
 
+Hybrid stacks (``layers`` / ``MXNET_SERVE_LAYERS`` +
+``window`` / ``MXNET_SERVE_WINDOW``): a per-layer kind pattern
+(``full`` | ``window`` | ``ssm``, cycled over the model's depth) turns
+the decoder into a hybrid stack whose per-slot memory is O(1) in
+context length.  Windowed layers keep a fixed ring of pages per slot
+(ring append overwrites the oldest rows in place; the rotated,
+position-labeled gather keeps attention bit-exact against the windowed
+reference); SSM layers keep one (H, D, D) fp32 recurrence state per
+slot in the cache's state pool, prefill advances it with a chunked
+in-dispatch scan and decode with the same scan at T=1 — identical op
+sequences, so chunked and serial execution are bit-identical.  The
+executable count stays frozen (hybrid changes argument lists, not the
+executable set), speculative decoding composes (verify recomputes
+acceptance in-graph to commit SSM state snapshots at each slot's
+commit point; rings roll back lengths-only), and preempt/resume uses
+the same deterministic re-prefill oracle — re-running prefill
+reconstructs ring contents and SSM state exactly.  Prefix caching is
+the one subsystem hybrid stacks opt out of: rings and states are
+slot-private, so the only window-aligned boundary at which every layer
+kind's state is reconstructible from published pages is offset 0 —
+lookups miss and nothing is published.
+
 Env knobs (see docs/env_vars.md): ``MXNET_SERVE_SLOTS``,
 ``MXNET_SERVE_PAGE``, ``MXNET_SERVE_BUCKETS``, ``MXNET_SERVE_MAX_NEW``,
 ``MXNET_SERVE_PAGES``, ``MXNET_SERVE_EXACT``, ``MXNET_SERVE_SPEC_K``,
 ``MXNET_SERVE_DRAFT``, ``MXNET_SERVE_QUANT``,
 ``MXNET_SERVE_KV_QUANT``, ``MXNET_SERVE_PREFIX_PAGES``,
 ``MXNET_SERVE_OVERSUB``,
-``MXNET_SERVE_WATERMARK``, ``MXNET_SERVE_TTFT_SLO_MS``.
+``MXNET_SERVE_WATERMARK``, ``MXNET_SERVE_TTFT_SLO_MS``,
+``MXNET_SERVE_WINDOW``, ``MXNET_SERVE_LAYERS``.
 """
 from __future__ import annotations
 
@@ -98,8 +121,8 @@ import time
 from ..base import MXNetError, get_env
 from ..quantize import quant_mode
 from .kv_cache import PagedKVCache
-from .model import ModelConfig, config_from_params, decode_step, \
-    draft_propose, exact_mode, prefill_forward, verify_step
+from .model import ModelConfig, _pool_names, config_from_params, \
+    decode_step, draft_propose, exact_mode, prefill_forward, verify_step
 
 __all__ = ["ServeConfig", "InferenceSession"]
 
@@ -142,6 +165,14 @@ class ServeConfig:
     oversub: bool = False  # admit by current need, grow on demand
     watermark: int = 0  # free-pool floor that triggers preemption
     ttft_slo_ms: float = 0.0  # 0 = no TTFT budget (SLO admission off)
+    window: int = 0  # sliding-window length for "window" layers
+    layers: str = ""  # layer-kind pattern, e.g. "full,window,ssm"
+    # ``layers`` is cycled over the model's depth ("full,window" on a
+    # 4-layer model -> full,window,full,window); ``window`` sizes every
+    # "window" layer's attention span (its KV lives in a fixed ring of
+    # pages per slot, so per-slot bytes stop scaling with context).
+    # Empty layers = the classic all-full-attention stack; window is
+    # ignored then.
 
     @classmethod
     def from_env(cls, **overrides):
@@ -161,6 +192,8 @@ class ServeConfig:
             oversub=get_env("MXNET_SERVE_OVERSUB", False, bool),
             watermark=get_env("MXNET_SERVE_WATERMARK", 0, int),
             ttft_slo_ms=get_env("MXNET_SERVE_TTFT_SLO_MS", 0.0, float),
+            window=get_env("MXNET_SERVE_WINDOW", 0, int),
+            layers=get_env("MXNET_SERVE_LAYERS", "", str),
         )
         vals.update(overrides)
         return cls(**vals)
@@ -185,6 +218,51 @@ class ServeConfig:
                 raise MXNetError(
                     "ServeConfig: bucket %d is not a multiple of page_size "
                     "%d (prefill writes whole pages)" % (b, self.page_size))
+        if self.window < 0:
+            raise MXNetError("ServeConfig: window must be >= 0")
+        pat = self.layer_pattern
+        bad = set(pat) - {"full", "window", "ssm"}
+        if bad:
+            raise MXNetError("ServeConfig: unknown layer kinds %r in "
+                             "layers=%r" % (sorted(bad), self.layers))
+        if "window" in pat and self.window < 1:
+            raise MXNetError(
+                "ServeConfig: layers=%r has windowed layers but window "
+                "is unset (MXNET_SERVE_WINDOW)" % (self.layers,))
+
+    @property
+    def layer_pattern(self):
+        """``layers`` parsed into a kind tuple (may be shorter than the
+        model — :meth:`kinds_for` cycles it over the real depth)."""
+        return tuple(t.strip() for t in self.layers.replace(";", ",")
+                     .split(",") if t.strip())
+
+    def kinds_for(self, num_layers):
+        """Per-layer kinds for an ``num_layers``-deep model: the
+        ``layers`` pattern repeated to cover the stack.  All-full
+        patterns normalize to ``()`` so they keep the classic executable
+        signatures (and recompile-guard names) byte-identical."""
+        pat = self.layer_pattern
+        if not pat:
+            return ()
+        kinds = tuple(pat[i % len(pat)] for i in range(int(num_layers)))
+        return () if set(kinds) == {"full"} else kinds
+
+    @property
+    def ring_pages(self):
+        """Ring capacity (pages) for each windowed layer's per-slot KV.
+
+        A dispatch writes up to ``write_span`` rows (the largest prefill
+        chunk, or the speculative window) before its queries read, so a
+        ring must hold the window plus the whole span minus the row that
+        overlaps (``window + span - 1`` rows) for no visible key to be
+        overwritten mid-dispatch — plus one extra page because the
+        rotated gather is page-granular: the newest page may be only
+        one row full, yet the gather must still reach ``window + span -
+        1`` rows below that row."""
+        span = max(max(self.buckets),
+                   self.spec_window if self.spec_k else 1)
+        return -(-(self.window + span - 1) // self.page_size) + 1
 
     @property
     def max_pages_per_slot(self):
@@ -269,6 +347,13 @@ class InferenceSession(object):
             arr = getattr(v, "_data", v)
             self.params[k] = jnp.asarray(arr, jnp.float32)
         self.model = config_from_params(self.params, num_heads=num_heads)
+        kinds = cfg.kinds_for(self.model.num_layers)
+        if kinds:
+            # hybrid stack: the kind pattern cycles over the real depth
+            # and every kind reuses the block's attention weights, so
+            # any checkpoint hosts any stack
+            self.model = dataclasses.replace(
+                self.model, window=cfg.window, layer_kinds=kinds).validate()
         if max(cfg.buckets) + cfg.max_new > self.model.max_len:
             raise MXNetError(
                 "ServeConfig worst case %d (bucket %d + max_new %d) exceeds "
@@ -285,7 +370,10 @@ class InferenceSession(object):
             max_pages_per_slot=cfg.max_pages_per_slot,
             table_pad=cfg.spec_pad_pages,
             prefix_pages=cfg.prefix_pages,
-            kv_quant=cfg.kv_quant)
+            kv_quant=cfg.kv_quant,
+            layer_kinds=self.model.layer_kinds,
+            window=self.model.window,
+            ring_pages=cfg.ring_pages if "window" in kinds else 0)
         self._slot_tokens = {}  # slot -> next token to feed the decoder
         self._slot_history = {}  # slot -> prompt + committed tokens
         self._spec_stats = {"verify_steps": 0, "slot_steps": 0,
@@ -324,6 +412,14 @@ class InferenceSession(object):
             # quantized KV pools change every executable's pool avals
             # (storage dtype + parallel scale arrays)
             self._guard_prefix += "-kv%s" % cfg.kv_quant
+        if self.model.hybrid:
+            # hybrid stacks add ring/state pool avals (and a window
+            # length baked into every trace), so they must never share
+            # a guard with the classic stack — tag: window length plus
+            # the per-layer kind initials (f/w/s)
+            self._guard_prefix += "-w%d%s" % (
+                self.model.window,
+                "".join(k[0] for k in self.model.kinds))
         self._compile_all()
 
     def _resolve_draft(self, draft_params, draft_num_heads):
@@ -344,6 +440,7 @@ class InferenceSession(object):
                     "ServeConfig.spec_k (MXNET_SERVE_SPEC_K) to enable "
                     "speculative decoding")
             return
+        inherit_layers = None
         if draft_params is None:
             spec = cfg.draft or "ngram"
             if spec == "ngram":
@@ -353,6 +450,11 @@ class InferenceSession(object):
                 n = int(spec.split(":", 1)[1])
                 draft_params = _layer_truncated(self.params, n)
                 draft_num_heads = draft_num_heads or self.model.num_heads
+                # a layer-skip draft IS the target's first n blocks, so
+                # it inherits their kinds (and the window) — its ring
+                # writes then track the target's committed stream and
+                # roll back lengths-only, exactly like the paged pools
+                inherit_layers = n
             else:
                 from ..checkpoint import CheckpointManager
 
@@ -371,6 +473,18 @@ class InferenceSession(object):
         self.draft_model = config_from_params(
             self.draft_params,
             num_heads=draft_num_heads or self.model.num_heads)
+        if inherit_layers is not None and self.model.hybrid:
+            dkinds = self.model.kinds[:inherit_layers]
+            if set(dkinds) != {"full"}:
+                self.draft_model = dataclasses.replace(
+                    self.draft_model, window=self.model.window,
+                    layer_kinds=dkinds).validate()
+        if "ssm" in self.draft_model.kinds:
+            raise MXNetError(
+                "draft model has SSM layers — a draft's speculative rows "
+                "must roll back O(1), and an SSM draft would need its own "
+                "verify-synchronized state pool; put SSM layers above the "
+                "draft depth or use the ngram draft")
         if self.draft_model.vocab_size != self.model.vocab_size:
             raise MXNetError(
                 "draft vocab %d != target vocab %d — a draft must share "
@@ -391,7 +505,11 @@ class InferenceSession(object):
             max_pages_per_slot=cfg.max_pages_per_slot,
             table_pad=cfg.spec_pad_pages,
             prefix_pages=cfg.prefix_pages,
-            kv_quant=cfg.kv_quant)
+            kv_quant=cfg.kv_quant,
+            layer_kinds=self.draft_model.layer_kinds,
+            window=self.draft_model.window,
+            ring_pages=(cfg.ring_pages
+                        if "window" in self.draft_model.kinds else 0))
 
     # -- compilation ------------------------------------------------------
     def _aot(self, name, fn, avals, donate_argnums):
@@ -440,7 +558,6 @@ class InferenceSession(object):
 
     def _compile_all(self):
         import jax
-        import numpy as np
 
         cfg = self.config
         model = self.model
@@ -453,99 +570,108 @@ class InferenceSession(object):
         # executables' arguments are the 1-byte codes themselves
         param_avals = jax.tree.map(lambda v: sds(v.shape, v.dtype),
                                    self.params)
-        # pool avals follow the cache's storage dtype (float32 clean,
-        # 1-byte codes under kv_quant); quantized sessions additionally
-        # pass the parallel per-row scale pools, appended LAST so the
-        # clean-path signatures are untouched
-        pool_aval = sds(self.cache.k_pool.shape, self.cache.k_pool.dtype)
 
-        def scale_avals(cache):
-            if not kvq:
-                return ()
-            a = sds(cache.k_scale.shape, cache.k_scale.dtype)
-            return (a, a)
+        # pool avals in the canonical _pool_pack order — float32 pools
+        # clean, 1-byte codes + scale pools under kv_quant, ring/state
+        # pools appended for hybrid stacks.  The classic all-full stack
+        # keeps its historical signatures byte-identical.
+        def pool_avals(cache):
+            return tuple(sds(p.shape, p.dtype)
+                         for p in self._pool_args(cache))
 
-        extra = scale_avals(self.cache)
+        pools = pool_avals(self.cache)
+        names = _pool_names(kvq, self.cache.n_window > 0,
+                            self.cache.n_ssm > 0)
+        hybrid = self.cache.hybrid
         # table width includes the speculative all-trash pad columns
         # (zero when spec_k == 0, so non-spec avals are unchanged)
         max_pages = self.cache.table_width
 
-        def decode_fn(params, tokens, lengths, tables, k_pool, v_pool,
-                      *scales):
-            return decode_step(params, tokens, lengths, tables, k_pool,
-                               v_pool, model, psize, exact=exact,
-                               k_scale=scales[0] if kvq else None,
-                               v_scale=scales[1] if kvq else None,
-                               kv_quant=kvq)
+        def decode_fn(params, tokens, lengths, tables, *pool_args):
+            return decode_step(params, tokens, lengths, tables,
+                               cfg=model, page_size=psize, exact=exact,
+                               kv_quant=kvq, **dict(zip(names, pool_args)))
 
         self._aot(
             "decode", decode_fn,
             (param_avals, sds((cfg.slots,), i32), sds((cfg.slots,), i32),
-             sds((cfg.slots, max_pages), i32), pool_aval, pool_aval)
-            + extra,
-            donate_argnums=(4, 5) + ((6, 7) if kvq else ()))
+             sds((cfg.slots, max_pages), i32)) + pools,
+            donate_argnums=tuple(range(4, 4 + len(pools))))
 
         for bucket in cfg.buckets:
+            # hybrid prefill takes a slot scalar (rings and SSM state
+            # are slot-indexed, unlike the table-indirected pages)
             def prefill_fn(params, tokens, length, offset, table_row,
-                           k_pool, v_pool, *scales):
+                           *rest):
+                if hybrid:
+                    slot, pool_args = rest[0], rest[1:]
+                else:
+                    slot, pool_args = None, rest
                 return prefill_forward(params, tokens, length, offset,
-                                       table_row, k_pool, v_pool, model,
-                                       psize, exact=exact,
-                                       k_scale=scales[0] if kvq else None,
-                                       v_scale=scales[1] if kvq else None,
-                                       kv_quant=kvq)
+                                       table_row, cfg=model,
+                                       page_size=psize, exact=exact,
+                                       kv_quant=kvq, slot=slot,
+                                       **dict(zip(names, pool_args)))
 
             self._aot(
                 "prefill_%d" % bucket, prefill_fn,
                 (param_avals, sds((1, bucket), i32), sds((), i32),
-                 sds((), i32), sds((max_pages,), i32), pool_aval,
-                 pool_aval) + extra,
-                donate_argnums=(5, 6) + ((7, 8) if kvq else ()))
+                 sds((), i32), sds((max_pages,), i32))
+                + ((sds((), i32),) if hybrid else ()) + pools,
+                donate_argnums=tuple(range(5 + (1 if hybrid else 0),
+                                           5 + (1 if hybrid else 0)
+                                           + len(pools))))
 
         if cfg.spec_k:
             w = cfg.spec_window
+            # SSM layers make the per-slot commit cap an executable
+            # input: the in-graph acceptance recomputation selects each
+            # slot's state snapshot at its commit point (O(1) rollback)
+            has_limits = self.cache.n_ssm > 0
 
-            def verify_fn(params, tokens, lengths, tables, k_pool,
-                          v_pool, *scales):
+            def verify_fn(params, tokens, lengths, tables, *rest):
+                if has_limits:
+                    limits, pool_args = rest[0], rest[1:]
+                else:
+                    limits, pool_args = None, rest
                 return verify_step(params, tokens, lengths, tables,
-                                   k_pool, v_pool, model, psize,
-                                   exact=exact,
-                                   k_scale=scales[0] if kvq else None,
-                                   v_scale=scales[1] if kvq else None,
-                                   kv_quant=kvq)
+                                   cfg=model, page_size=psize,
+                                   exact=exact, kv_quant=kvq,
+                                   limits=limits,
+                                   **dict(zip(names, pool_args)))
 
             self._aot(
                 "verify", verify_fn,
                 (param_avals, sds((cfg.slots, w), i32),
-                 sds((cfg.slots,), i32), sds((cfg.slots, max_pages), i32),
-                 pool_aval, pool_aval) + extra,
-                donate_argnums=(4, 5) + ((6, 7) if kvq else ()))
+                 sds((cfg.slots,), i32), sds((cfg.slots, max_pages), i32))
+                + ((sds((cfg.slots,), i32),) if has_limits else ())
+                + pools,
+                donate_argnums=tuple(range(4 + (1 if has_limits else 0),
+                                           4 + (1 if has_limits else 0)
+                                           + len(pools))))
 
         if self._draft_mode == "model":
             w = cfg.spec_window
             dmodel = self.draft_model
             draft_avals = jax.tree.map(lambda v: sds(v.shape, v.dtype),
                                        self.draft_params)
-            dpool_aval = sds(self.draft_cache.k_pool.shape,
-                             self.draft_cache.k_pool.dtype)
-            dextra = scale_avals(self.draft_cache)
+            dpools = pool_avals(self.draft_cache)
+            dnames = _pool_names(kvq, self.draft_cache.n_window > 0,
+                                 False)
 
-            def draft_fn(params, tokens, n_feed, lengths, tables, k_pool,
-                         v_pool, *scales):
+            def draft_fn(params, tokens, n_feed, lengths, tables,
+                         *pool_args):
                 return draft_propose(params, tokens, n_feed, lengths,
-                                     tables, k_pool, v_pool, dmodel,
-                                     psize, exact=exact,
-                                     k_scale=scales[0] if kvq else None,
-                                     v_scale=scales[1] if kvq else None,
-                                     kv_quant=kvq)
+                                     tables, cfg=dmodel, page_size=psize,
+                                     exact=exact, kv_quant=kvq,
+                                     **dict(zip(dnames, pool_args)))
 
             self._aot(
                 "draft", draft_fn,
                 (draft_avals, sds((cfg.slots, w), i32),
                  sds((cfg.slots,), i32), sds((cfg.slots,), i32),
-                 sds((cfg.slots, max_pages), i32), dpool_aval,
-                 dpool_aval) + dextra,
-                donate_argnums=(5, 6) + ((7, 8) if kvq else ()))
+                 sds((cfg.slots, max_pages), i32)) + dpools,
+                donate_argnums=tuple(range(5, 5 + len(dpools))))
 
     @classmethod
     def from_checkpoint(cls, directory, prefix="model", epoch=None,
@@ -583,18 +709,33 @@ class InferenceSession(object):
             return rec.jitted(*args)
 
     def _pool_args(self, cache):
-        """The pool arguments a dispatch appends: (k, v) pools, plus the
-        per-row scale pools under ``kv_quant``."""
+        """The pool arguments a dispatch appends, in the canonical
+        ``model._pool_pack`` order: (k, v) pools, the per-row scale
+        pools under ``kv_quant``, then any windowed-layer rings (plus
+        ring scales) and the SSM state pool."""
+        pools = [cache.k_pool, cache.v_pool]
         if self.config.kv_quant:
-            return (cache.k_pool, cache.v_pool, cache.k_scale,
-                    cache.v_scale)
-        return (cache.k_pool, cache.v_pool)
+            pools += [cache.k_scale, cache.v_scale]
+        if cache.n_window:
+            pools += [cache.kw_pool, cache.vw_pool]
+            if self.config.kv_quant:
+                pools += [cache.kw_scale, cache.vw_scale]
+        if cache.n_ssm:
+            pools.append(cache.ssm_state)
+        return tuple(pools)
 
     def _store_pools(self, cache, pools):
         """Re-adopt the (donated) pool outputs of a dispatch."""
-        cache.k_pool, cache.v_pool = pools[0], pools[1]
+        it = iter(pools)
+        cache.k_pool, cache.v_pool = next(it), next(it)
         if self.config.kv_quant:
-            cache.k_scale, cache.v_scale = pools[2], pools[3]
+            cache.k_scale, cache.v_scale = next(it), next(it)
+        if cache.n_window:
+            cache.kw_pool, cache.vw_pool = next(it), next(it)
+            if self.config.kv_quant:
+                cache.kw_scale, cache.vw_scale = next(it), next(it)
+        if cache.n_ssm:
+            cache.ssm_state = next(it)
 
     # -- request lifecycle ------------------------------------------------
     def bucket_for(self, prompt_len):
@@ -690,6 +831,14 @@ class InferenceSession(object):
             from ..testing import faults
 
             faults.inject("kv_quant")
+        if self.cache.n_window:
+            # chaos site: fail before any ring row is written — the
+            # slot's ring still holds only rows whose gather labels fall
+            # outside every future mask, so survivors (and this slot's
+            # re-admission) see a consistent ring
+            from ..testing import faults
+
+            faults.inject("kv_window")
         first = last_logits = None
         off = cached
         while off < p:
@@ -701,7 +850,10 @@ class InferenceSession(object):
             args = (self.params, jnp.asarray(toks),
                     jnp.asarray(n, jnp.int32),
                     jnp.asarray(off, jnp.int32),
-                    self.cache.table_row(slot)) + self._pool_args(self.cache)
+                    self.cache.table_row(slot)) \
+                + ((jnp.asarray(slot, jnp.int32),)
+                   if self.cache.hybrid else ()) \
+                + self._pool_args(self.cache)
             out = self._dispatch("prefill_%d" % bucket, args)
             first, last_logits = out[0], out[1]
             self._store_pools(self.cache, out[2:])
@@ -831,17 +983,31 @@ class InferenceSession(object):
         else:
             for slot in active:
                 tokens[slot, 1:] = self._ngram_propose(slot, k)
+        lims = {}
+        for slot in active:
+            limit = w
+            if limits is not None:
+                limit = max(1, min(w, int(limits.get(slot, w))))
+            lims[slot] = limit
         args = (self.params, jnp.asarray(tokens),
-                self.cache.device_lengths(), self.cache.device_tables()) \
-            + self._pool_args(self.cache)
+                self.cache.device_lengths(), self.cache.device_tables())
+        if self.cache.n_ssm:
+            # the same per-slot caps ride into the executable: the
+            # in-graph acceptance recomputation must reach the exact c
+            # the commit loop below reaches, or the committed SSM state
+            # would belong to a different prefix (inactive slots cap at
+            # 1; their state is garbage until alloc re-zeroes it)
+            lim_arr = np.ones((cfg.slots,), np.int32)
+            for slot, limit in lims.items():
+                lim_arr[slot] = limit
+            args += (jnp.asarray(lim_arr),)
+        args += self._pool_args(self.cache)
         res = self._dispatch("verify", args)
         self._store_pools(self.cache, res[2:])
         greedy = np.asarray(res[0])
         self._spec_stats["verify_steps"] += 1
         for slot in active:
-            limit = w
-            if limits is not None:
-                limit = max(1, min(w, int(limits.get(slot, w))))
+            limit = lims[slot]
             # commit greedy[:c]: row 0 unconditionally, then one more
             # per proposal the target's previous row agreed with
             c = 1

@@ -164,6 +164,8 @@ SITES = {
                     "backoff)",
     "kv_quant": "quantized-KV prefill, before the request's pages/"
                 "scales are written",
+    "kv_window": "hybrid-stack prefill, before any windowed-layer ring "
+                 "row or SSM state update is written",
     "data_decode": "inside each data-service decode task (worker "
                    "process, or inline with num_workers=0)",
     "data_service": "data-service consumer next()",

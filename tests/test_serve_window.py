@@ -1,0 +1,474 @@
+"""Hybrid serving stacks with O(1) per-slot memory: sliding-window
+attention rings + SSM scan layers (mxnet_tpu/ops/ssm_ops.py,
+mxnet_tpu/serve/, docs/serving.md "Hybrid stacks").  Covers windowed
+decode bit-exact against the windowed reference oracle across kv_quant
+modes, the ring gather's position-labeled rotation at the ops level
+(fp32 and bf16), chunked-prefill == serial SSM recurrence, speculative
+verify with in-graph O(1) hybrid rollback, watermark preempt/resume vs
+a never-evicted oracle, the ``kv_window`` chaos site, prefix-cache
+opt-out, and the frozen executable contract."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import serve
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import model as serve_model
+from mxnet_tpu.serve.kv_cache import PagedKVCache
+from mxnet_tpu.serve.scheduler import Request, Scheduler
+from mxnet_tpu.testing import faults
+
+CFG = serve.ModelConfig(vocab_size=61, num_layers=3, d_model=32,
+                        num_heads=2, max_len=256)
+PAGE = 8
+WINDOW = 8
+HYBRID = dict(layers="full,window,ssm", window=WINDOW)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_INJECT", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return serve_model.init_params(CFG, seed=3)
+
+
+@pytest.fixture(scope="module")
+def hybrid_session(params):
+    sconf = serve.ServeConfig(slots=3, page_size=PAGE, buckets=(16, 32),
+                              max_new=8, exact=True, **HYBRID)
+    return serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+
+
+def _ref_row(sess, seq):
+    """The windowed/hybrid reference forward — jitted, padded to the
+    page multiple; eager dispatch fuses differently and is NOT
+    bit-comparable."""
+    return np.asarray(serve_model.reference_last_logits(
+        sess.params, seq, sess.model, sess.config.page_size, exact=True,
+        kv_quant=sess.config.kv_quant))
+
+
+def _greedy_oracle(sess, prompt, max_new):
+    seq = list(prompt)
+    out = []
+    for _ in range(max_new):
+        tok = int(np.argmax(_ref_row(sess, seq)))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def _trace(n, seed, prompt_len=8, max_new=6):
+    rs = np.random.RandomState(seed)
+    return [Request(rid=i,
+                    prompt=rs.randint(1, CFG.vocab_size,
+                                      size=prompt_len).tolist(),
+                    max_new=max_new, arrival_s=0.0)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# config + cache bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_serve_config_hybrid_validation():
+    with pytest.raises(MXNetError):
+        serve.ServeConfig(page_size=PAGE, buckets=(16,), window=-1)
+    with pytest.raises(MXNetError):
+        serve.ServeConfig(page_size=PAGE, buckets=(16,),
+                          layers="full,conv")  # unknown kind
+    with pytest.raises(MXNetError):
+        # window layers demand an explicit window >= 1
+        serve.ServeConfig(page_size=PAGE, buckets=(16,),
+                          layers="window,full")
+    cfg = serve.ServeConfig(page_size=PAGE, buckets=(16, 32),
+                            max_new=8, **HYBRID)
+    # the pattern cycles over the model depth; all-full normalizes away
+    assert cfg.kinds_for(5) == ("full", "window", "ssm", "full",
+                                "window")
+    assert serve.ServeConfig(page_size=PAGE, buckets=(16,),
+                             layers="full").kinds_for(3) == ()
+    # ring bound: ceil((window + span - 1)/page) + 1 with span = the
+    # largest bucket (the biggest burst written before any read)
+    assert cfg.ring_pages == (WINDOW + 32 - 1 + PAGE - 1) // PAGE + 1
+
+
+def test_ring_cache_bookkeeping():
+    cache = PagedKVCache(num_layers=3, num_heads=2, head_dim=4,
+                         page_size=8, num_pages=4, slots=2,
+                         max_pages_per_slot=2,
+                         layer_kinds=("full", "window", "ssm"),
+                         window=8, ring_pages=3)
+    assert (cache.n_full, cache.n_window, cache.n_ssm) == (1, 1, 1)
+    assert cache.hybrid
+    # pools only carry FULL layers; rings and state live beside them
+    assert cache.k_pool.shape[0] == 1
+    assert cache.kw_pool.shape == (1, 2, 24, 2, 4)
+    assert cache.ssm_state.shape == (1, 2, 2, 4, 4)
+    assert cache.pool_bytes() > 2 * cache.k_pool.nbytes
+    # alloc re-zeroes the slot's recurrence state (rings need no zeroing:
+    # stale rows carry out-of-window position labels and mask out)
+    import jax.numpy as jnp
+    cache.ssm_state = jnp.ones_like(cache.ssm_state)
+    slot = cache.alloc(5, 8)
+    assert float(jnp.abs(cache.ssm_state[:, slot]).max()) == 0.0
+
+    # a stack with NO full layers needs no pages at all: admission is
+    # bounded by slots alone (the O(1)-per-slot capacity story)
+    nofull = PagedKVCache(num_layers=2, num_heads=2, head_dim=4,
+                          page_size=8, num_pages=1, slots=3,
+                          max_pages_per_slot=1,
+                          layer_kinds=("window", "ssm"),
+                          window=8, ring_pages=2)
+    assert nofull.pages_needed(8, 8) == 0
+    slots = [nofull.alloc(8, 8) for _ in range(3)]
+    assert all(s is not None for s in slots)
+    assert nofull.free_slots == 0
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: windowed decode vs the windowed reference oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_quant", ["", "int8", "e4m3"])
+def test_hybrid_decode_bitexact_vs_reference(params, kv_quant):
+    """Prefill + decode through a full x window x ssm stack reproduces
+    the full-context hybrid reference forward bit-for-bit — logits, not
+    just argmax — including steps where the window slides past the
+    prompt and the ring wraps, at every KV storage precision."""
+    sconf = serve.ServeConfig(slots=2, page_size=PAGE, buckets=(16, 32),
+                              max_new=16, exact=True, kv_quant=kv_quant,
+                              **HYBRID)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(1, CFG.vocab_size, size=13).tolist()
+    slot = sess.try_alloc(len(prompt), 8)
+    assert slot is not None
+    first, logits = sess.prefill(slot, prompt)
+    np.testing.assert_array_equal(logits, _ref_row(sess, prompt))
+    seq = prompt + [first]
+    for _ in range(6):  # crosses position 16: window slides, ring wraps
+        toks, logs = sess.step()
+        np.testing.assert_array_equal(logs[slot], _ref_row(sess, seq))
+        seq.append(toks[slot])
+    sess.release(slot)
+
+
+def test_hybrid_cobatched_equals_solo(hybrid_session):
+    """Co-batched strangers must not perturb a hybrid stream: rings and
+    SSM states are slot-private and the kernels are M-invariant."""
+    sess = hybrid_session
+    rs = np.random.RandomState(12)
+    p = rs.randint(1, CFG.vocab_size, size=9).tolist()
+
+    def run(neighbors):
+        slot = sess.try_alloc(len(p), 6)
+        first, _ = sess.prefill(slot, p)
+        others = []
+        for q in neighbors:
+            s = sess.try_alloc(len(q), 6)
+            sess.prefill(s, q)
+            others.append(s)
+        out = [first]
+        for _ in range(5):
+            toks, _ = sess.step()
+            out.append(toks[slot])
+        for s in [slot] + others:
+            sess.release(s)
+        return out
+
+    solo = run([])
+    crowd = run([rs.randint(1, CFG.vocab_size, size=14).tolist(),
+                 rs.randint(1, CFG.vocab_size, size=6).tolist()])
+    assert solo == crowd
+
+
+def test_no_full_layers_session_decodes_and_admits_by_slots(params):
+    """A pure window+ssm stack reserves zero pool pages — every slot
+    admits regardless of context length — and still decodes the exact
+    reference stream."""
+    sconf = serve.ServeConfig(slots=3, page_size=PAGE, buckets=(16,),
+                              max_new=8, exact=True,
+                              layers="window,ssm", window=WINDOW)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    assert sess.cache.pages_needed(16, 8) == 0
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(1, CFG.vocab_size, size=11).tolist()
+               for _ in range(3)]
+    slots, seqs = [], []
+    for p in prompts:
+        slot = sess.try_alloc(len(p), 8)
+        assert slot is not None  # all three admit: slot-bounded only
+        first, logits = sess.prefill(slot, p)
+        np.testing.assert_array_equal(logits, _ref_row(sess, p))
+        slots.append(slot)
+        seqs.append(list(p) + [first])
+    for _ in range(4):
+        toks, logs = sess.step()
+        for slot, seq in zip(slots, seqs):
+            np.testing.assert_array_equal(logs[slot], _ref_row(sess, seq))
+            seq.append(toks[slot])
+    for slot in slots:
+        sess.release(slot)
+
+
+# ---------------------------------------------------------------------------
+# ops level: windowed kernels and the ring-gather contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_windowed_decode_matches_flash_last_row(dtype):
+    """One windowed decode step over a contiguous context equals the
+    last row of the windowed flash forward bit-for-bit (both built from
+    the same M-invariant attend_block, same block geometry)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import attention as A
+
+    S, H, T, D, B = 2, 2, 24, 16, 8
+    rs = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rs.randn(S, H, T, D), dtype)
+               for _ in range(3))
+    full = jax.jit(lambda a, b, c: A.flash_attention(
+        a, b, c, causal=True, block=B, mi=True, window=WINDOW))(q, k, v)
+    dec = jax.jit(lambda a, b, c: A.decode_attention(
+        a, b, c, jnp.full((S,), T, jnp.int32), block=B, mi=True,
+        window=WINDOW))(q[:, :, -1:, :], k, v)
+    np.testing.assert_array_equal(np.asarray(dec[:, :, 0], "float32"),
+                                  np.asarray(full[:, :, -1], "float32"))
+
+
+def test_ring_rotation_with_position_labels_is_exact():
+    """The windowed ring contract at the ops level: rotating the context
+    page-granularly (what the ring gather produces) and labeling every
+    row with its absolute position gives the SAME output as the
+    contiguous layout — wrapped/stale rows mask out exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import attention as A
+
+    S, H, T, D, B = 2, 2, 24, 16, 8
+    rs = np.random.RandomState(4)
+    q1 = jnp.asarray(rs.randn(S, H, 1, D), jnp.float32)
+    k, v = (jnp.asarray(rs.randn(S, H, T, D), jnp.float32)
+            for _ in range(2))
+    lengths = jnp.full((S,), T, jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    f = jax.jit(lambda kk, vv, pp: A.decode_attention(
+        q1, kk, vv, lengths, block=B, mi=True, window=WINDOW,
+        k_positions=pp))
+    base = f(k, v, pos)
+    for shift_pages in (1, 2):
+        r = shift_pages * B
+        rot = f(jnp.roll(k, r, axis=2), jnp.roll(v, r, axis=2),
+                jnp.roll(pos, r, axis=1))
+        np.testing.assert_array_equal(np.asarray(rot), np.asarray(base))
+    # garbage rows beyond the window (position labels < T - WINDOW)
+    # must be exact no-ops, not merely small contributions
+    k_bad = k.at[:, :, : T - WINDOW].set(1e6)
+    v_bad = v.at[:, :, : T - WINDOW].set(-1e6)
+    np.testing.assert_array_equal(np.asarray(f(k_bad, v_bad, pos)),
+                                  np.asarray(base))
+
+
+def test_ssm_chunked_prefill_equals_serial_decode():
+    """The recurrence contract: one T=16 scan == two T=8 chunks == 16
+    serial T=1 steps, bit-identical outputs AND states; padded rows are
+    identity pass-throughs."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.ssm_ops import ssm_decay, ssm_scan
+
+    S, T, H, D = 2, 16, 2, 8
+    rs = np.random.RandomState(5)
+    q, k, v = (jnp.asarray(rs.randn(S, T, H, D), jnp.float32)
+               for _ in range(3))
+    gamma = ssm_decay(H)
+    state0 = jnp.zeros((S, H, D, D), jnp.float32)
+
+    y_full, s_full = ssm_scan(q, k, v, state0, gamma)
+    y_a, s_mid = ssm_scan(q[:, :8], k[:, :8], v[:, :8], state0, gamma)
+    y_b, s_chunk = ssm_scan(q[:, 8:], k[:, 8:], v[:, 8:], s_mid, gamma)
+    np.testing.assert_array_equal(np.asarray(jnp.concatenate(
+        [y_a, y_b], axis=1)), np.asarray(y_full))
+    np.testing.assert_array_equal(np.asarray(s_chunk), np.asarray(s_full))
+
+    s_serial = state0
+    rows = []
+    for t in range(T):
+        y_t, s_serial = ssm_scan(q[:, t:t + 1], k[:, t:t + 1],
+                                 v[:, t:t + 1], s_serial, gamma)
+        rows.append(y_t)
+    np.testing.assert_array_equal(np.asarray(jnp.concatenate(
+        rows, axis=1)), np.asarray(y_full))
+    np.testing.assert_array_equal(np.asarray(s_serial), np.asarray(s_full))
+
+    # bucket-padding rows leave the state exactly unchanged
+    valid = jnp.broadcast_to(jnp.arange(T) < 10, (S, T))
+    _, s_ragged = ssm_scan(q, k, v, state0, gamma, row_valid=valid)
+    _, s_short = ssm_scan(q[:, :10], k[:, :10], v[:, :10], state0, gamma)
+    np.testing.assert_array_equal(np.asarray(s_ragged),
+                                  np.asarray(s_short))
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding on hybrid stacks: exact verify, O(1) rollback
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("draft", ["ngram", "layers:2"])
+def test_hybrid_spec_decode_matches_oracle(params, draft):
+    """Speculation over a hybrid stack commits EXACTLY the serial greedy
+    stream: the verify executable recomputes acceptance in-graph and
+    rolls rings (lengths-only) and SSM states (snapshot select) back to
+    the commit point.  ``layers:2`` inherits the target's full,window
+    prefix as the draft stack."""
+    sconf = serve.ServeConfig(slots=2, page_size=PAGE, buckets=(16, 32),
+                              max_new=16, exact=True, spec_k=3,
+                              draft=draft, **HYBRID)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(1, CFG.vocab_size, size=13).tolist()
+    oracle = _greedy_oracle(sess, prompt, 10)
+    slot = sess.try_alloc(len(prompt), 16)
+    first, _ = sess.prefill(slot, prompt)
+    got = [first]
+    while len(got) < 10:
+        out = sess.spec_step()
+        got.extend(out[slot])
+    assert got[:10] == oracle
+    stats = sess.spec_report()
+    assert stats["verify_steps"] > 0
+    assert stats["committed"] == len(got) - 1  # prefill emitted got[0]
+    sess.release(slot)
+
+
+def test_hybrid_draft_with_ssm_layers_rejected(params):
+    """SSM layers never appear in a draft stack — the session rejects
+    the configuration up front instead of silently mis-speculating."""
+    sconf = serve.ServeConfig(slots=2, page_size=PAGE, buckets=(16,),
+                              max_new=8, spec_k=2, draft="layers:2",
+                              layers="full,ssm,window", window=WINDOW)
+    with pytest.raises(MXNetError):
+        serve.InferenceSession(params, num_heads=CFG.num_heads,
+                               config=sconf)
+
+
+# ---------------------------------------------------------------------------
+# preempt/resume, prefix opt-out, chaos, frozen executables
+# ---------------------------------------------------------------------------
+
+def test_hybrid_preempt_resume_bitexact_vs_never_evicted(params):
+    """Watermark preemption on a hybrid stack: eviction releases only
+    the full layers' pages; resume re-prefills through the SAME hybrid
+    executables, rebuilding rings and SSM state deterministically —
+    every resumed stream equals the never-evicted greedy oracle."""
+    sconf = serve.ServeConfig(slots=3, page_size=PAGE, buckets=(8, 16),
+                              max_new=8, exact=True, num_pages=5,
+                              oversub=True, prefix_pages=-1, **HYBRID)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    reqs = _trace(3, seed=23, prompt_len=8, max_new=6)
+    oracle = {r.rid: _greedy_oracle(sess, r.prompt, r.max_new)
+              for r in reqs}
+    sched = Scheduler(sess, policy="continuous")
+    done, _ = sched.run(reqs)
+    assert sched.stats["preemptions"] > 0
+    assert sched.stats["resumes"] == sched.stats["preemptions"]
+    for r in done:
+        assert not r.failed, r.error
+        assert r.tokens == oracle[r.rid]
+    assert sess.cache.free_slots == sess.config.slots
+
+
+def test_hybrid_prefix_cache_opts_out(params):
+    """Rings and SSM states are slot-private, so no window-aligned
+    boundary except offset 0 is reconstructible from published pages:
+    hybrid sessions neither publish nor hit — and still decode the
+    exact oracle streams."""
+    sconf = serve.ServeConfig(slots=2, page_size=PAGE, buckets=(16,),
+                              max_new=8, exact=True, prefix_pages=-1,
+                              **HYBRID)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    prompt = list(range(1, 17))  # two full pages: would hit if published
+    for _ in range(2):  # identical prompts back-to-back
+        oracle = _greedy_oracle(sess, prompt, 4)
+        slot = sess.try_alloc(len(prompt), 4, tokens=prompt)
+        first, _ = sess.prefill(slot, prompt)
+        got = [first]
+        for _ in range(3):
+            toks, _ = sess.step()
+            got.append(toks[slot])
+        assert got == oracle
+        sess.release(slot)
+    assert sess.cache.prefix_stats["hits"] == 0
+    assert sess.cache.prefix_stats["published_pages"] == 0
+
+
+@pytest.mark.chaos
+def test_chaos_kv_window_fault_isolates_request(params, monkeypatch):
+    """A raise at the hybrid prefill boundary (before any ring row or
+    SSM state is written) fails only the request whose prefill crossed
+    it; survivors' rings/states stay coherent — their streams match a
+    clean run — and the slot pool drains back to full."""
+    monkeypatch.setenv("MXNET_FAULT_INJECT", "kv_window:raise:after=2")
+    faults.reset()
+    sconf = serve.ServeConfig(slots=3, page_size=PAGE, buckets=(8, 16),
+                              max_new=8, exact=True, **HYBRID)
+    sess = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                  config=sconf)
+    reqs = _trace(3, seed=21, max_new=4)
+    done, _ = Scheduler(sess, policy="continuous").run(reqs)
+    failed = [r for r in done if r.failed]
+    ok = [r for r in done if not r.failed]
+    assert len(failed) == 1 and "FaultInjected" in failed[0].error
+    assert len(ok) == 2
+    assert all(len(r.tokens) == 4 for r in ok)
+    assert sess.cache.free_slots == sess.config.slots
+
+    monkeypatch.delenv("MXNET_FAULT_INJECT")
+    faults.reset()
+    clean = serve.InferenceSession(params, num_heads=CFG.num_heads,
+                                   config=sconf)
+    cdone, _ = Scheduler(clean, policy="continuous").run(
+        _trace(3, seed=21, max_new=4))
+    want = {r.rid: list(r.tokens) for r in cdone}
+    for r in ok:
+        assert list(r.tokens) == want[r.rid]
+
+
+def test_hybrid_executables_frozen_and_guard_tagged(hybrid_session,
+                                                    monkeypatch):
+    """Hybrid stacks change executable ARGUMENTS (ring/state pools, the
+    prefill slot scalar), never the executable set: a full load under
+    MXNET_RECOMPILE_ERROR=1 completes with len(buckets) + 1 executables
+    and one trace each, and the recompile-guard namespace carries the
+    window/kind tag so hybrid and classic sessions never alias."""
+    session = hybrid_session
+    assert session._guard_prefix.endswith("-w%dfws" % WINDOW)
+    monkeypatch.setenv("MXNET_RECOMPILE_ERROR", "1")
+    rs = np.random.RandomState(13)
+    reqs = [Request(rid=i,
+                    prompt=rs.randint(1, CFG.vocab_size,
+                                      size=3 + 2 * i).tolist(),
+                    max_new=5, arrival_s=0.002 * i)
+            for i in range(6)]
+    done, _ = Scheduler(session, policy="continuous").run(reqs)
+    assert all(r.done_s >= 0 and not r.failed for r in done)
+    assert sorted(session.executables) == \
+        ["decode", "prefill_16", "prefill_32"]
+    for name, snap in session.guard_report().items():
+        assert snap["traces"] == 1, (name, snap)
+        assert snap["signatures"] == 1, (name, snap)
+    assert session.fallback_count() == 0
