@@ -377,6 +377,13 @@ def main():
                 sv["window_capacity_ratio"]
             result["serving_window_latency_ratio_32k_over_4k"] = \
                 sv["window_latency_ratio_32k_over_4k"]
+            # network-edge row (real-socket gateway soak under chaos;
+            # bench_serve.py asserts zero-lost, bit-exactness, and the
+            # clean drain)
+            result["serving_socket_goodput_rps"] = sv["gw_goodput_rps"]
+            result["serving_socket_ttft_p50_delta_s"] = \
+                sv["gw_ttft_p50_delta_s"]
+            result["serving_socket_drain_clean"] = sv["gw_drain_clean"]
         except Exception as exc:  # keep the primary metric robust
             result["serving_error"] = str(exc)[:200]
         _emit_partial()

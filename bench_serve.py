@@ -73,6 +73,13 @@ Also certifies the serving acceptance criteria directly in the JSON:
                            shed accounting, goodput >= 60% of baseline,
                            and the per-replica executable count frozen
                            across death + failover.
+* ``gw_*``               — network-edge soak: the streaming asyncio
+                           ``serve.Gateway`` over real sockets, same
+                           trace + replica kill, with every 5th client
+                           RST-crashing mid-stream — zero lost
+                           requests, byte-identical completed streams,
+                           state back at the cold snapshot, and a clean
+                           graceful drain, all asserted.
 * ``compile_report``     — ``compile_cache.write_artifact`` path for
                            the serving executable set
                            (pretty-print: ``tools/compile_report.py``).
@@ -110,6 +117,82 @@ def _poisson_trace(n_requests, mean_gap_s, prompt_lens, max_new, seed):
         reqs.append(dict(rid=i, prompt=prompt, max_new=int(max_new),
                          arrival_s=float(arrivals[i])))
     return reqs
+
+
+def _gw_client(port, spec, disconnect, out):
+    """One socket client for the gateway soak: sleeps to its Poisson
+    arrival offset, POSTs ``/v1/generate``, parses the chunked SSE
+    stream, and records a TYPED terminal outcome.  ``disconnect``
+    clients RST-close after the first token event (a crashed client —
+    the gateway must cancel the decode and free its state)."""
+    import socket
+    import struct
+
+    time.sleep(spec["arrival_s"])
+    rec = {"outcome": "error", "ttft_s": None, "tokens": None}
+    out[spec["rid"]] = rec
+    t0 = time.perf_counter()
+    try:
+        sk = socket.create_connection(("127.0.0.1", port), timeout=300)
+    except OSError:
+        return
+    try:
+        body = json.dumps({"rid": spec["rid"], "prompt": spec["prompt"],
+                           "max_new": spec["max_new"]}).encode()
+        sk.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: bench\r\n"
+                   b"Content-Length: " + str(len(body)).encode()
+                   + b"\r\n\r\n" + body)
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sk.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+        head, _, buf = buf.partition(b"\r\n\r\n")
+        status = int(head.split(None, 2)[1])
+        if status == 429:
+            rec["outcome"] = "shed"
+            return
+        if status != 200:
+            rec["outcome"] = "http_%d" % status
+            return
+        while b"data: " not in buf:
+            chunk = sk.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+        rec["ttft_s"] = time.perf_counter() - t0
+        if disconnect:
+            sk.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                          struct.pack("ii", 1, 0))
+            rec["outcome"] = "disconnected"
+            return
+        while True:
+            chunk = sk.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        payload, events = b"", []
+        while buf:  # de-chunk the HTTP body, then parse the SSE events
+            size, _, buf = buf.partition(b"\r\n")
+            n = int(size, 16)
+            if n == 0:
+                break
+            payload += buf[:n]
+            buf = buf[n + 2:]
+        for line in payload.split(b"\n"):
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[6:]))
+        last = events[-1] if events else {}
+        if last.get("done") and last.get("tokens") is not None:
+            rec["outcome"] = "completed"
+            rec["tokens"] = last["tokens"]
+        elif last.get("done"):
+            rec["outcome"] = "failed:%s" % last.get("error")
+    except (OSError, ValueError):
+        pass  # rec stays "error": the zero-lost assert surfaces it
+    finally:
+        sk.close()
 
 
 def measure(argv=None):
@@ -705,6 +788,91 @@ def measure(argv=None):
     assert all(r.shed and "ServeOverloaded" in r.error
                for r in odone if r.failed)
     assert over_sum["shed"] == rs_over.counters["shed"]
+
+    # -- network-edge soak: the same chaos, now over real sockets --------
+    # A streaming asyncio Gateway fronts three fresh replicas; threaded
+    # socket clients replay the Poisson trace closed-loop (every 5th
+    # client crashes mid-stream with an RST) while one replica is
+    # chaos-killed mid-traffic.  Acceptance, asserted: zero lost
+    # requests (every client reached a typed terminal outcome),
+    # completed streams byte-identical to the in-process oracle,
+    # cancellation returned every replica to its cold-state snapshot,
+    # the per-replica executable count frozen, and the closing
+    # SIGTERM-style drain completed clean.
+    import threading as _threading
+
+    for s in soak_sessions:
+        s.reset_cold()
+    gw_snap = [s.state_report() for s in soak_sessions]
+    rs_gw = serve.ReplicaSet(sessions=soak_sessions, rejoin_backoff_s=1e9)
+    gw = serve.Gateway(rs_gw, port=0).start()
+    gw_out = {}
+    gw_drops = set(range(2, soak_n, 5))
+    gw_threads = [
+        _threading.Thread(target=_gw_client,
+                          args=(gw.port, spec, spec["rid"] in gw_drops,
+                                gw_out))
+        for spec in soak_trace]
+    _os.environ["MXNET_FAULT_INJECT"] = "serve_replica_kill:kill:after=16"
+    _faults.reset()
+    gw_t0 = time.perf_counter()
+    try:
+        for t in gw_threads:
+            t.start()
+        for t in gw_threads:
+            t.join(timeout=300)
+    finally:
+        del _os.environ["MXNET_FAULT_INJECT"]
+        _faults.reset()
+    gw_wall = time.perf_counter() - gw_t0
+    _RESULT["gw_drain_clean"] = bool(gw.drain(wait=True))
+    gw.stop()
+    assert not any(t.is_alive() for t in gw_threads), "socket client hung"
+    outcomes = [rec["outcome"] for rec in gw_out.values()]
+    _RESULT["gw_requests"] = soak_n
+    _RESULT["gw_completed"] = outcomes.count("completed")
+    _RESULT["gw_disconnects"] = outcomes.count("disconnected")
+    _RESULT["gw_shed_429"] = outcomes.count("shed")
+    _RESULT["gw_deaths"] = rs_gw.counters["deaths"]
+    assert rs_gw.counters["deaths"] == 1
+    # zero lost: nothing timed out, errored untyped, or vanished with
+    # the dead replica or the crashed clients
+    _RESULT["gw_zero_lost"] = (
+        len(gw_out) == soak_n
+        and all(o in ("completed", "disconnected", "shed")
+                for o in outcomes))
+    assert _RESULT["gw_zero_lost"], \
+        "gateway soak lost requests: %r" % sorted(set(outcomes))
+    assert _RESULT["gw_completed"] \
+        >= soak_n - len(gw_drops) - _RESULT["gw_shed_429"]
+    # every completed stream byte-identical to the in-process oracle
+    _RESULT["gw_bitexact"] = all(
+        rec["tokens"] == soak_oracle[rid]
+        for rid, rec in gw_out.items() if rec["outcome"] == "completed")
+    assert _RESULT["gw_bitexact"], "gateway streams drifted from oracle"
+    # the drain was clean: no stream needed a force-cancel
+    assert _RESULT["gw_drain_clean"], "gateway drain force-cancelled"
+    assert gw.counters["force_cancelled"] == 0
+    # crashed clients + chaos kill freed everything: each replica is
+    # byte-for-byte back at its cold snapshot
+    assert [s.state_report() for s in soak_sessions] == gw_snap, \
+        "gateway soak leaked serving state"
+    assert rs_gw.executables_per_replica() \
+        == [len(sconf.buckets) + 1] * 3, "gateway soak minted executables"
+    gw_rps = _RESULT["gw_completed"] / max(gw_wall, 1e-9)
+    gw_ttfts = sorted(rec["ttft_s"] for rec in gw_out.values()
+                      if rec["ttft_s"] is not None)
+    gw_ttft_p50 = gw_ttfts[len(gw_ttfts) // 2]
+    _RESULT["gw_goodput_rps"] = round(gw_rps, 2)
+    _RESULT["gw_goodput_ratio"] = round(gw_rps / max(base_rps, 1e-9), 3)
+    _RESULT["gw_ttft_p50_s"] = round(gw_ttft_p50, 5)
+    # the wire tax: socket TTFT p50 minus the in-process baseline's
+    _RESULT["gw_ttft_p50_delta_s"] = round(
+        gw_ttft_p50 - base_sum["ttft_p50_s"], 5)
+    assert _RESULT["gw_goodput_ratio"] >= 0.2, \
+        "gateway goodput %.2f below 20%% of in-process baseline" \
+        % _RESULT["gw_goodput_ratio"]
+    _RESULT["gw_counters"] = dict(gw.counters)
 
     # -- hybrid long-context A/B: O(1) per-slot serving memory -----------
     # Windowed-ring + SSM stacks against full attention at a FIXED

@@ -4,20 +4,23 @@ Continuous batching over bucketed AOT executables plus an O(1) paged
 KV decode cache.  See docs/serving.md for the architecture and
 bench_serve.py for the serial/static/continuous comparison.
 """
+from .gateway import Gateway
 from .kv_cache import PagedKVCache
 from .model import ModelConfig, config_from_params, decode_step, \
     full_forward, init_params, prefill_forward, reference_last_logits
-from .scheduler import Request, Scheduler, summarize
+from .scheduler import Request, Scheduler, ServeCancelled, summarize
 from .session import InferenceSession, ServeConfig
 from .supervisor import ReplicaSet, ServeOverloaded, ServeUnavailable
 
 __all__ = [
+    "Gateway",
     "InferenceSession",
     "ModelConfig",
     "PagedKVCache",
     "ReplicaSet",
     "Request",
     "Scheduler",
+    "ServeCancelled",
     "ServeConfig",
     "ServeOverloaded",
     "ServeUnavailable",

@@ -91,12 +91,35 @@ import time
 from ..base import MXNetError
 from ..testing import faults
 
-__all__ = ["Request", "Scheduler", "summarize"]
+__all__ = ["Request", "Scheduler", "ServeCancelled", "summarize"]
 
 _POLICIES = ("serial", "static", "continuous")
 
 _FRESH_STATS = {"preemptions": 0, "resumes": 0, "peak_active": 0,
-                "faulted": 0}
+                "faulted": 0, "cancelled": 0}
+
+
+class ServeCancelled(MXNetError):
+    """A request cancelled before completion — client disconnect,
+    per-request deadline, or a gateway drain force-cancel.  Typed so
+    accounting can tell deliberate cancellation apart from faults and
+    load sheds: a cancelled request is neither lost nor shed."""
+
+    def __init__(self, msg, rid=None, reason=""):
+        super().__init__(msg)
+        self.rid = rid
+        self.reason = reason
+
+
+def mark_cancelled(req, reason):
+    """Stamp one request as typed-cancelled (shared by
+    :meth:`Scheduler.cancel`, the replica dispatcher, and the gateway's
+    drain force-cancel, so the error string is uniform)."""
+    exc = ServeCancelled("request %d cancelled: %s" % (req.rid, reason),
+                         rid=req.rid, reason=reason)
+    req.failed = True
+    req.cancelled = True
+    req.error = "%s: %s" % (type(exc).__name__, exc)
 
 
 @dataclasses.dataclass
@@ -118,6 +141,9 @@ class Request:
     resumes: int = 0      # times its transcript re-prefilled (park or
     #                       failover — both cross the same resume path)
     shed: bool = False    # refused by overload protection (typed error)
+    shed_kind: str = ""   # "queue" | "deadline" when shed is set
+    cancelled: bool = False  # typed-cancelled (disconnect / deadline /
+    #                          drain) — deliberate, not a fault
 
     @property
     def finished(self):
@@ -244,6 +270,43 @@ class Scheduler(object):
         self._parked = []
         self._pending = []
         return resumable, fresh
+
+    def cancel(self, rid, reason="cancelled by client"):
+        """Cancel one request at the current decode boundary: drop it
+        from wherever it lives (pending / parked / active) and mark it
+        with a typed :class:`ServeCancelled`.  An active request's slot
+        is released refcount-aware — shared prefix pages survive for
+        their other holders, and a speculative session's mirrored draft
+        cache releases in lockstep — so pool occupancy returns to its
+        pre-request baseline.  Cancelling an unknown or already-finished
+        request is a no-op (a response that already completed stays
+        completed); returns True when something was actually cancelled.
+
+        Call between ticks: the tick loop owns the session, so the
+        caller (the gateway's dispatch thread, or any single-threaded
+        driver) must not race a tick in flight."""
+        for bucket in (self._pending, self._parked):
+            for req in bucket:
+                if req.rid == rid and not req.finished:
+                    bucket.remove(req)
+                    mark_cancelled(req, reason)
+                    self.stats["cancelled"] += 1
+                    return True
+        for slot in sorted(self._active):
+            req = self._active[slot]
+            if req.rid != rid:
+                continue
+            if req.finished:  # finish already accounted the slot
+                return False
+            del self._active[slot]
+            try:
+                self.session.release(slot)  # refcount-aware
+            except MXNetError:
+                pass
+            mark_cancelled(req, reason)
+            self.stats["cancelled"] += 1
+            return True
+        return False
 
     # -- the run loop -----------------------------------------------------
     def run(self, requests, followup=None):
@@ -445,13 +508,20 @@ def summarize(requests, makespan_s, ttft_slo_ms=0.0):
     Robustness counters always ride along so chaos A/Bs can assert on
     them: ``preemptions``/``resumes`` (watermark evictions and
     transcript replays, failover resumes included), ``shed`` (requests
-    the dispatcher refused with a typed ``ServeOverloaded``), and
-    ``faulted`` — failures that were NOT sheds, i.e. a fault or crash
-    ate the request.  ``failed`` stays the historical total (faulted +
-    shed), so existing ``failed == 0`` assertions keep their meaning."""
+    the dispatcher refused with a typed ``ServeOverloaded``) split into
+    ``shed_queue`` (bounded admission queue overflowed) and
+    ``shed_deadline`` (lapsed or projected-TTFT budget), ``cancelled``
+    (typed :class:`ServeCancelled` — client disconnects and drain
+    force-cancels, deliberate by definition), and ``faulted`` —
+    failures that were NEITHER sheds nor cancels, i.e. a fault or
+    crash ate the request.  ``failed`` stays the historical total
+    (faulted + shed + cancelled), so existing ``failed == 0``
+    assertions keep their meaning."""
     done = [r for r in requests if r.done_s >= 0.0 and not r.failed]
     failed = [r for r in requests if r.failed]
     shed = [r for r in failed if getattr(r, "shed", False)]
+    cancelled = [r for r in failed if getattr(r, "cancelled", False)
+                 and not getattr(r, "shed", False)]
     ttfts = [r.ttft_s for r in done if r.ttft_s >= 0.0]
     per_token = []
     total_tokens = 0
@@ -464,7 +534,12 @@ def summarize(requests, makespan_s, ttft_slo_ms=0.0):
         "completed": len(done),
         "failed": len(failed),
         "shed": len(shed),
-        "faulted": len(failed) - len(shed),
+        "shed_queue": sum(1 for r in shed
+                          if getattr(r, "shed_kind", "") == "queue"),
+        "shed_deadline": sum(1 for r in shed
+                             if getattr(r, "shed_kind", "") == "deadline"),
+        "cancelled": len(cancelled),
+        "faulted": len(failed) - len(shed) - len(cancelled),
         "preemptions": sum(r.preemptions for r in requests),
         "resumes": sum(getattr(r, "resumes", 0) for r in requests),
         "total_tokens": total_tokens,

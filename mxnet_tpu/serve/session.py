@@ -1124,6 +1124,27 @@ class InferenceSession(object):
         if self.draft_cache is not None:
             self.draft_cache.drop_prefix_index()
 
+    def state_report(self):
+        """Occupancy snapshot for leak assertions: the gateway's
+        cancellation tests take one before traffic and assert the
+        post-traffic report is identical — freed slots, freed pages
+        (refcount-aware: retained published-prefix pages are reported
+        separately, since they deliberately survive release), and the
+        draft cache in lockstep.  ``pool_bytes`` rides along to make
+        "pool bytes return to baseline" observable (the pools are fixed
+        buffers, so it must never move at all)."""
+        out = {
+            "active_slots": self.active_slots(),
+            "free_slots": self.cache.free_slots,
+            "free_pages": self.cache.free_pages,
+            "retained_pages": self.cache.retained_pages,
+            "pool_bytes": self.cache.pool_bytes(),
+        }
+        if self.draft_cache is not None:
+            out["draft_free_slots"] = self.draft_cache.free_slots
+            out["draft_free_pages"] = self.draft_cache.free_pages
+        return out
+
     # -- accounting -------------------------------------------------------
     @property
     def executables(self):

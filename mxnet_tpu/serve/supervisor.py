@@ -68,7 +68,7 @@ import time
 from ..base import MXNetError, StepHung, get_env, logger
 from ..health import StepWatchdog
 from ..testing import faults
-from .scheduler import Scheduler
+from .scheduler import Scheduler, mark_cancelled
 from .session import InferenceSession
 
 __all__ = ["ReplicaSet", "ServeOverloaded", "ServeUnavailable"]
@@ -178,13 +178,15 @@ class ReplicaSet(object):
             "MXNET_HEALTH_DIR", tempfile.gettempdir(), str)
         self.events = []
         self.counters = {"deaths": 0, "failover_requests": 0, "shed": 0,
-                         "rejoins": 0, "probes_failed": 0,
+                         "shed_queue": 0, "shed_deadline": 0,
+                         "cancelled": 0, "rejoins": 0, "probes_failed": 0,
                          "dispatch_faults": 0}
         self.incident_path = None
         self._watchdog = None
         self._user_followup = None
         self._ema_ttft_s = 0.0
         self._t0 = None
+        self._waiting = []   # trace requests not yet past their arrival_s
         self._queue = []     # admitted, not yet assigned (arrival order)
         self._failover = []  # drained resumable requests awaiting a home
         self._all = []
@@ -226,7 +228,7 @@ class ReplicaSet(object):
             return
         if len(self._queue) >= self.queue_cap:
             self._shed(req, "admission queue full (cap %d)"
-                       % self.queue_cap)
+                       % self.queue_cap, kind="queue")
             return
         budget_ms = float(getattr(req, "deadline_ms", 0.0)
                           or self.deadline_ms)
@@ -241,15 +243,22 @@ class ReplicaSet(object):
         self.counters["dispatch_faults"] += 1
         self._event("dispatch_fault", rid=req.rid, detail=req.error)
 
-    def _shed(self, req, why):
+    def _shed(self, req, why, kind):
+        """Refuse one request typed.  ``kind`` splits the accounting:
+        ``"queue"`` (the bounded admission queue overflowed — capacity)
+        vs ``"deadline"`` (its TTFT budget lapsed or is projected to —
+        latency), which ``summarize()`` and the incident artifact keep
+        as separate counters."""
         exc = ServeOverloaded(
             "request %d shed: %s" % (req.rid, why), rid=req.rid,
             reason=why)
         req.failed = True
         req.shed = True
+        req.shed_kind = kind
         req.error = "%s: %s" % (type(exc).__name__, exc)
         self.counters["shed"] += 1
-        self._event("shed", rid=req.rid, detail=why)
+        self.counters["shed_" + kind] += 1
+        self._event("shed", rid=req.rid, detail=why, kind=kind)
 
     def _live_capacity(self):
         return sum(max(r.headroom, 0) for r in self.live_replicas()) \
@@ -272,14 +281,16 @@ class ReplicaSet(object):
                 continue
             if now >= deadline:
                 self._shed(req, "deadline lapsed after %.0f ms in queue"
-                           % ((now - req.arrival_s) * 1e3))
+                           % ((now - req.arrival_s) * 1e3),
+                           kind="deadline")
                 continue
             projected = now + self._ema_ttft_s * (1.0 + pos / slots)
             if self._ema_ttft_s > 0.0 and projected > deadline:
                 self._shed(req, "projected TTFT %.0f ms exceeds the "
                            "%.0f ms budget"
                            % ((projected - req.arrival_s) * 1e3,
-                              (deadline - req.arrival_s) * 1e3))
+                              (deadline - req.arrival_s) * 1e3),
+                           kind="deadline")
                 continue
             keep.append((key, req))
         self._queue = keep
@@ -398,57 +409,130 @@ class ReplicaSet(object):
                     or any(r.scheduler.outstanding
                            for r in self.live_replicas()))
 
-    def run(self, requests, followup=None):
-        """Serve ``requests`` (an ``arrival_s``-stamped trace) across
-        the replica set to completion; returns ``(requests,
-        makespan_s)`` with followup-generated requests included.
-        Raises :class:`ServeUnavailable` if every replica dies with
-        work outstanding."""
-        self._t0 = time.perf_counter()
+    # -- tick form (the gateway's dispatch-thread hook) --------------------
+    def begin(self, requests=(), followup=None, t0=None):
+        """Arm the set for tick-form driving without stepping it: reset
+        counters/events, arm every replica's scheduler on one shared
+        clock, start the watchdog.  ``requests`` is an optional
+        ``arrival_s``-stamped trace; mid-run work enters via
+        :meth:`submit`.  Pair with :meth:`tick` and :meth:`finish` —
+        :meth:`run` is exactly that loop."""
+        self._t0 = time.perf_counter() if t0 is None else t0
         self._user_followup = followup
+        self._waiting = sorted(requests,
+                               key=lambda r: (r.arrival_s, r.rid))
         self._queue = []
         self._failover = []
         self._all = []
         self.events = []
         self.counters = {k: 0 for k in self.counters}
         self.incident_path = None
-        waiting = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
         for rep in self.replicas:
             rep.scheduler.begin([], followup=self._on_finish, t0=self._t0)
         self._arm_watchdog()
+        return self
+
+    def now(self):
+        return self._now()
+
+    @property
+    def outstanding(self):
+        """True while unfinished requests remain anywhere."""
+        return self._outstanding(self._waiting)
+
+    def submit(self, request):
+        """One request enters the dispatcher mid-run (the gateway
+        path): crosses ``serve_dispatch``, the bounded queue, and the
+        deadline stamp exactly like a trace arrival."""
+        self._submit(request, self._now())
+
+    def cancel(self, rid, reason="cancelled by client"):
+        """Cancel one request wherever it lives — the dispatcher's
+        waiting/queued/failover holdings, or a live replica's scheduler
+        (which releases its slot refcount-aware at the decode
+        boundary).  A late cancel of a finished or unknown request is a
+        no-op; returns True when something was cancelled.  Call between
+        ticks — the tick loop owns the replicas."""
+        def _take(seq, get=lambda item: item):
+            for i, item in enumerate(seq):
+                if get(item).rid == rid and not get(item).finished:
+                    del seq[i]
+                    return get(item)
+            return None
+
+        req = _take(self._waiting)
+        if req is not None:
+            self._all.append(req)  # never reached _submit's accounting
+        else:
+            req = _take(self._queue, get=lambda kv: kv[1]) \
+                or _take(self._failover)
+        if req is not None:
+            mark_cancelled(req, reason)
+            self.counters["cancelled"] += 1
+            self._event("cancel", rid=rid, detail=reason)
+            return True
+        for rep in self.replicas:
+            if rep.state == "live" and rep.scheduler.cancel(rid, reason):
+                self.counters["cancelled"] += 1
+                self._event("cancel", replica=rep.index, rid=rid,
+                            detail=reason)
+                return True
+        return False
+
+    def tick(self):
+        """One supervision iteration; returns True when any replica
+        made decode-boundary progress.  Raises
+        :class:`ServeUnavailable` when every replica is dead with work
+        outstanding."""
+        now = self._now()
+        # 1) arrivals enter the dispatcher
+        while self._waiting and self._waiting[0].arrival_s <= now:
+            self._submit(self._waiting.pop(0), now)
+        # 2) overload protection over the queued tail
+        self._shed_pass(now)
+        # 3) queued work to replicas with headroom
+        self._assign()
+        # 4) one decode boundary per live replica
+        progressed = self._tick_replicas()
+        # 5) total outage is a typed failure, never a hang
+        if not self.live_replicas() \
+                and self._outstanding(self._waiting):
+            self._raise_unavailable(self._waiting)
+        # 6) drained requests re-admit on survivors
+        self._place_failover()
+        # 7) ejected replicas probe for rejoin (backoff-gated)
+        now = self._now()
+        for rep in self.replicas:
+            if rep.state == "dead" and now >= rep.probe_at:
+                self._probe(rep, now)
+        return progressed
+
+    def finish(self):
+        """Stop the watchdog and persist the incident artifact — the
+        tail of :meth:`run`, called by tick-form drivers when their
+        loop ends (the gateway's drain path)."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        self._write_incident()
+
+    def run(self, requests, followup=None):
+        """Serve ``requests`` (an ``arrival_s``-stamped trace) across
+        the replica set to completion; returns ``(requests,
+        makespan_s)`` with followup-generated requests included.
+        Raises :class:`ServeUnavailable` if every replica dies with
+        work outstanding."""
+        self.begin(requests, followup=followup)
         try:
             while True:
-                now = self._now()
-                # 1) arrivals enter the dispatcher
-                while waiting and waiting[0].arrival_s <= now:
-                    self._submit(waiting.pop(0), now)
-                # 2) overload protection over the queued tail
-                self._shed_pass(now)
-                # 3) queued work to replicas with headroom
-                self._assign()
-                # 4) one decode boundary per live replica
-                progressed = self._tick_replicas()
-                # 5) total outage is a typed failure, never a hang
-                if not self.live_replicas() \
-                        and self._outstanding(waiting):
-                    self._raise_unavailable(waiting)
-                # 6) drained requests re-admit on survivors
-                self._place_failover()
-                # 7) ejected replicas probe for rejoin (backoff-gated)
-                now = self._now()
-                for rep in self.replicas:
-                    if rep.state == "dead" and now >= rep.probe_at:
-                        self._probe(rep, now)
-                if not self._outstanding(waiting):
+                progressed = self.tick()
+                if not self.outstanding:
                     break
                 if not progressed:
                     # idle: waiting on an arrival or a rejoin probe
                     time.sleep(0.002)
         finally:
-            if self._watchdog is not None:
-                self._watchdog.stop()
-                self._watchdog = None
-            self._write_incident()
+            self.finish()
         return self._all, self._now()
 
     def _tick_replicas(self):

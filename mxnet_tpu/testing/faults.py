@@ -105,7 +105,17 @@ fire instead of hanging the ring, ``delay`` models slow decode.
 ``elastic_resume``, see ``parallel/elastic.py``): a ``raise`` at any of
 them must leave the job falling back to the last good checkpoint, a
 ``kill`` must leave it resumable — the chaos matrix in
-``tests/test_elastic.py`` asserts exactly that at every phase.
+``tests/test_elastic.py`` asserts exactly that at every phase.  The
+network gateway (``serve/gateway.py``) adds four sites at its failure
+boundaries: ``gateway_read`` (after a connection's bytes are read,
+before parsing — a fault fails that connection typed, isolated from
+every other stream), ``gateway_write`` (before each streamed chunk —
+a fault is treated as the client vanishing, so the request is
+cancelled and its state freed), ``gateway_cancel`` (cancel
+propagation — a fault fails the cancel alone and the request decodes
+to completion, whose normal finish still frees the slot), and
+``gateway_drain`` (drain start — a fault collapses the grace window,
+force-cancelling in-flight streams typed immediately).
 
 The parsed spec auto-refreshes when the env var string changes; call
 :func:`reset` to re-arm counters when reusing the same string (tests).
@@ -179,6 +189,19 @@ SITES = {
                        "layout",
     "elastic_resume": "elastic migration resume phase, before the data "
                       "service seeks back to the quiesce boundary",
+    "gateway_read": "serve gateway, after a connection's request bytes "
+                    "are read and before parsing (a fault fails that "
+                    "connection typed; kill drops it abruptly)",
+    "gateway_write": "serve gateway, before each streamed chunk is "
+                     "written (a fault is treated as the client "
+                     "vanishing: the request is cancelled, state freed)",
+    "gateway_cancel": "serve gateway cancel propagation, before the "
+                      "backend releases the request's slot (a fault "
+                      "fails the cancel alone; the request decodes to "
+                      "completion, which still frees its state)",
+    "gateway_drain": "serve gateway drain start (a fault collapses the "
+                     "grace window: in-flight streams are force-"
+                     "cancelled typed immediately)",
 }
 
 

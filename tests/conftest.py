@@ -61,16 +61,56 @@ def seed_rngs():
 
 @pytest.fixture(autouse=True)
 def no_health_thread_leaks():
-    """Every watchdog/heartbeat thread must be stopped by the code that
-    started it (fit's finally block, kv.close, explicit stop()) — a
-    leaked poller would keep firing into later tests."""
+    """Every watchdog/heartbeat/gateway thread must be stopped by the
+    code that started it (fit's finally block, kv.close, explicit
+    stop()) — a leaked poller would keep firing into later tests."""
     yield
     import threading
 
     from mxnet_tpu.health import (HEARTBEAT_THREAD_PREFIX,
                                   WATCHDOG_THREAD_PREFIX)
+    from mxnet_tpu.serve.gateway import GATEWAY_THREAD_PREFIX
 
     leaked = [t.name for t in threading.enumerate()
               if t.name.startswith((WATCHDOG_THREAD_PREFIX,
-                                    HEARTBEAT_THREAD_PREFIX))]
+                                    HEARTBEAT_THREAD_PREFIX,
+                                    GATEWAY_THREAD_PREFIX))]
     assert not leaked, "leaked run-health threads: %s" % leaked
+
+
+def _net_fds():
+    """Snapshot the process's open sockets and event-loop epoll fds.
+
+    /proc-based so it sees everything (asyncio transports, raw sockets,
+    selectors) with no dependency beyond Linux; returns {} elsewhere so
+    the guard degrades to a no-op."""
+    fds = {}
+    try:
+        for name in os.listdir("/proc/self/fd"):
+            try:
+                target = os.readlink("/proc/self/fd/" + name)
+            except OSError:
+                continue  # raced a close
+            if target.startswith("socket:") or \
+                    target == "anon_inode:[eventpoll]":
+                fds[int(name)] = target
+    except OSError:
+        pass
+    return fds
+
+
+@pytest.fixture(autouse=True)
+def no_socket_leaks():
+    """A test that opens sockets or event loops (the gateway tests)
+    must close them: a leaked listener would collide with later binds
+    and a leaked loop's epoll fd pins its callbacks alive.  fd numbers
+    get recycled, so compare (fd, inode-target) pairs."""
+    before = _net_fds()
+    yield
+    after = _net_fds()
+    leaked = {fd: tgt for fd, tgt in after.items()
+              if before.get(fd) != tgt}
+    assert not leaked, (
+        "leaked sockets/event loops (fd: kind): %s — close every "
+        "socket and asyncio loop the test opens (Gateway.stop() does "
+        "both for the gateway)" % leaked)

@@ -568,3 +568,54 @@ def test_autotune_report_cli(tmp_path):
     res = run(empty)
     assert res.returncode == 1
     assert "no autotune records" in res.stderr
+
+
+def test_diagnose_cli_renders_gateway_incident(tmp_path):
+    """tools/diagnose.py on a gateway incident artifact: recognized by
+    kind, gathered by the directory glob, rendered with counters, the
+    drain outcome, open connections, and the timeline."""
+    import json
+
+    payload = {
+        "kind": "mxnet_tpu-gateway-incident",
+        "pid": 4242, "time": time.time(),
+        "host": "127.0.0.1", "port": 8431, "state": "draining",
+        "counters": {"connections": 9, "requests": 7,
+                     "streams_completed": 5, "shed_429": 1,
+                     "unavailable_503": 0, "draining_503": 1,
+                     "cancelled": 2, "slow_reader_sheds": 1,
+                     "deadline_cancels": 0, "force_cancelled": 1,
+                     "disconnects": 2, "idempotent_replays": 1},
+        "open_connections": [
+            {"rid": 31, "peer": "('127.0.0.1', 55021)",
+             "tokens_sent": 3, "keyed": True, "orphaned": True}],
+        "drain": {"requested": True, "deadline_s": 5.0, "clean": False},
+        "timeline": [
+            {"t": 0.01, "event": "start", "port": 8431},
+            {"t": 2.5, "event": "sigterm"},
+            {"t": 7.5, "event": "drain_end", "clean": False,
+             "force_cancelled": 1,
+             "detail": "grace lapsed with 1 stream open"}],
+    }
+    path = tmp_path / "gateway-incident-4242-1.json"
+    path.write_text(json.dumps(payload))
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "diagnose.py")
+    # the directory scan must pick the artifact up by its glob
+    res = subprocess.run([sys.executable, tool, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    out = res.stdout
+    assert "GATEWAY INCIDENT" in out
+    assert "127.0.0.1:8431" in out and "draining" in out
+    assert "9 connection(s)" in out and "1 shed 429" in out
+    assert "FORCED" in out  # the drain outcome line
+    assert "rid 31" in out and "orphaned" in out  # open connections
+    assert "sigterm" in out and "grace lapsed" in out  # timeline
+    # an unrecognized directory still names the gateway artifact kind
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    res = subprocess.run([sys.executable, tool, empty],
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1
+    assert "gateway-incident" in res.stderr

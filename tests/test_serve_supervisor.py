@@ -156,8 +156,14 @@ def test_queue_cap_sheds_typed(pool):
             assert r.shed and "ServeOverloaded" in r.error \
                 and "queue full" in r.error
     assert rs.counters["shed"] == s["shed"]
+    # the shed split is pinned: queue overflow, never deadline
+    assert rs.counters["shed_queue"] == s["shed"]
+    assert rs.counters["shed_deadline"] == 0
+    assert s["shed_queue"] == s["shed"] and s["shed_deadline"] == 0
+    assert all(r.shed_kind == "queue" for r in out if r.shed)
     shed_events = [e for e in rs.events if e["event"] == "shed"]
     assert len(shed_events) == s["shed"]
+    assert all(e["kind"] == "queue" for e in shed_events)
 
 
 def test_deadline_lapse_sheds_typed(pool):
@@ -168,6 +174,11 @@ def test_deadline_lapse_sheds_typed(pool):
     assert s["shed"] == 8 and s["completed"] == 0
     assert all("deadline lapsed" in r.error or "projected TTFT" in r.error
                for r in out)
+    # the shed split is pinned: all deadline, no queue overflow
+    assert rs.counters["shed_deadline"] == 8
+    assert rs.counters["shed_queue"] == 0
+    assert s["shed_deadline"] == 8 and s["shed_queue"] == 0
+    assert all(r.shed_kind == "deadline" for r in out)
 
 
 def test_per_request_deadline_overrides_default(pool):
@@ -327,7 +338,10 @@ def test_summarize_surfaces_robustness_counters(pool):
     rs = serve.ReplicaSet(sessions=pool[:2], queue_cap=1)
     out, makespan = rs.run(_mk(10))
     s = serve.summarize(out, makespan)
-    for key in ("shed", "faulted", "preemptions", "resumes"):
+    for key in ("shed", "shed_queue", "shed_deadline", "faulted",
+                "cancelled", "preemptions", "resumes"):
         assert key in s
-    assert s["failed"] == s["shed"] + s["faulted"]
+    assert s["failed"] == s["shed"] + s["faulted"] + s["cancelled"]
+    assert s["shed"] == s["shed_queue"] + s["shed_deadline"]
+    assert s["cancelled"] == 0  # nothing cancels in a closed run
     assert s["resumes"] == sum(r.resumes for r in out)

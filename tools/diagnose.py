@@ -20,6 +20,10 @@ Understands the JSON artifact kinds the sentinel writes:
 * ``serve-incident-<pid>-<n>.json`` — a serving ``ReplicaSet``'s
   incident timeline (``mxnet_tpu.serve.supervisor``): replica deaths,
   failover drains, shed requests, and rejoin probes, in order.
+* ``gateway-incident-<pid>-<n>.json`` — the serving gateway's abnormal
+  exit (``mxnet_tpu.serve.gateway``): request/shed/cancel counters,
+  the connections still open when it went down, the drain outcome, and
+  the full event timeline.
 
 Stdlib only: this must run on the stripped coordinator image where the
 training venv is gone but the dump survived.
@@ -114,11 +118,15 @@ def print_serve_incident(path, payload):
              payload.get("deadline_ms", "?"),
              payload.get("step_timeout_s", "?"),
              payload.get("breaker_k", "?")))
-    print("  totals: %s death(s), %s failover request(s), %s shed, "
-          "%s rejoin(s), %s failed probe(s)"
+    print("  totals: %s death(s), %s failover request(s), %s shed "
+          "(%s queue-full, %s deadline), %s cancelled, %s rejoin(s), "
+          "%s failed probe(s)"
           % (counters.get("deaths", 0),
              counters.get("failover_requests", 0),
-             counters.get("shed", 0), counters.get("rejoins", 0),
+             counters.get("shed", 0),
+             counters.get("shed_queue", 0),
+             counters.get("shed_deadline", 0),
+             counters.get("cancelled", 0), counters.get("rejoins", 0),
              counters.get("probes_failed", 0)))
     states = payload.get("replica_states") or []
     if states:
@@ -136,6 +144,58 @@ def print_serve_incident(path, payload):
         line = "    %8.3fs  %-13s %-10s %s" \
             % (float(ev.get("t", 0) or 0), ev.get("event", "?"), who,
                extra)
+        print(line.rstrip())
+        if ev.get("detail"):
+            print("              %s" % ev["detail"])
+
+
+def print_gateway_incident(path, payload):
+    print("=" * 72)
+    print("GATEWAY INCIDENT  %s" % path)
+    counters = payload.get("counters") or {}
+    print("  pid %s at %s — %s:%s, state %s"
+          % (payload.get("pid", "?"), _fmt_time(payload.get("time")),
+             payload.get("host", "?"), payload.get("port", "?"),
+             payload.get("state", "?")))
+    print("  totals: %s connection(s), %s request(s), %s completed, "
+          "%s shed 429, %s unavailable 503, %s draining 503"
+          % (counters.get("connections", 0),
+             counters.get("requests", 0),
+             counters.get("streams_completed", 0),
+             counters.get("shed_429", 0),
+             counters.get("unavailable_503", 0),
+             counters.get("draining_503", 0)))
+    print("  cancels: %s client, %s slow-reader, %s deadline, "
+          "%s forced; %s disconnect(s), %s idempotent replay(s)"
+          % (counters.get("cancelled", 0),
+             counters.get("slow_reader_sheds", 0),
+             counters.get("deadline_cancels", 0),
+             counters.get("force_cancelled", 0),
+             counters.get("disconnects", 0),
+             counters.get("idempotent_replays", 0)))
+    drain = payload.get("drain") or {}
+    if drain.get("requested"):
+        clean = drain.get("clean")
+        print("  drain: %s (grace %ss)"
+              % ("clean" if clean
+                 else "FORCED — in-flight streams cancelled typed",
+                 drain.get("deadline_s", "?")))
+    conns = payload.get("open_connections") or []
+    if conns:
+        print("  open connections at exit:")
+        for c in conns:
+            print("    rid %-8s peer %-22s %s token(s) sent%s%s"
+                  % (c.get("rid", "?"), c.get("peer", "?"),
+                     c.get("tokens_sent", "?"),
+                     ", keyed" if c.get("keyed") else "",
+                     ", orphaned" if c.get("orphaned") else ""))
+    print("  timeline:")
+    for ev in payload.get("timeline") or []:
+        extra = " ".join(
+            "%s=%r" % (k, v) for k, v in sorted(ev.items())
+            if k not in ("t", "event", "detail"))
+        line = "    %8.3fs  %-18s %s" \
+            % (float(ev.get("t", 0) or 0), ev.get("event", "?"), extra)
         print(line.rstrip())
         if ev.get("detail"):
             print("              %s" % ev["detail"])
@@ -161,6 +221,9 @@ def diagnose_file(path):
     if payload.get("kind") == "mxnet_tpu-serve-incident":
         print_serve_incident(path, payload)
         return True
+    if payload.get("kind") == "mxnet_tpu-gateway-incident":
+        print_gateway_incident(path, payload)
+        return True
     if name.startswith("heartbeat_rank") and "rank" in payload:
         print_heartbeat(path, payload)
         return True
@@ -173,7 +236,9 @@ def gather(target):
                  + glob.glob(os.path.join(target, "heartbeat_rank*.json"))
                  + glob.glob(os.path.join(target, "migration-*.json"))
                  + glob.glob(os.path.join(target,
-                                          "serve-incident-*.json")))
+                                          "serve-incident-*.json"))
+                 + glob.glob(os.path.join(target,
+                                          "gateway-incident-*.json")))
         return sorted(found)
     return [target]
 
@@ -192,15 +257,16 @@ def main(argv=None):
     for target in targets:
         files = gather(target)
         if not files:
-            print("%s: no watchdog/heartbeat/migration/serve-incident "
-                  "artifacts" % target, file=sys.stderr)
+            print("%s: no watchdog/heartbeat/migration/serve-incident/"
+                  "gateway-incident artifacts" % target,
+                  file=sys.stderr)
         for path in files:
             shown += diagnose_file(path)
     if not shown:
         print("nothing recognized — expected watchdog-*.json, "
-              "heartbeat_rank*.json, migration-*.json or "
-              "serve-incident-*.json (see docs/health_monitoring.md)",
-              file=sys.stderr)
+              "heartbeat_rank*.json, migration-*.json, "
+              "serve-incident-*.json or gateway-incident-*.json "
+              "(see docs/health_monitoring.md)", file=sys.stderr)
         return 1
     return 0
 
